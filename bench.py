@@ -1,0 +1,103 @@
+"""Benchmark: tokens/sec/chip + MFU on the flagship training step.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline anchor (BASELINE.md): the reference's headline is 45% MFU for
+Llama-2-7B ZeRO-3 on v5p; on one chip we measure the largest Llama-family
+model that fits and report MFU as value, vs_baseline = MFU / 0.45.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
+              batch: int = None, steps: int = None):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models import llama_config, make_model
+    from deepspeed_tpu.parallel import num_params
+
+    accel = get_accelerator()
+    on_tpu = accel.platform not in ("cpu",)
+
+    if quick or not on_tpu:
+        size, S, B, nsteps = "tiny", 512, 8, 10
+    else:
+        size, S, B, nsteps = "1b", 2048, 8, 20
+    size = model_size or size
+    S = seq or S
+    B = batch or B
+    nsteps = steps or nsteps
+
+    cfg = llama_config(size, max_seq_len=S, remat=True,
+                       remat_policy="dots_saveable")
+    model = make_model(cfg, name=f"llama-{size}")
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": B,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000000,
+    })
+
+    import itertools
+    rng = np.random.default_rng(0)
+    # pre-generate: host RNG inside the timed loop would dominate small models
+    batches = itertools.cycle(
+        [{"input_ids": rng.integers(0, cfg.vocab_size, size=(B, S), dtype=np.int32)}
+         for _ in range(min(nsteps, 8))])
+    make_batch = lambda: next(batches)
+
+    # warmup (compile). NOTE: through the axon relay, block_until_ready does
+    # not actually block — only a device->host fetch forces the dependency
+    # chain, so we sync by fetching the step counter.
+    def sync():
+        return int(np.asarray(jax.device_get(engine.state["step"])))
+
+    engine.train_batch(make_batch())
+    sync()
+
+    t0 = time.perf_counter()
+    for _ in range(nsteps):
+        engine.train_batch(make_batch())
+    sync()
+    dt = time.perf_counter() - t0
+
+    m = None
+    tokens = B * S * nsteps
+    tok_per_sec = tokens / dt
+    n_params = num_params(engine.state["params"])
+    model_flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * S
+    achieved_flops = tok_per_sec * model_flops_per_token
+    peak = accel.peak_flops_per_device("bf16") * max(1, jax.device_count())
+    mfu = achieved_flops / peak
+    return {
+        "metric": f"llama-{size} bf16 zero1 train MFU (seq={S}, bs={B}, "
+                  f"{n_params/1e6:.0f}M params, {accel.device_kind()})",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_sec_per_chip": round(tok_per_sec / max(1, jax.device_count()), 1),
+        "step_ms": round(dt / nsteps * 1000, 2),
+    }
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--size", default=None)
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    a = p.parse_args()
+    result = run_bench(quick=a.quick, model_size=a.size, seq=a.seq,
+                       batch=a.batch, steps=a.steps)
+    print(json.dumps(result))

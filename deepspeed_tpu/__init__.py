@@ -1,0 +1,42 @@
+"""deepspeed_tpu — a TPU-native training & inference framework.
+
+Capability-equivalent to DeepSpeed (reference v0.8.3, see SURVEY.md), re-designed
+for JAX/XLA on TPU: GSPMD/pjit sharding over a named device mesh replaces the
+hook-and-stream ZeRO runtime; `jax.lax` collectives over ICI/DCN replace NCCL;
+Pallas kernels replace CUDA ops; pytrees replace flatten/unflatten.
+
+Public API (mirrors the reference surface, `deepspeed/__init__.py:52,214`):
+
+    engine, optimizer, _, lr_scheduler = deepspeed_tpu.initialize(
+        model=model, config=config_dict_or_path)
+    inference_engine = deepspeed_tpu.init_inference(model, config=...)
+"""
+
+__version__ = "0.1.0"
+version = __version__
+
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator
+from deepspeed_tpu.config import Config
+from deepspeed_tpu.runtime.engine import Engine, initialize
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu import comm
+from deepspeed_tpu.utils import logging as _logging
+
+logger = _logging.logger
+
+
+def add_config_arguments(parser):
+    """Add framework arguments to an argparse parser.
+
+    Reference: ``deepspeed/__init__.py:150`` (``_add_core_arguments``) — the
+    reference exposes only ``--deepspeed``, ``--deepspeed_config``,
+    ``--local_rank``; we expose the equivalent trio.
+    """
+    group = parser.add_argument_group("deepspeed_tpu", "TPU framework configuration")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable the deepspeed_tpu engine.")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the JSON config file.")
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="Local process rank (set by the launcher).")
+    return parser

@@ -1,0 +1,192 @@
+"""Accelerator abstraction.
+
+Reference: ``accelerator/abstract_accelerator.py:7`` (~60-method ABC over device
+management, streams, events, memory, RNG, tensor factories) and
+``accelerator/real_accelerator.py:34,52`` (global get/set singleton).
+
+TPU-native re-design: XLA owns scheduling, so the stream/event surface of the
+reference is intentionally absent — async dispatch plus buffer donation is the
+idiomatic equivalent, and the few callers that genuinely need ordering use
+``synchronize()``. What remains is the part that is real on TPU: device
+enumeration, platform naming, memory stats, RNG seeding, default dtypes, and
+the communication-backend name (ICI/DCN via XLA collectives instead of NCCL).
+"""
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+
+class Accelerator:
+    """Base accelerator over JAX device APIs; concrete for any JAX platform."""
+
+    def __init__(self, platform: Optional[str] = None):
+        import jax
+        self._jax = jax
+        self._platform = platform or jax.default_backend()
+
+    # --- naming -----------------------------------------------------------
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._platform
+        return f"{self._platform}:{device_index}"
+
+    @property
+    def platform(self) -> str:
+        return self._platform
+
+    def is_available(self) -> bool:
+        try:
+            return len(self.devices()) > 0
+        except RuntimeError:
+            return False
+
+    def communication_backend_name(self) -> str:
+        """'xla' — collectives compile onto ICI/DCN; reference returns 'nccl'
+        (``accelerator/cuda_accelerator.py``)."""
+        return "xla"
+
+    # --- devices ----------------------------------------------------------
+    def devices(self) -> List:
+        return self._jax.devices(self._platform)
+
+    def local_devices(self) -> List:
+        return self._jax.local_devices(backend=self._platform)
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def local_device_count(self) -> int:
+        return len(self.local_devices())
+
+    def process_index(self) -> int:
+        return self._jax.process_index()
+
+    def process_count(self) -> int:
+        return self._jax.process_count()
+
+    def current_device(self):
+        return self.local_devices()[0]
+
+    def synchronize(self, device=None) -> None:
+        """Block until all dispatched work is complete (reference:
+        ``torch.cuda.synchronize``)."""
+        self._jax.effects_barrier()
+
+    # --- memory -----------------------------------------------------------
+    def memory_stats(self, device=None) -> dict:
+        from deepspeed_tpu.utils.memory import device_memory_stats
+        return device_memory_stats(device or self.current_device())
+
+    def memory_allocated(self, device=None) -> int:
+        device = device or self.current_device()
+        try:
+            return (device.memory_stats() or {}).get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    def max_memory_allocated(self, device=None) -> int:
+        device = device or self.current_device()
+        try:
+            return (device.memory_stats() or {}).get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    def total_memory(self, device=None) -> int:
+        device = device or self.current_device()
+        try:
+            return (device.memory_stats() or {}).get("bytes_limit", 0)
+        except Exception:
+            return 0
+
+    def available_memory(self, device=None) -> int:
+        return max(0, self.total_memory(device) - self.memory_allocated(device))
+
+    def empty_cache(self) -> None:
+        """No-op: XLA's BFC allocator manages HBM; live buffers are freed by GC."""
+
+    # --- RNG --------------------------------------------------------------
+    def manual_seed(self, seed: int):
+        """Return a root PRNG key. JAX threads explicit keys instead of global
+        RNG state (reference mutates ``torch.cuda`` RNG)."""
+        return self._jax.random.PRNGKey(seed)
+
+    def default_generator(self, seed: int = 0):
+        return self._jax.random.PRNGKey(seed)
+
+    # --- dtypes -----------------------------------------------------------
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+        return jnp.bfloat16 if self._platform == "tpu" else jnp.float32
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    # --- HLO/interconnect hints ------------------------------------------
+    def device_kind(self) -> str:
+        devs = self.local_devices()
+        return devs[0].device_kind if devs else "unknown"
+
+    def peak_flops_per_device(self, dtype: str = "bf16") -> float:
+        """Best-effort peak matmul FLOPs for MFU math; see BASELINE.md."""
+        kind = self.device_kind().lower()
+        table = {
+            # chip kind substring -> bf16 peak FLOPs
+            "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+            "v5p": 459e12, "v4": 275e12, "v3": 123e12, "v6": 918e12,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        if self._platform == "cpu":
+            return 1e11
+        return 197e12
+
+    def pin_memory(self, array):
+        """Host staging; JAX host buffers are already DMA-capable — identity."""
+        return array
+
+    def on_device(self, array, device=None):
+        return self._jax.device_put(array, device or self.current_device())
+
+
+class TPU_Accelerator(Accelerator):
+    def __init__(self):
+        super().__init__(platform=None)
+
+
+class CPU_Accelerator(Accelerator):
+    def __init__(self):
+        super().__init__(platform="cpu")
+
+    def peak_flops_per_device(self, dtype: str = "bf16") -> float:
+        return 1e11
+
+
+_ACCELERATOR: Optional[Accelerator] = None
+
+
+def get_accelerator() -> Accelerator:
+    """Global accelerator singleton (reference:
+    ``accelerator/real_accelerator.py:34``). Honors DSTPU_ACCELERATOR=cpu|tpu."""
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        forced = os.environ.get("DSTPU_ACCELERATOR", "").lower()
+        if forced == "cpu":
+            _ACCELERATOR = CPU_Accelerator()
+        else:
+            _ACCELERATOR = Accelerator()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: Accelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel

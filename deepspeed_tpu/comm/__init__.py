@@ -1,0 +1,7 @@
+from deepspeed_tpu.comm.comm import (
+    init_distributed, is_initialized, get_world_size, get_rank,
+    get_local_rank, get_device_count, get_local_device_count, barrier,
+    all_reduce, all_gather, reduce_scatter, all_to_all, ppermute, broadcast,
+    psum, pmean, pmax,
+    log_summary, comms_logger,
+)

@@ -1,0 +1,8 @@
+from deepspeed_tpu.config.config import (
+    Config, OptimizerConfig, SchedulerConfig, FP16Config, BF16Config,
+    ZeroConfig, OffloadDeviceConfig, PipelineConfig, TensorParallelConfig,
+    SequenceParallelConfig, MoEConfig, MeshConfig, ActivationCheckpointingConfig,
+    FlopsProfilerConfig, CommsLoggerConfig, AIOConfig, CheckpointConfig,
+    ElasticityConfig, AutotuningConfig, CurriculumConfig, CompressionConfig,
+)
+from deepspeed_tpu.config.config_utils import ConfigError, ConfigModel

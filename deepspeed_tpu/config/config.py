@@ -1,0 +1,427 @@
+"""The framework config tree.
+
+Reference: ``deepspeed/runtime/config.py:658`` (``DeepSpeedConfig``) plus the
+pydantic sub-configs (zero ``runtime/zero/config.py:76``, offload
+``offload_config.py:20,51``, fp16/bf16 getters ``runtime/config.py:118-640``,
+monitor ``monitor/config.py``, comms ``comm/config.py``, aio/flops-profiler
+sections). Same JSON key surface where the concept survives on TPU; new
+TPU-only keys (mesh/tensor_parallel/sequence_parallel/remat) are additive.
+
+The batch triad solve (train_batch = micro_batch × grad_accum × dp_world) is
+preserved exactly (reference: ``runtime/config.py`` batch reconciliation).
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.config.config_utils import ConfigModel, ConfigError, config_field
+from deepspeed_tpu.utils.logging import logger
+
+
+# --------------------------------------------------------------------------
+# Sub-sections
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OptimizerConfig(ConfigModel):
+    ALIASES = {"type": "name"}
+    name: str = "adamw"
+    params: Dict[str, Any] = config_field({})
+
+    def validate(self):
+        from deepspeed_tpu.ops.registry import SUPPORTED_OPTIMIZERS
+        if self.name.lower() not in SUPPORTED_OPTIMIZERS:
+            raise ConfigError(f"optimizer '{self.name}' not supported; "
+                              f"choose from {sorted(SUPPORTED_OPTIMIZERS)}")
+
+
+@dataclasses.dataclass
+class SchedulerConfig(ConfigModel):
+    ALIASES = {"type": "name"}
+    name: Optional[str] = None
+    params: Dict[str, Any] = config_field({})
+
+
+@dataclasses.dataclass
+class FP16Config(ConfigModel):
+    """Reference keys: ``runtime/config.py`` fp16 section + ``fp16/loss_scaler.py:84``."""
+    enabled: bool = False
+    loss_scale: float = 0.0            # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    auto_cast: bool = True
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+@dataclasses.dataclass
+class BF16Config(ConfigModel):
+    enabled: bool = True  # TPU-first default: bf16 on
+
+
+@dataclasses.dataclass
+class OffloadDeviceConfig(ConfigModel):
+    """Reference: ``runtime/zero/offload_config.py:20,51`` (DeepSpeedZeroOffload{Param,Optimizer}Config)."""
+    device: str = "none"              # none | cpu | nvme  (cpu == TPU-VM host DRAM)
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    max_in_cpu: int = 1_000_000_000
+    ratio: float = 1.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.device not in ("none", None)
+
+
+@dataclasses.dataclass
+class ZeroConfig(ConfigModel):
+    """Reference: ``runtime/zero/config.py:76`` (DeepSpeedZeroConfig).
+
+    On TPU, stages are realized as sharding rules over the mesh's data/fsdp
+    axes rather than a partitioned-tensor runtime:
+      stage 0 — pure DP (replicated params/grads/opt, psum grads)
+      stage 1 — optimizer states sharded over data axis
+      stage 2 — + gradients reduce-scattered (psum_scatter)
+      stage 3 — + parameters sharded, all-gathered on use by GSPMD
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    offload_param: OffloadDeviceConfig = config_field(OffloadDeviceConfig)
+    offload_optimizer: OffloadDeviceConfig = config_field(OffloadDeviceConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    elastic_checkpoint: bool = False
+
+    def validate(self):
+        if self.stage not in (0, 1, 2, 3):
+            raise ConfigError(f"zero_optimization.stage must be 0..3, got {self.stage}")
+
+
+@dataclasses.dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference: ``runtime/activation_checkpointing/checkpointing.py:789``
+    (configure). On TPU this maps to jax.checkpoint/remat policies;
+    partition_activations maps to saving activations sharded over the tensor
+    axis (GSPMD keeps them sharded when the policy saves them)."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False        # offload saved activations to host
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native additions
+    policy: str = "none"   # none | full | dots_saveable | save_nothing | offload_dots
+
+
+@dataclasses.dataclass
+class PipelineConfig(ConfigModel):
+    stages: int = 1                      # pipeline-parallel degree
+    partition_method: str = "parameters"  # parameters | uniform | type:<regex>
+    micro_batches: Optional[int] = None   # defaults to gradient_accumulation_steps
+    activation_checkpoint_interval: int = 0
+    schedule: str = "1f1b"                # 1f1b | gpipe | interleaved
+
+
+@dataclasses.dataclass
+class TensorParallelConfig(ConfigModel):
+    ALIASES = {"size": "tp_size", "tp": "tp_size"}
+    tp_size: int = 1
+    seq_parallel: bool = False  # shard activations along sequence on the tensor axis
+
+
+@dataclasses.dataclass
+class SequenceParallelConfig(ConfigModel):
+    """Context/sequence parallelism (absent in reference v0.8.3 — SURVEY §2.7;
+    first-class here): ring attention over the 'seq' mesh axis."""
+    ALIASES = {"size": "sp_size"}
+    sp_size: int = 1
+    mode: str = "ring"  # ring | allgather
+
+
+@dataclasses.dataclass
+class MoEConfig(ConfigModel):
+    enabled: bool = False
+    expert_parallel_size: int = 1
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None   # None | 'Jitter' | 'RSample'
+    drop_tokens: bool = True
+    use_residual: bool = False                # PR-MoE
+    aux_loss_weight: float = 0.01
+
+
+@dataclasses.dataclass
+class MonitorSinkConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    # wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+@dataclasses.dataclass
+class FlopsProfilerConfig(ConfigModel):
+    """Reference: ``profiling/flops_profiler`` config keys."""
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclasses.dataclass
+class CommsLoggerConfig(ConfigModel):
+    """Reference: ``deepspeed/comm/config.py`` + ``utils/comms_logging.py:58``."""
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = config_field([])
+
+
+@dataclasses.dataclass
+class AIOConfig(ConfigModel):
+    """Reference: aio section (``runtime/swap_tensor/constants.py``)."""
+    block_size: int = 1_048_576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclasses.dataclass
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"      # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = config_field({})
+    async_save: bool = False
+
+
+@dataclasses.dataclass
+class CurriculumParams(ConfigModel):
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = config_field({})
+
+
+@dataclasses.dataclass
+class CurriculumConfig(ConfigModel):
+    """Reference: curriculum_learning section (``runtime/data_pipeline/curriculum_scheduler.py``)."""
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = config_field({})
+
+
+@dataclasses.dataclass
+class DataEfficiencyConfig(ConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = config_field({})
+    data_routing: Dict[str, Any] = config_field({})
+
+
+@dataclasses.dataclass
+class CompressionConfig(ConfigModel):
+    """Reference: ``compression/config.py`` surface (weight/activation quant,
+    pruning, layer reduction)."""
+    weight_quantization: Dict[str, Any] = config_field({})
+    activation_quantization: Dict[str, Any] = config_field({})
+    sparse_pruning: Dict[str, Any] = config_field({})
+    row_pruning: Dict[str, Any] = config_field({})
+    head_pruning: Dict[str, Any] = config_field({})
+    channel_pruning: Dict[str, Any] = config_field({})
+    layer_reduction: Dict[str, Any] = config_field({})
+
+
+@dataclasses.dataclass
+class ElasticityConfig(ConfigModel):
+    """Reference: ``elasticity/config.py`` (v0.1/0.2 keys)."""
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = config_field([2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+@dataclasses.dataclass
+class AutotuningConfig(ConfigModel):
+    enabled: bool = False
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"     # gridsearch | random | model_based
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    mp_size: int = 1
+    fast: bool = True
+
+
+@dataclasses.dataclass
+class MeshConfig(ConfigModel):
+    """TPU-native: explicit mesh override. By default the planner derives the
+    mesh from world size and the parallelism degrees."""
+    axes: Dict[str, int] = config_field({})   # e.g. {"data": 4, "tensor": 2}
+    allow_split_physical_axes: bool = False
+
+
+# --------------------------------------------------------------------------
+# Root config
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Config(ConfigModel):
+    # batch triad (reference: runtime/config.py batch reconciliation)
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    sparse_gradients: bool = False
+    gradient_clipping: float = 0.0
+    communication_data_type: Optional[str] = None
+    seed: int = 42
+    disable_allgather: bool = False
+    memory_breakdown: bool = False
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = config_field(FP16Config)
+    bf16: BF16Config = config_field(BF16Config)
+    zero_optimization: ZeroConfig = config_field(ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = config_field(ActivationCheckpointingConfig)
+    pipeline: PipelineConfig = config_field(PipelineConfig)
+    tensor_parallel: TensorParallelConfig = config_field(TensorParallelConfig)
+    sequence_parallel: SequenceParallelConfig = config_field(SequenceParallelConfig)
+    moe: MoEConfig = config_field(MoEConfig)
+    mesh: MeshConfig = config_field(MeshConfig)
+
+    tensorboard: MonitorSinkConfig = config_field(MonitorSinkConfig)
+    wandb: MonitorSinkConfig = config_field(MonitorSinkConfig)
+    csv_monitor: MonitorSinkConfig = config_field(MonitorSinkConfig)
+    flops_profiler: FlopsProfilerConfig = config_field(FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = config_field(CommsLoggerConfig)
+    aio: AIOConfig = config_field(AIOConfig)
+    checkpoint: CheckpointConfig = config_field(CheckpointConfig)
+    curriculum_learning: CurriculumConfig = config_field(CurriculumConfig)
+    data_efficiency: DataEfficiencyConfig = config_field(DataEfficiencyConfig)
+    compression_training: CompressionConfig = config_field(CompressionConfig)
+    elasticity: ElasticityConfig = config_field(ElasticityConfig)
+    autotuning: AutotuningConfig = config_field(AutotuningConfig)
+
+    # ---------------------------------------------------------------------
+    @classmethod
+    def load(cls, source) -> "Config":
+        """Accept a dict, a JSON path, or an existing Config."""
+        if isinstance(source, Config):
+            return source
+        if isinstance(source, str):
+            if not os.path.exists(source):
+                raise ConfigError(f"config file not found: {source}")
+            with open(source) as f:
+                source = json.load(f)
+        return cls.from_dict(source or {})
+
+    def validate(self):
+        if self.fp16.enabled and self.bf16.enabled:
+            # reference errors on fp16+bf16 both on; we prefer the explicit one
+            logger.warning("config: fp16 and bf16 both enabled — using fp16 "
+                           "(disable one explicitly to silence)")
+            self.bf16 = BF16Config(enabled=False)
+        zero = self.zero_optimization
+        if zero.offload_param.enabled and zero.stage != 3:
+            raise ConfigError("offload_param requires zero stage 3")
+
+    # --- batch triad (train = micro × gas × dp_world) ---------------------
+    def resolve_batch_size(self, dp_world_size: int) -> None:
+        train, micro, gas = (self.train_batch_size,
+                             self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        if train is not None and micro is not None and gas is not None:
+            if train != micro * gas * dp_world_size:
+                raise ConfigError(
+                    f"batch mismatch: train_batch_size={train} != "
+                    f"micro({micro}) * gas({gas}) * dp({dp_world_size})")
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp_world_size)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp_world_size
+        elif train is not None:
+            gas = 1
+            micro = train // dp_world_size
+        elif micro is not None:
+            gas = 1
+            train = micro * dp_world_size
+        else:
+            micro, gas = 1, 1
+            train = dp_world_size
+        if micro is None or micro <= 0 or gas is None or gas <= 0:
+            raise ConfigError(
+                f"cannot solve batch triad: train={train} micro={micro} gas={gas} dp={dp_world_size}")
+        if train != micro * gas * dp_world_size:
+            raise ConfigError(
+                f"batch triad unsolvable: train_batch_size={train} not divisible into "
+                f"micro({micro}) * gas({gas}) * dp({dp_world_size})")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    # --- dtype helpers ----------------------------------------------------
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+    @property
+    def loss_scale_enabled(self) -> bool:
+        return self.fp16.enabled

@@ -1,0 +1,98 @@
+"""Config plumbing: a small typed-config base over dataclasses.
+
+Reference: ``deepspeed/runtime/config_utils.py`` (``DeepSpeedConfigModel`` on
+pydantic, with deprecated-field machinery). We use plain dataclasses with a
+recursive ``from_dict`` so the config surface is declared once and validated
+eagerly; unknown keys warn (the reference errors on some, ignores others —
+warning keeps user configs portable).
+"""
+
+import dataclasses
+import json
+from typing import Any, Dict, Type, TypeVar, get_args, get_origin, get_type_hints
+
+from deepspeed_tpu.utils.logging import logger
+
+T = TypeVar("T", bound="ConfigModel")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class ConfigModel:
+    """Base for all config sections; subclass as a @dataclass."""
+
+    # Map of json_key -> field_name overrides (e.g. "type" -> "name").
+    _aliases: Dict[str, str] = None  # type: ignore[assignment]
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Dict[str, Any], path: str = "") -> T:
+        if data is None:
+            data = {}
+        if not isinstance(data, dict):
+            raise ConfigError(f"{path or cls.__name__}: expected a dict, got {type(data).__name__}")
+        hints = get_type_hints(cls)
+        field_names = {f.name for f in dataclasses.fields(cls) if f.name != "_aliases"}
+        aliases = getattr(cls, "ALIASES", {})
+        kwargs = {}
+        for key, value in data.items():
+            name = aliases.get(key, key)
+            if name not in field_names:
+                logger.warning(f"config: unknown key '{path}{key}' (ignored)")
+                continue
+            hint = hints.get(name)
+            kwargs[name] = _coerce(hint, value, f"{path}{key}.")
+        obj = cls(**kwargs)  # type: ignore[call-arg]
+        obj.validate()
+        return obj
+
+    def validate(self) -> None:
+        """Override for cross-field checks."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(self):
+            if f.name == "_aliases":
+                continue
+            value = getattr(self, f.name)
+            if isinstance(value, ConfigModel):
+                out[f.name] = value.to_dict()
+            else:
+                out[f.name] = value
+        return out
+
+    def __repr__(self):
+        return f"{type(self).__name__}({json.dumps(self.to_dict(), default=str, indent=2)})"
+
+
+def _coerce(hint, value, path: str):
+    """Best-effort coercion of a raw JSON value to the annotated type."""
+    if hint is None or value is None:
+        return value
+    origin = get_origin(hint)
+    if origin is not None:
+        # Optional[X] / Union — try the non-None arm if it's a ConfigModel
+        for arg in get_args(hint):
+            if isinstance(arg, type) and issubclass(arg, ConfigModel) and isinstance(value, dict):
+                return arg.from_dict(value, path)
+        return value
+    if isinstance(hint, type) and issubclass(hint, ConfigModel):
+        return hint.from_dict(value if isinstance(value, dict) else {}, path)
+    if hint is float and isinstance(value, int):
+        return float(value)
+    if hint is int and isinstance(value, float) and value.is_integer():
+        return int(value)
+    if hint is bool and isinstance(value, str):
+        return value.lower() in ("true", "1", "yes")
+    return value
+
+
+def config_field(default=None, **kw):
+    if isinstance(default, (dict, list, set)) or (isinstance(default, type) and issubclass(default, ConfigModel)):
+        if isinstance(default, type):
+            return dataclasses.field(default_factory=default, **kw)
+        d = default
+        return dataclasses.field(default_factory=lambda: type(d)(d), **kw)
+    return dataclasses.field(default=default, **kw)

@@ -1,0 +1,64 @@
+"""Environment/op compatibility report (the ds_report CLI).
+
+Reference: ``deepspeed/env_report.py`` — prints op build status, torch/cuda
+versions. TPU equivalent: JAX/platform/device inventory + Pallas op
+availability + host capabilities (AVX for the host optimizer, io_uring for
+AIO).
+"""
+
+import platform
+import sys
+
+
+def _cpu_flags():
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return set(line.split(":")[1].split())
+    except Exception:
+        pass
+    return set()
+
+
+def main() -> str:
+    lines = ["-" * 60, "deepspeed_tpu environment report", "-" * 60]
+    lines.append(f"python ................ {sys.version.split()[0]} ({platform.machine()})")
+    try:
+        import jax
+        lines.append(f"jax ................... {jax.__version__}")
+        try:
+            lines.append(f"default backend ....... {jax.default_backend()}")
+            devs = jax.devices()
+            lines.append(f"devices ............... {len(devs)} x {devs[0].device_kind}")
+        except Exception as e:
+            lines.append(f"devices ............... unavailable ({str(e).splitlines()[0]})")
+    except ImportError:
+        lines.append("jax ................... NOT INSTALLED")
+    for mod in ("flax", "optax", "orbax.checkpoint"):
+        try:
+            m = __import__(mod)
+            lines.append(f"{mod:<22} {getattr(m, '__version__', 'ok')}")
+        except ImportError:
+            lines.append(f"{mod:<22} not installed")
+    lines.append("-" * 60)
+    lines.append("op compatibility:")
+    from deepspeed_tpu.ops.registry import op_report
+    for op, ok in sorted(op_report().items()):
+        lines.append(f"  {op:<28} {'[OK]' if ok else '[NO]'}")
+    flags = _cpu_flags()
+    lines.append("-" * 60)
+    lines.append("host capabilities (offload path):")
+    for flag in ("avx2", "avx512f"):
+        lines.append(f"  {flag:<28} {'[OK]' if flag in flags else '[NO]'}")
+    report = "\n".join(lines)
+    print(report)
+    return report
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

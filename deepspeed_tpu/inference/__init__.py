@@ -1,0 +1,1 @@
+from deepspeed_tpu.inference.engine import InferenceEngine, InferenceConfig, init_inference

@@ -1,0 +1,43 @@
+"""Decode loop.
+
+Fixed-shape buffer decode: the token buffer is padded to prompt+max_new
+rounded up, so the jitted forward compiles ONCE regardless of how many tokens
+are generated (causality guarantees the padding beyond the cursor cannot
+influence the logits that are read). The KV-cache incremental path (reference:
+``csrc/transformer/inference/.../inference_context.h`` workspace) lands with
+the cache manager; this full-recompute loop is the correct fallback and is
+O(n^2) in sequence, not in compiles.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(n: int, m: int = 64) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def generate(engine, input_ids, max_new_tokens: int = 32,
+             temperature: float = 0.0, rng=None):
+    ids = jnp.asarray(input_ids)
+    if ids.ndim == 1:
+        ids = ids[None]
+    B, prompt_len = ids.shape
+    total = _round_up(prompt_len + max_new_tokens)
+    buf = jnp.zeros((B, total), ids.dtype).at[:, :prompt_len].set(ids)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    for i in range(max_new_tokens):
+        cur = prompt_len + i
+        logits = engine.forward(buf)          # fixed shape -> single compile
+        next_logits = logits[:, cur - 1, :]
+        if temperature and temperature > 0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(next_logits, axis=-1)
+        buf = buf.at[:, cur].set(nxt.astype(buf.dtype))
+    return buf[:, :prompt_len + max_new_tokens]

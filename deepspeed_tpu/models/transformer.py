@@ -1,0 +1,457 @@
+"""Decoder-only transformer family (GPT-2, Llama, ...) — TPU-first.
+
+This is the in-tree model zoo equivalent of the reference's model
+implementations (``deepspeed/model_implementations/transformers/ds_transformer
+.py:18`` and the test fixtures ``tests/unit/simple_model.py``), re-designed for
+XLA:
+
+- layers are *stacked* (leading `layers` dim) and executed with `lax.scan`,
+  so compile time is O(1) in depth and pipeline stages can slice the stack;
+- every parameter carries logical axis names consumed by
+  parallel/partitioning.py (TP = megatron col/row splits fall out of the
+  ("embed","heads"/"mlp") annotations; ZeRO-3 shards "embed");
+- attention dispatches to the Pallas flash kernel when available, with a
+  pure-XLA fallback (same math, fp32 softmax);
+- GQA (n_kv_heads < n_heads), rotary or learned positions, gelu MLP or
+  silu-GLU, layernorm or rmsnorm — covering GPT-2 and Llama with one code
+  path.
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None          # GQA; None -> num_heads
+    head_dim: Optional[int] = None              # None -> hidden // heads
+    intermediate_size: Optional[int] = None     # None -> 4*hidden (gelu) / 8/3 (glu)
+    max_seq_len: int = 1024
+    position_type: str = "learned"              # learned | rotary | none
+    activation: str = "gelu"                    # gelu | silu_glu | gelu_glu
+    norm_type: str = "layernorm"                # layernorm | rmsnorm
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16                   # activation/compute dtype
+    param_dtype: Any = jnp.float32              # storage dtype (engine may cast)
+    attention_impl: str = "auto"                # auto | pallas | xla
+    remat: bool = False
+    remat_policy: str = "none"                  # none|dots_saveable|save_nothing
+    scan_layers: bool = True
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.intermediate_size:
+            return self.intermediate_size
+        if "glu" in self.activation:
+            # llama convention: 2/3 * 4h rounded to 256
+            d = int(8 * self.hidden_size / 3)
+            return 256 * ((d + 255) // 256)
+        return 4 * self.hidden_size
+
+
+# Presets (model zoo)
+def gpt2_config(size: str = "125m", **overrides) -> TransformerConfig:
+    dims = {
+        "125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+        "350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
+        "760m": dict(hidden_size=1536, num_layers=24, num_heads=16),
+        "1.3b": dict(hidden_size=2048, num_layers=24, num_heads=32),
+    }[size]
+    base = dict(vocab_size=50257, max_seq_len=1024, position_type="learned",
+                activation="gelu", norm_type="layernorm", tie_embeddings=True)
+    base.update(dims)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
+    dims = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=4, num_kv_heads=2,
+                     intermediate_size=768, vocab_size=32000, max_seq_len=2048),
+        "1b": dict(hidden_size=2048, num_layers=16, num_heads=32, num_kv_heads=8,
+                   intermediate_size=5632, vocab_size=32000, max_seq_len=4096),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                   intermediate_size=11008, vocab_size=32000, max_seq_len=4096),
+        "13b": dict(hidden_size=5120, num_layers=40, num_heads=40,
+                    intermediate_size=13824, vocab_size=32000, max_seq_len=4096),
+        "70b": dict(hidden_size=8192, num_layers=80, num_heads=64, num_kv_heads=8,
+                    intermediate_size=28672, vocab_size=32000, max_seq_len=4096),
+    }[size]
+    base = dict(position_type="rotary", activation="silu_glu", norm_type="rmsnorm",
+                norm_eps=1e-5, tie_embeddings=False)
+    base.update(dims)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def init_params(key, cfg: TransformerConfig) -> Params:
+    H, L = cfg.hidden_size, cfg.num_layers
+    nh, nkv, hd, F = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head, cfg.ffn_dim
+    k = iter(jax.random.split(key, 16))
+    dt = cfg.param_dtype
+    std = 0.02
+
+    def normal(key, shape, scale=std):
+        return (jax.random.normal(key, shape) * scale).astype(dt)
+
+    # per-layer params, stacked on a leading L dim
+    lkeys = jax.random.split(next(k), 8)
+
+    def stacked(key, shape, scale=std):
+        return (jax.random.normal(key, (L,) + shape) * scale).astype(dt)
+
+    out_scale = std / math.sqrt(2 * L)  # gpt-2 residual init scaling
+    layers = {
+        "ln1_scale": jnp.ones((L, H), dt),
+        "ln2_scale": jnp.ones((L, H), dt),
+        "wq": stacked(lkeys[0], (H, nh * hd)),
+        "wk": stacked(lkeys[1], (H, nkv * hd)),
+        "wv": stacked(lkeys[2], (H, nkv * hd)),
+        "wo": stacked(lkeys[3], (nh * hd, H), scale=out_scale),
+        "w_in": stacked(lkeys[4], (H, F)),
+        "w_out": stacked(lkeys[5], (F, H), scale=out_scale),
+    }
+    if "glu" in cfg.activation:
+        layers["w_gate"] = stacked(lkeys[6], (H, F))
+    if cfg.norm_type == "layernorm":
+        layers["ln1_bias"] = jnp.zeros((L, H), dt)
+        layers["ln2_bias"] = jnp.zeros((L, H), dt)
+        layers["bq"] = jnp.zeros((L, nh * hd), dt)
+        layers["bk"] = jnp.zeros((L, nkv * hd), dt)
+        layers["bv"] = jnp.zeros((L, nkv * hd), dt)
+        layers["bo"] = jnp.zeros((L, H), dt)
+        layers["b_in"] = jnp.zeros((L, F), dt)
+        layers["b_out"] = jnp.zeros((L, H), dt)
+
+    params: Params = {
+        "tok_embed": normal(next(k), (cfg.vocab_size, H)),
+        "layers": layers,
+        "final_norm_scale": jnp.ones((H,), dt),
+    }
+    if cfg.position_type == "learned":
+        params["pos_embed"] = normal(next(k), (cfg.max_seq_len, H), scale=0.01)
+    if cfg.norm_type == "layernorm":
+        params["final_norm_bias"] = jnp.zeros((H,), dt)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(k), (H, cfg.vocab_size))
+    return params
+
+
+def logical_axes(cfg: TransformerConfig) -> Params:
+    """Pytree of logical-axis tuples, same structure as init_params output."""
+    layers = {
+        "ln1_scale": ("layers", "unmodeled"),
+        "ln2_scale": ("layers", "unmodeled"),
+        "wq": ("layers", "embed", "qkv"),
+        "wk": ("layers", "embed", "qkv"),
+        "wv": ("layers", "embed", "qkv"),
+        "wo": ("layers", "heads", "embed"),
+        "w_in": ("layers", "embed", "mlp"),
+        "w_out": ("layers", "mlp", "embed"),
+    }
+    if "glu" in cfg.activation:
+        layers["w_gate"] = ("layers", "embed", "mlp")
+    if cfg.norm_type == "layernorm":
+        layers.update({
+            "ln1_bias": ("layers", "unmodeled"), "ln2_bias": ("layers", "unmodeled"),
+            "bq": ("layers", "qkv"), "bk": ("layers", "qkv"), "bv": ("layers", "qkv"),
+            "bo": ("layers", "unmodeled"), "b_in": ("layers", "mlp"),
+            "b_out": ("layers", "unmodeled"),
+        })
+    axes: Params = {
+        "tok_embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm_scale": ("unmodeled",),
+    }
+    if cfg.position_type == "learned":
+        axes["pos_embed"] = (None, "embed")
+    if cfg.norm_type == "layernorm":
+        axes["final_norm_bias"] = ("unmodeled",)
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# --------------------------------------------------------------------------
+# building blocks
+# --------------------------------------------------------------------------
+
+def _norm(x, scale, bias, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.norm_type == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * lax.rsqrt(var + cfg.norm_eps)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * lax.rsqrt(var + cfg.norm_eps)
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rotary_embed(x, positions, theta: float):
+    """x: [B, S, N, D]; rotate pairs (d, d + D/2) — llama convention."""
+    B, S, N, D = x.shape
+    half = D // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32) * (math.log(theta) / half))
+    angles = positions.astype(jnp.float32)[:, :, None] * freqs[None, None, :]  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _use_pallas(cfg: TransformerConfig, seq_len: int) -> bool:
+    if cfg.attention_impl == "xla":
+        return False
+    try:
+        from deepspeed_tpu.ops.flash_attention import flash_attention  # noqa: F401
+    except Exception:
+        return False
+    import jax
+    if jax.default_backend() not in ("tpu", "axon"):
+        return cfg.attention_impl == "pallas"  # explicit opt-in (interpret mode)
+    return seq_len % 128 == 0 and cfg.dim_per_head >= 64
+
+
+def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig,
+              segment_ids=None):
+    """q: [B,S,Nq,D], k/v: [B,S,Nkv,D] -> [B,S,Nq,D]."""
+    B, S, Nq, D = q.shape
+    Nkv = k.shape[2]
+    if Nkv != Nq:  # GQA: repeat kv heads
+        rep = Nq // Nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if _use_pallas(cfg, S) and mask is None and segment_ids is None:
+        from deepspeed_tpu.ops.flash_attention import flash_attention as fa
+        return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D))
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(D)
+    if causal:
+        cm = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        scores = jnp.where(cm[None, None], scores, -1e30)
+    if mask is not None:  # [B, S] padding mask over keys
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+def _activation(x, gate, cfg: TransformerConfig):
+    if cfg.activation == "silu_glu":
+        return jax.nn.silu(gate) * x
+    if cfg.activation == "gelu_glu":
+        return jax.nn.gelu(gate) * x
+    return jax.nn.gelu(x)
+
+
+def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
+                      positions=None, dropout_rng=None, deterministic=True):
+    """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+    p = layer_params
+    B, S, H = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg)
+    q = h @ p["wq"].astype(h.dtype)
+    k = h @ p["wk"].astype(h.dtype)
+    v = h @ p["wv"].astype(h.dtype)
+    if "bq" in p:
+        q, k, v = q + p["bq"].astype(h.dtype), k + p["bk"].astype(h.dtype), v + p["bv"].astype(h.dtype)
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.position_type == "rotary":
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+    attn_out = attention(q, k, v, mask=mask, causal=True, cfg=cfg)
+    attn_out = attn_out.reshape(B, S, nh * hd) @ p["wo"].astype(h.dtype)
+    if "bo" in p:
+        attn_out = attn_out + p["bo"].astype(h.dtype)
+    x = x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
+
+    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
+    up = h @ p["w_in"].astype(h.dtype)
+    if "b_in" in p:
+        up = up + p["b_in"].astype(h.dtype)
+    gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
+    act = _activation(up, gate, cfg)
+    out = act @ p["w_out"].astype(h.dtype)
+    if "b_out" in p:
+        out = out + p["b_out"].astype(h.dtype)
+    x = x + _dropout(out, cfg, dropout_rng, deterministic, 1)
+    return x
+
+
+def _dropout(x, cfg, rng, deterministic, salt: int):
+    if deterministic or cfg.dropout_rate == 0.0 or rng is None:
+        return x
+    rng = jax.random.fold_in(rng, salt)
+    keep = 1.0 - cfg.dropout_rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _remat_policy(cfg: TransformerConfig):
+    if cfg.remat_policy in ("none", None) and not cfg.remat:
+        return None
+    policies = {
+        "none": None,
+        "full": None,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "save_nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots_with_no_batch_dims": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "offload_dots": jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+        if hasattr(jax.checkpoint_policies, "offload_dot_with_no_batch_dims") else None,
+    }
+    return policies.get(cfg.remat_policy)
+
+
+def forward(params: Params, input_ids, cfg: TransformerConfig, *,
+            attention_mask=None, positions=None, dropout_rng=None,
+            deterministic: bool = True, layer_override=None):
+    """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32)."""
+    B, S = input_ids.shape
+    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    if cfg.position_type == "learned":
+        pos = positions if positions is not None else jnp.arange(S)[None]
+        x = x + params["pos_embed"][pos].astype(cfg.dtype)
+
+    layers = layer_override if layer_override is not None else params["layers"]
+
+    def body(carry, layer_p):
+        rng = carry[1]
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = None
+        y = transformer_layer(carry[0], layer_p, cfg, mask=attention_mask,
+                              positions=positions, dropout_rng=sub,
+                              deterministic=deterministic)
+        return (y, rng), None
+
+    if cfg.remat or cfg.remat_policy not in ("none", None):
+        policy = _remat_policy(cfg)
+        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+
+    if cfg.scan_layers:
+        (x, _), _ = lax.scan(body, (x, dropout_rng), layers)
+    else:
+        n_layers = jax.tree.leaves(layers)[0].shape[0]
+        carry = (x, dropout_rng)
+        for i in range(n_layers):
+            layer_p = jax.tree.map(lambda a: a[i], layers)
+            carry, _ = body(carry, layer_p)
+        x = carry[0]
+
+    x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["tok_embed"].T
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Mean next-token CE. logits [B,S,V] fp32; labels [B,S] (already aligned —
+    caller shifts, or pass input_ids as labels and we shift here via
+    lm_loss)."""
+    V = logits.shape[-1]
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
+            deterministic: bool = True):
+    """Standard causal-LM loss: predict token t+1 from prefix ≤ t."""
+    ids = batch["input_ids"]
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
+    mask = batch.get("attention_mask")
+    logits = forward(params, ids, cfg, attention_mask=mask,
+                     dropout_rng=dropout_rng, deterministic=deterministic)
+    return cross_entropy_loss(logits, labels)
+
+
+# --------------------------------------------------------------------------
+# ModelSpec — what the engine consumes
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Bundle of pure functions + metadata; any model exposing this plugs into
+    the engine (the reference's nn.Module contract equivalent)."""
+    init: Callable[[Any], Params]
+    loss_fn: Callable[..., jnp.ndarray]       # (params, batch, rng, deterministic)
+    apply: Callable[..., jnp.ndarray]         # (params, input_ids, ...) -> logits
+    logical_axes: Params
+    config: Any = None
+    name: str = "model"
+
+    def flops_per_token(self) -> float:
+        """Approximate train FLOPs/token (6N rule + attention)."""
+        cfg = self.config
+        if cfg is None:
+            return 0.0
+        n_params = (cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
+                    + cfg.num_layers * (
+                        cfg.hidden_size * (cfg.num_heads + 2 * cfg.kv_heads) * cfg.dim_per_head
+                        + cfg.num_heads * cfg.dim_per_head * cfg.hidden_size
+                        + cfg.hidden_size * cfg.ffn_dim * (3 if "glu" in cfg.activation else 2)))
+        attn = 6 * cfg.num_layers * cfg.hidden_size * cfg.max_seq_len  # rough
+        return 6.0 * n_params + attn
+
+
+def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
+    return ModelSpec(
+        init=lambda key: init_params(key, cfg),
+        loss_fn=lambda params, batch, rng=None, deterministic=True:
+            lm_loss(params, batch, cfg, dropout_rng=rng, deterministic=deterministic),
+        apply=lambda params, input_ids, **kw: forward(params, input_ids, cfg, **kw),
+        logical_axes=logical_axes(cfg),
+        config=cfg,
+        name=name,
+    )

@@ -1,0 +1,130 @@
+"""Experiment monitoring: TensorBoard / W&B / CSV fan-out.
+
+Reference: ``deepspeed/monitor/monitor.py:26`` (MonitorMaster) and the
+per-sink writers (``monitor/{tensorboard,wandb,csv_monitor}.py``). Same event
+contract: ``write_events([(name, value, step), ...])``. Only the process-0
+host writes (reference gates on rank 0).
+"""
+
+import csv
+import os
+import time
+from typing import List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+def _is_rank0() -> bool:
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg):
+        self.enabled = False
+        if not (cfg.enabled and _is_rank0()):
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+            except Exception:
+                logger.warning("tensorboard requested but no SummaryWriter available")
+                return
+        out = os.path.join(cfg.output_path or "runs", cfg.job_name)
+        self.writer = SummaryWriter(log_dir=out)
+        self.enabled = True
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, float(value), int(step))
+
+    def flush(self):
+        if self.enabled:
+            self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg):
+        self.enabled = False
+        if not (cfg.enabled and _is_rank0()):
+            return
+        try:
+            import wandb
+        except Exception:
+            logger.warning("wandb requested but not installed")
+            return
+        self.wandb = wandb
+        wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team,
+                   name=cfg.job_name or None)
+        self.enabled = True
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.wandb.log({name: float(value)}, step=int(step))
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, cfg):
+        self.enabled = False
+        if not (cfg.enabled and _is_rank0()):
+            return
+        self.dir = os.path.join(cfg.output_path or "csv_logs", cfg.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+        self.enabled = True
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name, "time"])
+                w.writerow([int(step), float(value), time.time()])
+
+
+class MonitorMaster(Monitor):
+    """Fans one event stream out to every enabled sink (reference:
+    monitor.py:26)."""
+
+    def __init__(self, config):
+        self.sinks = [
+            TensorBoardMonitor(config.tensorboard),
+            WandbMonitor(config.wandb),
+            CSVMonitor(config.csv_monitor),
+        ]
+        self.enabled = any(s.enabled for s in self.sinks)
+
+    def write_events(self, events: List[Event]) -> None:
+        for s in self.sinks:
+            if s.enabled:
+                s.write_events(events)
+
+    def flush(self):
+        for s in self.sinks:
+            if s.enabled:
+                s.flush()

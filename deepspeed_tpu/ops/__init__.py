@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.registry import SUPPORTED_OPTIMIZERS, get_optimizer_builder, op_report
+from deepspeed_tpu.ops.optimizers import Optimizer, sgd, adagrad, lion, global_grad_norm
+from deepspeed_tpu.ops.adam import adam, adamw, onebit_adam
+from deepspeed_tpu.ops.lamb import lamb

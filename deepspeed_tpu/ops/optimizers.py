@@ -1,0 +1,196 @@
+"""Optimizer core: a functional, sharding-transparent optimizer API.
+
+Reference equivalents: ``deepspeed/ops/adam/fused_adam.py:16`` (FusedAdam),
+``csrc/adam/multi_tensor_adam.cu`` (multi-tensor apply), ``runtime/fp16``
+master-weight optimizers. TPU-native design: an optimizer is a pair of pure
+functions over pytrees (optax's GradientTransformation protocol, so optax
+optimizers drop in too). "Fused" and "multi-tensor" are XLA's job — a jitted
+update over the whole pytree compiles to fused HBM-bandwidth-bound loops, which
+is exactly what multi_tensor_apply hand-builds on CUDA. A Pallas fused kernel
+variant lives in ops/fused_kernels.py for the largest flat params.
+
+Master weights: when params are bf16/fp16, state carries an fp32 copy
+(reference: fp16/fused_optimizer.py, bf16_optimizer.py). The fp32 master is
+sharded identically to the param (ZeRO-1 shards it over dp via the engine's
+state sharding rules).
+"""
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+ScalarOrSchedule = Union[float, Schedule]
+
+
+class Optimizer(NamedTuple):
+    """optax-compatible: init(params) -> state; update(grads, state, params)
+    -> (new_params_updates_applied, state). Unlike optax we return the new
+    params directly (master-weight handling makes 'updates' ambiguous)."""
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], Any]
+
+
+def _lr_at(lr: ScalarOrSchedule, step):
+    return lr(step) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def from_optax(tx) -> Optimizer:
+    """Adapt an optax GradientTransformation to this framework's Optimizer
+    protocol (optax returns (updates, state); we return (new_params, state)).
+    The optax state is wrapped in a dict so the engine's sharding logic can
+    walk it uniformly."""
+
+    def init(params):
+        return {"optax": tx.init(params)}
+
+    def update(grads, state, params):
+        import optax
+        updates, new_inner = tx.update(grads, state["optax"], params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, {"optax": new_inner}
+
+    return Optimizer(init, update)
+
+
+def is_optax_transform(opt) -> bool:
+    try:
+        import optax
+        return isinstance(opt, optax.GradientTransformation) and \
+            not isinstance(opt, Optimizer)
+    except ImportError:
+        return False
+
+
+def cast_tree(tree, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def _master_init(params, use_master: bool):
+    if not use_master:
+        return None
+    return jax.tree.map(lambda p: p.astype(jnp.float32), params)
+
+
+def _resolve_master(params, master):
+    """fp32 view of params for the update."""
+    if master is not None:
+        return master
+    return jax.tree.map(lambda p: p.astype(jnp.float32), params)
+
+
+def _writeback(new_master, params, master):
+    """Cast updated fp32 master back to the param dtype."""
+    new_params = jax.tree.map(lambda m, p: m.astype(p.dtype), new_master, params)
+    return new_params, (new_master if master is not None else None)
+
+
+def sgd(lr: ScalarOrSchedule = 1e-2, momentum: float = 0.0,
+        weight_decay: float = 0.0, nesterov: bool = False,
+        use_master_weights: bool = True) -> Optimizer:
+    def init(params):
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if momentum:
+            state["momentum"] = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        state["master"] = _master_init(params, use_master_weights)
+        return state
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = _lr_at(lr, step)
+        master = _resolve_master(params, state.get("master"))
+        g32 = cast_tree(grads, jnp.float32)
+        if weight_decay:
+            g32 = jax.tree.map(lambda g, p: g + weight_decay * p, g32, master)
+        if momentum:
+            buf = jax.tree.map(lambda b, g: momentum * b + g, state["momentum"], g32)
+            upd = jax.tree.map(lambda b, g: g + momentum * b, buf, g32) if nesterov else buf
+        else:
+            buf, upd = None, g32
+        new_master = jax.tree.map(lambda m, u: m - lr_t * u, master, upd)
+        new_params, new_master = _writeback(new_master, params, state.get("master"))
+        new_state = {"step": step, "master": new_master}
+        if momentum:
+            new_state["momentum"] = buf
+        return new_params, new_state
+
+    return Optimizer(init, update)
+
+
+def adagrad(lr: ScalarOrSchedule = 1e-2, eps: float = 1e-10,
+            weight_decay: float = 0.0, initial_accumulator: float = 0.0,
+            use_master_weights: bool = True) -> Optimizer:
+    """Reference: ``csrc/adagrad/cpu_adagrad.cpp`` / ``ops/adagrad``. """
+    def init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "accum": jax.tree.map(
+                lambda p: jnp.full(p.shape, initial_accumulator, jnp.float32), params),
+            "master": _master_init(params, use_master_weights),
+        }
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = _lr_at(lr, step)
+        master = _resolve_master(params, state.get("master"))
+        g32 = cast_tree(grads, jnp.float32)
+        if weight_decay:
+            g32 = jax.tree.map(lambda g, p: g + weight_decay * p, g32, master)
+        accum = jax.tree.map(lambda a, g: a + g * g, state["accum"], g32)
+        new_master = jax.tree.map(
+            lambda m, g, a: m - lr_t * g / (jnp.sqrt(a) + eps), master, g32, accum)
+        new_params, new_master = _writeback(new_master, params, state.get("master"))
+        return new_params, {"step": step, "accum": accum, "master": new_master}
+
+    return Optimizer(init, update)
+
+
+def lion(lr: ScalarOrSchedule = 1e-4, beta1: float = 0.9, beta2: float = 0.99,
+         weight_decay: float = 0.0, use_master_weights: bool = True) -> Optimizer:
+    """Lion (sign-momentum) — no reference equivalent; included because its
+    1-bit update is a natural fit for compressed DCN gradients."""
+    def init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            "master": _master_init(params, use_master_weights),
+        }
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = _lr_at(lr, step)
+        master = _resolve_master(params, state.get("master"))
+        g32 = cast_tree(grads, jnp.float32)
+        upd = jax.tree.map(lambda m, g: jnp.sign(beta1 * m + (1 - beta1) * g),
+                           state["mu"], g32)
+        mu = jax.tree.map(lambda m, g: beta2 * m + (1 - beta2) * g, state["mu"], g32)
+        new_master = jax.tree.map(
+            lambda p, u: p - lr_t * (u + weight_decay * p), master, upd)
+        new_params, new_master = _writeback(new_master, params, state.get("master"))
+        return new_params, {"step": step, "mu": mu, "master": new_master}
+
+    return Optimizer(init, update)
+
+
+def chain_clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
+    """Global-norm clipping before the update (reference:
+    ``runtime/utils.py`` global-norm helpers + engine gradient_clipping)."""
+    if not max_norm or max_norm <= 0:
+        return optimizer
+
+    def update(grads, state, params):
+        g32 = cast_tree(grads, jnp.float32)
+        leaves = jax.tree.leaves(g32)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+        clipped = jax.tree.map(lambda g: g * scale, g32)
+        return optimizer.update(clipped, state, params)
+
+    return Optimizer(optimizer.init, update)
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    leaves = jax.tree.leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))

@@ -1,0 +1,68 @@
+"""Op/optimizer registry.
+
+Reference: ``op_builder/all_ops.py`` + ``deepspeed/runtime/engine.py:1225``
+(``_configure_basic_optimizer`` name dispatch). There is no JIT-build step on
+TPU — Pallas kernels compile with XLA — so the registry is a plain name->factory
+table plus a compatibility report used by ``ds_report``.
+"""
+
+SUPPORTED_OPTIMIZERS = {
+    "adam", "adamw", "fusedadam", "sgd", "lamb", "fusedlamb", "adagrad",
+    "onebitadam", "onebitlamb", "zerooneadam", "lion", "cpuadam", "cpuadagrad",
+}
+
+
+def get_optimizer_builder(name: str):
+    from deepspeed_tpu.ops.adam import adam as adam_fn, adamw, onebit_adam
+    from deepspeed_tpu.ops.lamb import lamb as lamb_fn
+    from deepspeed_tpu.ops.optimizers import sgd, adagrad, lion
+    name = name.lower()
+    table = {
+        "adam": adam_fn,
+        "fusedadam": adam_fn,
+        "adamw": adamw,
+        "cpuadam": adamw,       # host-offloaded variant selected by offload config
+        "sgd": sgd,
+        "lamb": lamb_fn,
+        "fusedlamb": lamb_fn,
+        "onebitlamb": lamb_fn,
+        "adagrad": adagrad,
+        "cpuadagrad": adagrad,
+        "lion": lion,
+        "onebitadam": onebit_adam,
+        "zerooneadam": onebit_adam,
+    }
+    if name not in table:
+        raise ValueError(f"unknown optimizer '{name}'")
+    return table[name]
+
+
+def op_report():
+    """Name -> availability, for the ds_report CLI (reference: env_report.py)."""
+    report = {}
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        report["pallas"] = True
+    except ImportError:
+        report["pallas"] = False
+    modules = {
+        "flash_attention": "deepspeed_tpu.ops.flash_attention",
+        "fused_adam": "deepspeed_tpu.ops.adam",
+        "layer_norm": "deepspeed_tpu.ops.layer_norm",
+        "quantizer": "deepspeed_tpu.ops.quantizer",
+        "block_sparse_attention": "deepspeed_tpu.ops.sparse_attention",
+        "rotary": "deepspeed_tpu.models.transformer",
+    }
+    import importlib
+    for op, mod in modules.items():
+        try:
+            importlib.import_module(mod)
+            report[op] = report["pallas"]
+        except ImportError:
+            report[op] = False
+    try:
+        from deepspeed_tpu.ops.aio import aio_available
+        report["async_io"] = aio_available()
+    except Exception:
+        report["async_io"] = False
+    return report

@@ -1,0 +1,8 @@
+from deepspeed_tpu.parallel.mesh import (
+    AXIS_ORDER, MeshPlan, Topology, build_mesh, plan_from_config,
+    single_device_mesh,
+)
+from deepspeed_tpu.parallel.partitioning import (
+    ShardingRules, make_rules, logical_to_sharding, spec_tree, shard_params,
+    num_params, params_bytes,
+)

@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.engine import Engine, initialize
+from deepspeed_tpu.runtime import lr_schedules
+from deepspeed_tpu.runtime import fp16
+from deepspeed_tpu.runtime import zero
+from deepspeed_tpu.runtime import checkpointing

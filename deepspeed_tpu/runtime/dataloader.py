@@ -1,0 +1,87 @@
+"""Data loading.
+
+Reference: ``deepspeed/runtime/dataloader.py`` (DeepSpeedDataLoader wrapping a
+DistributedSampler, RepeatingLoader). Under SPMD one process feeds the global
+batch; sharding happens at device_put, so the "distributed sampler" is just
+batch slicing per host in the multi-host case (each host yields its slice of
+the global batch; jax.make_array_from_process_local_data assembles it).
+"""
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class DataLoader:
+    """Minimal batching loader over an indexable dataset of dict rows (or a
+    callable index -> row)."""
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = True, collate_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
+                           self.batch_size):
+            idx = order[start:start + self.batch_size]
+            rows = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(rows)
+
+
+class RepeatingLoader:
+    """Infinite cycling wrapper (reference: dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self._it = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self._it = iter(self.loader)
+            return next(self._it)
+
+
+def _default_collate(rows):
+    if isinstance(rows[0], dict):
+        return {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+    if isinstance(rows[0], (tuple, list)):
+        return tuple(np.stack([r[i] for r in rows]) for i in range(len(rows[0])))
+    return np.stack(rows)
+
+
+def random_token_batches(batch_size: int, seq_len: int, vocab_size: int,
+                         num_batches: int, seed: int = 0):
+    """Synthetic LM data (reference: tests/unit/simple_model.py
+    random_dataloader equivalent)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(num_batches):
+        ids = rng.integers(0, vocab_size, size=(batch_size, seq_len), dtype=np.int32)
+        yield {"input_ids": ids}

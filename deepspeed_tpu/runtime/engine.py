@@ -1,0 +1,619 @@
+"""The training engine.
+
+Reference: ``deepspeed/runtime/engine.py:183`` (DeepSpeedEngine) and
+``deepspeed/__init__.py:52`` (initialize). The reference engine is a hook
+machine: it wraps an eager nn.Module, intercepts forward/backward, buckets
+grads, and drives partitioned optimizers. Here the engine is a *compiler
+front-end*: it resolves config -> mesh plan -> sharding specs, builds ONE
+jitted train_step (forward + backward + grad-accum + optimizer + loss-scale
+update, with buffer donation), and XLA performs what stage_1_and_2.py /
+stage3.py do by hand (reduce-scatter of grads, partitioned optimizer step,
+all-gather of updated params, overlap of comm with compute).
+
+API parity:
+  initialize(...) -> (engine, optimizer, dataloader, lr_scheduler)
+  engine.train_batch(batch)            — pipe-engine-style one-call step
+  engine.forward / backward / step     — eager-style 3-call loop (grad
+                                          accumulation across calls, like the
+                                          reference's micro-batch loop)
+  engine.save_checkpoint / load_checkpoint
+  engine.global_steps, get_lr, get_loss_scale, ...
+"""
+
+import dataclasses
+import json
+import os
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.config import Config
+from deepspeed_tpu.ops.registry import get_optimizer_builder
+from deepspeed_tpu.ops.optimizers import Optimizer, global_grad_norm
+from deepspeed_tpu.parallel import (
+    MeshPlan, build_mesh, make_rules, plan_from_config, spec_tree, num_params)
+from deepspeed_tpu.runtime import fp16 as fp16_mod
+from deepspeed_tpu.runtime import zero as zero_mod
+from deepspeed_tpu.runtime import checkpointing as ckpt_mod
+from deepspeed_tpu.runtime.lr_schedules import get_scheduler
+from deepspeed_tpu.utils import logging as log_mod
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+logger = log_mod.logger
+
+
+def initialize(args=None, model=None, config=None, config_params=None,
+               optimizer=None, lr_scheduler=None, mesh=None, rng=None,
+               model_parameters=None, dist_init_required=None, mpu=None,
+               **kwargs):
+    """Build an Engine (reference: ``deepspeed/__init__.py:52``).
+
+    `model` is a ModelSpec (deepspeed_tpu.models) or any object with
+    .init/.loss_fn/.logical_axes. Returns (engine, optimizer, dataloader,
+    lr_scheduler) for signature parity — dataloader is None unless
+    training_data is passed via kwargs.
+    """
+    cfg = Config.load(config if config is not None else config_params)
+    if args is not None and getattr(args, "deepspeed_config", None):
+        cfg = Config.load(args.deepspeed_config)
+    engine = Engine(model=model, config=cfg, optimizer=optimizer,
+                    lr_scheduler=lr_scheduler, mesh=mesh, rng=rng,
+                    devices=kwargs.get("devices"))
+    training_data = kwargs.get("training_data")
+    dataloader = None
+    if training_data is not None:
+        from deepspeed_tpu.runtime.dataloader import DataLoader
+        # train_batch() consumes GLOBAL batches (train_batch_size rows)
+        dataloader = DataLoader(training_data,
+                                batch_size=engine.config.train_batch_size)
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+class Engine:
+    def __init__(self, model, config: Config, optimizer: Optional[Optimizer] = None,
+                 lr_scheduler=None, mesh: Optional[Mesh] = None, rng=None,
+                 devices=None):
+        from deepspeed_tpu import comm
+        comm.init_distributed()
+
+        self.model = model
+        self.config = config
+        self.accelerator = get_accelerator()
+
+        # --- mesh plan (reference: _configure_distributed_model:1052 + groups)
+        n_devices = len(devices) if devices is not None else jax.device_count()
+        self.plan: MeshPlan = plan_from_config(config, n_devices)
+        self.mesh: Mesh = mesh if mesh is not None else build_mesh(self.plan, devices)
+        config.resolve_batch_size(self.plan.dp_world_size)
+        logger.info(zero_mod.describe(config.zero_optimization, self.plan))
+        logger.info(f"batch: train={config.train_batch_size} "
+                    f"micro={config.train_micro_batch_size_per_gpu} "
+                    f"gas={config.gradient_accumulation_steps} "
+                    f"dp={self.plan.dp_world_size}")
+
+        # --- sharding rules
+        zero_cfg = config.zero_optimization
+        self.rules = make_rules(zero_cfg.stage, tp=self.plan.tensor > 1)
+        laxes = model.logical_axes
+        base_specs = spec_tree(laxes, self.rules)
+        # shapes via eval_shape (no memory)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+        param_shapes = jax.eval_shape(model.init, self._rng)
+        shape_tree = jax.tree.map(lambda s: s.shape, param_shapes)
+        self.param_specs = jax.tree.map(
+            lambda spec, sh: zero_mod.zero_param_spec(spec, sh, self.plan, zero_cfg),
+            base_specs, shape_tree, is_leaf=lambda x: isinstance(x, P))
+        self.grad_specs = zero_mod.tree_grad_spec(
+            self.param_specs, shape_tree, self.plan, zero_cfg)
+        self.opt_specs = zero_mod.tree_opt_spec(
+            self.param_specs, shape_tree, self.plan, zero_cfg)
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+        # --- precision (reference: _configure_distributed_model dtype + fp16 wrap)
+        self.compute_dtype = config.compute_dtype
+        self._fp16 = config.fp16.enabled
+        use_master = self.compute_dtype != jnp.float32
+
+        # --- optimizer (reference: _configure_optimizer:1175)
+        self.lr_scheduler = lr_scheduler
+        self._schedule = None
+        if lr_scheduler is None and config.scheduler is not None:
+            self._schedule = get_scheduler(config.scheduler.name,
+                                           config.scheduler.params)
+            self.lr_scheduler = self._schedule
+        elif callable(lr_scheduler):
+            self._schedule = lr_scheduler
+        if optimizer is not None:
+            from deepspeed_tpu.ops.optimizers import from_optax, is_optax_transform
+            self.optimizer = from_optax(optimizer) if is_optax_transform(optimizer) \
+                else optimizer
+        else:
+            opt_cfg = config.optimizer
+            name = opt_cfg.name if opt_cfg else "adamw"
+            params = dict(opt_cfg.params) if opt_cfg else {}
+            if self._schedule is not None:
+                params["lr"] = self._schedule
+            params.setdefault("use_master_weights", use_master)
+            builder = get_optimizer_builder(name)
+            self.optimizer = builder(**params)
+        self._base_lr = None
+        if config.optimizer and "lr" in config.optimizer.params:
+            self._base_lr = config.optimizer.params["lr"]
+
+        # --- state init (sharded at creation; reference: zero.Init equivalent)
+        self.state_shardings = None
+        self.state = self._init_state()
+
+        # --- jitted step functions
+        self._compile_steps()
+
+        # --- bookkeeping (reference: engine timers/monitor wiring)
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self.micro_steps = 0
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size,
+            steps_per_output=config.steps_per_print)
+        self._grad_buffer = None  # for forward/backward/step API
+        self._accum_count = 0
+        self.monitor = self._build_monitor()
+        self.losses = None
+        n = num_params(param_shapes)
+        logger.info(f"engine ready: {model.name if hasattr(model, 'name') else 'model'} "
+                    f"{n / 1e6:.1f}M params, dtype={self.compute_dtype.__name__}, "
+                    f"mesh={self.plan.describe()}")
+
+    # ------------------------------------------------------------------
+    def _build_monitor(self):
+        try:
+            from deepspeed_tpu.monitor import MonitorMaster
+            return MonitorMaster(self.config)
+        except Exception:
+            return None
+
+    def _init_state(self):
+        cfg = self.config
+        zero_cfg = cfg.zero_optimization
+        mesh = self.mesh
+
+        param_sh = self.param_shardings
+
+        def make_state(key):
+            params32 = self.model.init(key)
+            opt_state = self.optimizer.init(params32)
+            params = jax.tree.map(lambda p: p.astype(self.compute_dtype), params32)
+            state = {"params": params, "opt": opt_state,
+                     "step": jnp.zeros((), jnp.int32)}
+            if self._fp16:
+                if cfg.fp16.dynamic:
+                    ls = fp16_mod.init_loss_scale(cfg.fp16.initial_scale_power,
+                                                  hysteresis=cfg.fp16.hysteresis)
+                else:
+                    ls = fp16_mod.static_loss_scale(cfg.fp16.loss_scale)
+                state["loss_scale"] = {"scale": ls.scale,
+                                       "good_steps": ls.good_steps,
+                                       "hysteresis": ls.hysteresis}
+            return state
+
+        # Determine opt-state sharding by matching leaves against params:
+        # per-param tensors (same shape as a param) use opt_specs; scalars replicate.
+        state_shapes = jax.eval_shape(make_state, self._rng)
+        self.state_shardings = self._state_shardings_from(state_shapes)
+        init_fn = jax.jit(make_state, out_shardings=self.state_shardings)
+        with self.mesh:
+            state = init_fn(self._rng)
+        return state
+
+    def _state_shardings_from(self, state_shapes):
+        """Build shardings for the full train-state pytree: params use
+        param_specs, optimizer per-param tensors use opt_specs (ZeRO
+        partitioning of master/moments), scalars replicate."""
+        mesh = self.mesh
+        param_leaves, param_treedef = jax.tree.flatten(
+            jax.tree.map(lambda s: s, self.param_specs,
+                         is_leaf=lambda x: isinstance(x, P)))
+        opt_spec_tree = self.opt_specs
+
+        def shard_like_params(subtree_shapes, specs):
+            return jax.tree.map(
+                lambda sh, sp: NamedSharding(mesh, sp),
+                subtree_shapes, specs, is_leaf=lambda x: hasattr(x, "shape"))
+
+        params_shapes = state_shapes["params"]
+
+        def assign(sub):
+            """Recursively walk the optimizer state: any subtree whose pytree
+            structure matches the params tree gets the ZeRO opt-state specs
+            (covers our dict optimizers AND optax NamedTuple states); scalars
+            and everything else replicate."""
+            if sub is None:
+                return None
+            if _same_structure(sub, params_shapes):
+                return shard_like_params(sub, opt_spec_tree)
+            if hasattr(sub, "shape"):  # leaf
+                return NamedSharding(mesh, P())
+            if isinstance(sub, dict):
+                return {k: assign(v) for k, v in sub.items()}
+            if isinstance(sub, tuple) and hasattr(sub, "_fields"):  # namedtuple
+                return type(sub)(*[assign(v) for v in sub])
+            if isinstance(sub, (tuple, list)):
+                return type(sub)(assign(v) for v in sub)
+            return jax.tree.map(lambda s: NamedSharding(mesh, P()), sub)
+
+        out = {}
+        out["params"] = shard_like_params(params_shapes, self.param_specs)
+        out["opt"] = assign(state_shapes["opt"])
+        out["step"] = NamedSharding(mesh, P())
+        if "loss_scale" in state_shapes:
+            out["loss_scale"] = jax.tree.map(
+                lambda s: NamedSharding(mesh, P()), state_shapes["loss_scale"])
+        return out
+
+    # ------------------------------------------------------------------
+    def _batch_spec(self):
+        axes = ("data", "fsdp")
+        return P(axes)
+
+    def _compile_steps(self):
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        mesh = self.mesh
+        batch_sharding = NamedSharding(mesh, self._batch_spec())
+        grad_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                      self.grad_specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+        model = self.model
+        fp16 = self._fp16
+        fp16_cfg = cfg.fp16
+        clip = cfg.gradient_clipping
+        compute_dtype = self.compute_dtype
+
+        def micro_grads(params, mb, rng, scale):
+            def loss_fn(p):
+                loss = model.loss_fn(p, mb, rng, False)
+                if fp16:
+                    loss = loss * scale.astype(loss.dtype)
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = jax.lax.with_sharding_constraint(
+                jax.tree.map(lambda g: g.astype(jnp.float32), grads),
+                self.grad_specs)
+            return loss, grads
+
+        def apply_grads(state, grads, mean_loss):
+            """Unscale, clip, optimizer, loss-scale update, overflow skip."""
+            params, opt = state["params"], state["opt"]
+            if fp16:
+                ls = fp16_mod.LossScaleState(**state["loss_scale"])
+                grads = fp16_mod.unscale_grads(grads, ls)
+                overflow = fp16_mod.has_overflow(grads)
+            else:
+                overflow = jnp.zeros((), jnp.bool_)
+            gnorm = global_grad_norm(grads)
+            if clip and clip > 0:
+                scale_c = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * scale_c, grads)
+            new_params, new_opt = self.optimizer.update(grads, opt, params)
+            if fp16:
+                # skip the step on overflow (reference: step:1635 overflow path)
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n), new_params, params)
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n), new_opt, opt)
+                new_ls = fp16_mod.update_loss_scale(
+                    ls, overflow, dynamic=fp16_cfg.dynamic,
+                    scale_window=fp16_cfg.loss_scale_window,
+                    min_scale=fp16_cfg.min_loss_scale,
+                    max_hysteresis=fp16_cfg.hysteresis)
+                loss_scale_state = {"scale": new_ls.scale,
+                                    "good_steps": new_ls.good_steps,
+                                    "hysteresis": new_ls.hysteresis}
+            else:
+                loss_scale_state = None
+            # applied-update counter: does not advance on a skipped (overflow)
+            # step, mirroring the reference's optimizer-step accounting
+            new_step = jnp.where(overflow, state["step"], state["step"] + 1)
+            new_state = {"params": new_params, "opt": new_opt, "step": new_step}
+            if loss_scale_state is not None:
+                new_state["loss_scale"] = loss_scale_state
+            metrics = {"loss": mean_loss, "grad_norm": gnorm,
+                       "overflow": overflow}
+            if fp16:
+                metrics["loss_scale"] = state["loss_scale"]["scale"]
+            return new_state, metrics
+
+        def train_step(state, batch, rng):
+            """One full optimizer step over `gas` microbatches.
+            batch leaves: [global_batch, ...], sharded over (data, fsdp)."""
+            params = state["params"]
+            scale = state["loss_scale"]["scale"] if fp16 else jnp.float32(1.0)
+            if gas == 1:
+                loss, grads = micro_grads(params, batch, rng, scale)
+                mean_loss = loss
+            else:
+                def split(x):
+                    return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+                mbs = jax.tree.map(split, batch)
+                zero_grads = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zero_grads = jax.lax.with_sharding_constraint(
+                    zero_grads, self.grad_specs)
+
+                def body(carry, mb_rng):
+                    acc = carry
+                    mb, r = mb_rng
+                    loss, grads = micro_grads(params, mb, r, scale)
+                    acc = jax.tree.map(jnp.add, acc, grads)
+                    acc = jax.lax.with_sharding_constraint(acc, self.grad_specs)
+                    return acc, loss
+
+                rngs = jax.random.split(rng, gas)
+                grads, losses = jax.lax.scan(body, zero_grads, (mbs, rngs))
+                grads = jax.tree.map(lambda g: g / gas, grads)
+                mean_loss = jnp.mean(losses)
+            if fp16:
+                mean_loss = mean_loss / scale
+            return apply_grads(state, grads, mean_loss)
+
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(self.state_shardings, batch_sharding, None),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,))
+
+        def eval_step(state, batch):
+            loss = model.loss_fn(state["params"], batch, None, True)
+            return loss
+
+        self._eval_step = jax.jit(
+            eval_step, in_shardings=(self.state_shardings, batch_sharding))
+
+        # --- 3-call API pieces (forward/backward/step)
+        def grad_only(state, batch, rng):
+            scale = state["loss_scale"]["scale"] if fp16 else jnp.float32(1.0)
+            loss, grads = micro_grads(state["params"], batch, rng, scale)
+            return (loss / scale if fp16 else loss), grads
+
+        self._grad_only = jax.jit(
+            grad_only, in_shardings=(self.state_shardings, batch_sharding, None),
+            out_shardings=(None, grad_shardings))
+        self._accum = jax.jit(
+            lambda acc, g: jax.tree.map(jnp.add, acc, g),
+            in_shardings=(grad_shardings, grad_shardings),
+            out_shardings=grad_shardings, donate_argnums=(0,))
+        self._apply = jax.jit(
+            lambda state, grads, loss: apply_grads(
+                state, jax.tree.map(lambda g: g / gas, grads), loss),
+            in_shardings=(self.state_shardings, grad_shardings, None),
+            out_shardings=(self.state_shardings, None), donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    # primary API
+    # ------------------------------------------------------------------
+    def train_batch(self, batch) -> Dict[str, Any]:
+        """Consume one *global* batch (train_batch_size rows) and take one
+        optimizer step (reference: PipelineEngine.train_batch:282 semantics,
+        also covers engine fwd/bwd/step loop for non-pipe)."""
+        self.tput_timer.start()
+        self._rng, sub = jax.random.split(self._rng)
+        batch = self._device_batch(batch)
+        with self.mesh:
+            self.state, metrics = self._train_step(self.state, batch, sub)
+        self.global_steps += 1
+        self.micro_steps += self.config.gradient_accumulation_steps
+        if self._fp16 and bool(metrics["overflow"]):
+            self.skipped_steps += 1  # reference: overflow accounting in step:1635
+        self.tput_timer.stop()
+        metrics = {k: v for k, v in metrics.items()}
+        self._log_step(metrics)
+        return metrics
+
+    def eval_batch(self, batch):
+        batch = self._device_batch(batch)
+        with self.mesh:
+            return self._eval_step(self.state, batch)
+
+    # --- 3-call compatibility API (reference: forward:1652/backward:1794/step:1990)
+    def forward(self, batch):
+        """Compute loss+grads for one microbatch; grads are buffered until
+        step(). Returns the (unscaled) loss."""
+        self._rng, sub = jax.random.split(self._rng)
+        batch = self._device_batch(batch)
+        with self.mesh:
+            loss, grads = self._grad_only(self.state, batch, sub)
+        self._pending = (loss, grads)
+        return loss
+
+    def backward(self, loss=None):
+        """Accumulate the pending grads (the jitted fwd already differentiated;
+        this keeps the reference's call order meaningful)."""
+        if getattr(self, "_pending", None) is None:
+            raise RuntimeError("backward() called without forward()")
+        loss, grads = self._pending
+        self._pending = None
+        with self.mesh:
+            if self._grad_buffer is None:
+                self._grad_buffer = grads
+                self._loss_sum = loss
+            else:
+                self._grad_buffer = self._accum(self._grad_buffer, grads)
+                self._loss_sum = self._loss_sum + loss
+        self._accum_count += 1
+        self.micro_steps += 1
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self._accum_count >= self.config.gradient_accumulation_steps
+
+    def step(self):
+        """Apply the optimizer if at a grad-accum boundary (reference:
+        is_gradient_accumulation_boundary:1875 + _take_model_step:1925)."""
+        if not self.is_gradient_accumulation_boundary():
+            return None
+        mean_loss = self._loss_sum / self._accum_count
+        with self.mesh:
+            self.state, metrics = self._apply(
+                self.state, self._grad_buffer, mean_loss)
+        self._grad_buffer = None
+        self._accum_count = 0
+        self.global_steps += 1
+        if self._fp16 and bool(metrics["overflow"]):
+            self.skipped_steps += 1
+        self._log_step(metrics)
+        return metrics
+
+    # ------------------------------------------------------------------
+    def _device_batch(self, batch):
+        sharding = NamedSharding(self.mesh, self._batch_spec())
+        def put(x):
+            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            return jax.device_put(x, sharding)
+        return jax.tree.map(put, batch)
+
+    def _log_step(self, metrics):
+        cfg = self.config
+        if self.global_steps % max(1, cfg.steps_per_print) == 0:
+            loss = float(metrics["loss"])
+            lr = self.get_lr()
+            msg = (f"step={self.global_steps} loss={loss:.4f} "
+                   f"lr={lr:.3e} gnorm={float(metrics['grad_norm']):.3f}")
+            if "loss_scale" in metrics:
+                msg += f" scale={float(metrics['loss_scale']):.0f}"
+            logger.info(msg)
+            if self.monitor is not None and self.monitor.enabled:
+                self.monitor.write_events([
+                    ("Train/loss", loss, self.global_steps),
+                    ("Train/lr", lr, self.global_steps)])
+
+    # ------------------------------------------------------------------
+    # info API (reference parity helpers)
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._schedule is not None:
+            # evaluate at the APPLIED update count (+1 = the lr the next
+            # update will use); overflow-skipped steps don't advance it
+            applied = self.global_steps - self.skipped_steps
+            return float(self._schedule(jnp.asarray(applied + 1)))
+        if isinstance(self._base_lr, (int, float)):
+            return float(self._base_lr)
+        return 0.0
+
+    def get_loss_scale(self) -> float:
+        if self._fp16:
+            return float(self.state["loss_scale"]["scale"])
+        return 1.0
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        return None  # available in step metrics
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    def get_mesh(self) -> Mesh:
+        return self.mesh
+
+    @property
+    def params(self):
+        return self.state["params"]
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference: save_checkpoint:2817 / load_checkpoint:2512)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict] = None,
+                        save_latest: bool = True) -> str:
+        tag = tag if tag is not None else f"global_step{self.global_steps}"
+        client_state = dict(client_state or {})
+        client_state.update({
+            "global_steps": self.global_steps,
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+        })
+        return ckpt_mod.save_checkpoint(
+            save_dir, tag, self.state, client_state=client_state,
+            config_dict=self.config.to_dict(), save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True):
+        state, client_state = ckpt_mod.load_checkpoint(
+            load_dir, tag, template=self.state, shardings=self.state_shardings)
+        if not load_optimizer_states:
+            state["opt"] = self.state["opt"]
+        self.state = state
+        self.global_steps = int(client_state.get("global_steps", 0))
+        self.skipped_steps = int(client_state.get("skipped_steps", 0))
+        self.micro_steps = int(client_state.get("micro_steps", 0))
+        return load_dir, client_state
+
+    def save_16bit_model(self, save_dir: str, name: str = "model_fp16.ckpt"):
+        """Gathered 16-bit weights export (reference:
+        _zero3_consolidated_16bit_state_dict:3146 / save_16bit_model:3213).
+
+        bf16 has no native npz dtype, so bf16 arrays are stored as uint16
+        views plus a dtype manifest; `load_16bit_model` restores them."""
+        gathered = jax.tree.map(
+            lambda p: np.asarray(jax.device_get(p)), self.state["params"])
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, name)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        flat = _flatten_dict(gathered)
+        dtypes = {}
+        arrays = {}
+        for key, arr in flat.items():
+            dtypes[key] = str(arr.dtype)
+            if arr.dtype.kind == "V" or "bfloat16" in str(arr.dtype):
+                arr = arr.view(np.uint16)
+            arrays[key] = arr
+        np.savez(path, **arrays)
+        with open(path + ".dtypes.json", "w") as f:
+            json.dump(dtypes, f)
+        return path
+
+
+def load_16bit_model(path: str):
+    """Restore a save_16bit_model export as {name: np.ndarray} (bf16 arrays
+    come back as ml_dtypes.bfloat16)."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    data = dict(np.load(path))
+    manifest = path + ".dtypes.json"
+    if os.path.exists(manifest):
+        import ml_dtypes
+        with open(manifest) as f:
+            dtypes = json.load(f)
+        for key, dt in dtypes.items():
+            if "bfloat16" in dt and key in data:
+                data[key] = data[key].view(ml_dtypes.bfloat16)
+    return data
+
+
+def _flatten_dict(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}" if not prefix else f"{prefix}/{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_dict(v, key))
+        elif v is not None:
+            out[key] = v
+    return out
+
+
+def _same_structure(a, b) -> bool:
+    try:
+        return jax.tree.structure(a) == jax.tree.structure(b)
+    except Exception:
+        return False

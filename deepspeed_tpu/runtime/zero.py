@@ -1,0 +1,137 @@
+"""ZeRO stages as sharding rules.
+
+Reference: the partitioned-tensor runtimes —
+``runtime/zero/stage_1_and_2.py:89`` (DeepSpeedZeroOptimizer: flat bit16
+buffers, grad bucketing + reduce-scatter, per-partition optimizer step,
+all-gather of updated params) and ``runtime/zero/stage3.py:65`` +
+``partition_parameters.py:516`` (param surgery, fetch/release coordinator).
+
+TPU-native design — the whole mechanism becomes sharding specs:
+
+  stage 0: params/grads/opt replicated over dp; grads psum'ed (plain DP).
+  stage 1: optimizer state (fp32 master + moments) sharded over the dp axis.
+           GSPMD partitions the optimizer update and all-gathers the updated
+           params — exactly `step:1635` + `all_gather_dp_groups:1738`, chosen
+           by the XLA SPMD partitioner instead of hand-written buckets.
+  stage 2: + gradient accumulation buffers carry the same dp-sharded spec, so
+           XLA reduce-scatters each microbatch's grads into a sharded buffer
+           (`average_tensor:893`'s reduce-scatter, without the bucketing
+           machinery — XLA's collective combiner does the bucketing).
+  stage 3: params themselves are sharded over the `fsdp` axis (partitioning
+           rules in parallel/partitioning.py); XLA inserts all-gather at each
+           use site and frees the gathered buffer after — the
+           fetch/release/prefetch coordinator (`partitioned_param_coordinator
+           .py`) falls out of XLA liveness + latency-hiding scheduling.
+
+Persistence thresholds (`stage3_param_persistence_threshold`) survive as
+"small params stay replicated": the rules only shard tensors bigger than the
+threshold.
+"""
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.config import ZeroConfig
+from deepspeed_tpu.parallel.mesh import MeshPlan
+from deepspeed_tpu.utils.logging import logger
+
+
+def zero_param_spec(spec: P, shape: Tuple[int, ...], plan: MeshPlan,
+                    zero_cfg: ZeroConfig) -> P:
+    """Adjust a parameter's TP spec for the ZeRO stage.
+
+    Stage 3 sharding itself is handled by the logical rules (fsdp axis); this
+    applies the persistence threshold: small params revert to replicated,
+    matching `stage3_param_persistence_threshold` semantics.
+    """
+    if zero_cfg.stage < 3 or plan.fsdp <= 1:
+        return spec
+    numel = int(np.prod(shape)) if shape else 1
+    if numel <= zero_cfg.stage3_param_persistence_threshold:
+        return P(*[None if ax == "fsdp" or (isinstance(ax, tuple) and "fsdp" in ax)
+                   else ax for ax in spec])
+    return spec
+
+
+def _axis_entries(spec: P):
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(entry))
+        else:
+            out.append((entry,))
+    return out
+
+
+def opt_state_spec(param_spec: P, shape: Tuple[int, ...], plan: MeshPlan,
+                   zero_cfg: ZeroConfig, dp_axis: str = "data") -> P:
+    """Sharding for per-param optimizer state (fp32 master, moments).
+
+    Stage >= 1: additionally shard the largest dim that is (a) not already
+    sharded and (b) divisible by the dp axis size, over `data`. This is the
+    ZeRO-1 partition of optimizer state without touching param layout.
+    Falls back to the param spec if nothing divides (tiny params stay
+    replicated — same as the reference's padding-free small tensors living in
+    one partition).
+    """
+    if zero_cfg.stage < 1 or plan.data <= 1:
+        return param_spec
+    entries = _axis_entries(param_spec)
+    while len(entries) < len(shape):
+        entries.append(())
+    used = {a for e in entries for a in e}
+    if dp_axis in used:
+        return param_spec
+    mesh_sizes = plan.axis_sizes()
+    # size of each dim's shard after existing sharding
+    best_dim, best_size = -1, 0
+    for i, dim in enumerate(shape):
+        denom = int(np.prod([mesh_sizes.get(a, 1) for a in entries[i]])) if entries[i] else 1
+        local = dim // denom if denom and dim % denom == 0 else 0
+        if local and local % plan.data == 0 and local > best_size:
+            best_dim, best_size = i, local
+    if best_dim < 0:
+        return param_spec
+    entries[best_dim] = entries[best_dim] + (dp_axis,)
+    out = [tuple(e) if len(e) > 1 else (e[0] if e else None) for e in entries]
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def grad_spec(param_spec: P, shape: Tuple[int, ...], plan: MeshPlan,
+              zero_cfg: ZeroConfig) -> P:
+    """Sharding for gradient accumulation buffers.
+
+    Stage >= 2: grads live dp-sharded (reduce-scatter semantics). We reuse the
+    optimizer-state spec so grads land exactly where the optimizer will read
+    them. Stage < 2: grads follow the params.
+    """
+    if zero_cfg.stage >= 2:
+        return opt_state_spec(param_spec, shape, plan, zero_cfg)
+    return param_spec
+
+
+def tree_opt_spec(param_specs, shapes, plan: MeshPlan, zero_cfg: ZeroConfig):
+    return jax.tree.map(
+        lambda s, sh: opt_state_spec(s, sh, plan, zero_cfg),
+        param_specs, shapes, is_leaf=lambda x: isinstance(x, P))
+
+
+def tree_grad_spec(param_specs, shapes, plan: MeshPlan, zero_cfg: ZeroConfig):
+    return jax.tree.map(
+        lambda s, sh: grad_spec(s, sh, plan, zero_cfg),
+        param_specs, shapes, is_leaf=lambda x: isinstance(x, P))
+
+
+def describe(zero_cfg: ZeroConfig, plan: MeshPlan) -> str:
+    return (f"ZeRO stage {zero_cfg.stage} | mesh {plan.describe()} | "
+            f"params {'fsdp-sharded' if zero_cfg.stage >= 3 else 'replicated'}, "
+            f"grads {'dp-sharded' if zero_cfg.stage >= 2 else 'replicated'}, "
+            f"opt {'dp-sharded' if zero_cfg.stage >= 1 else 'replicated'}")

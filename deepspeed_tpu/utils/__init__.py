@@ -1,0 +1,3 @@
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils.memory import see_memory_usage

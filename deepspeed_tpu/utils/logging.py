@@ -1,0 +1,53 @@
+"""Rank-aware logging. Reference: ``deepspeed/utils/logging.py`` (logger, log_dist)."""
+
+import logging
+import os
+import sys
+
+_LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=_LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log only on the given process ranks (None or [-1] = all).
+
+    Reference: ``deepspeed/utils/logging.py`` ``log_dist``.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

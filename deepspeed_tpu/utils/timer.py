@@ -1,0 +1,156 @@
+"""Wall-clock and throughput timers.
+
+Reference: ``deepspeed/utils/timer.py:32`` (``SynchronizedWallClockTimer``) and
+``:136`` (``ThroughputTimer``). The reference synchronizes CUDA streams around
+each timer; on TPU the equivalent is blocking on JAX async dispatch
+(``jax.block_until_ready`` / ``jax.effects_barrier``), which we make optional
+because it serializes the pipeline.
+"""
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _device_sync():
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str, synchronize: bool = False):
+        self.name = name
+        self.synchronize = synchronize
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0  # seconds
+        self._count = 0
+
+    def start(self):
+        if self.started:
+            return
+        if self.synchronize:
+            _device_sync()
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, reset: bool = False):
+        if not self.started:
+            return
+        if self.synchronize:
+            _device_sync()
+        self._elapsed += time.perf_counter() - self._start
+        self._count += 1
+        self.started = False
+        if reset:
+            self._elapsed = 0.0
+            self._count = 0
+
+    def reset(self):
+        self.started = False
+        self._elapsed = 0.0
+        self._count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Elapsed time in milliseconds (matches the reference's unit)."""
+        value = self._elapsed * 1000.0
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        if self._count == 0:
+            return 0.0
+        return self._elapsed * 1000.0 / self._count
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry; ``timer('name').start()/stop()`` + ``log(names)``."""
+
+    def __init__(self, synchronize: bool = False):
+        self.timers: "OrderedDict[str, _Timer]" = OrderedDict()
+        self.synchronize = synchronize
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name, synchronize=self.synchronize)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: Optional[List[str]] = None, normalizer: float = 1.0,
+            reset: bool = True, memory_breakdown: bool = False) -> str:
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        msg = "time (ms) | " + " | ".join(parts)
+        logger.info(msg)
+        return msg
+
+    def get_mean(self, names: List[str]) -> Dict[str, float]:
+        return {n: self.timers[n].mean() for n in names if n in self.timers}
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec + TFLOPS reporting across steps.
+
+    Reference: ``deepspeed/utils/timer.py:136``. We keep the same skip of the
+    first few steps (compile warm-up dominates on XLA far more than on CUDA).
+    """
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self._start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self):
+        self.local_step_count = 0
+
+    def start(self):
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self._start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+            self.local_step_count += 1
+        if self.global_step_count > self.start_step and self._start_time:
+            _device_sync()
+            duration = time.perf_counter() - self._start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.avg_samples_per_sec():.2f}, "
+                    f"time/step(ms)={duration * 1000:.2f}")
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count <= self.start_step or self.total_elapsed_time == 0:
+            return 0.0
+        steps = self.global_step_count - self.start_step
+        avg = self.total_elapsed_time / max(1, steps)
+        return self.batch_size / avg

@@ -1,0 +1,53 @@
+"""Test harness: single-process 8-virtual-device CPU mesh.
+
+Reference test strategy (SURVEY §4): the reference spawns N torch processes
+per test (tests/unit/common.py DistributedExec). The TPU-idiomatic equivalent
+is one process with XLA_FLAGS=--xla_force_host_platform_device_count=8 — the
+SPMD partitioner behaves identically to a real 8-chip slice, minus the wire.
+
+Env vars MUST be set before jax imports, hence module level.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DSTPU_LOG_LEVEL", "warning")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# the image's sitecustomize imports jax before conftest runs, so the env vars
+# above may be too late — force the platform through the live config instead.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def make_batch(batch_size: int, seq_len: int, vocab: int = 256, seed: int = 0):
+    r = np.random.default_rng(seed)
+    return {"input_ids": r.integers(0, vocab, size=(batch_size, seq_len), dtype=np.int32)}
+
+
+@pytest.fixture()
+def tiny_model():
+    from deepspeed_tpu.models import TransformerConfig, make_model
+    import jax.numpy as jnp
+    cfg = TransformerConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        max_seq_len=128, dtype=jnp.float32, attention_impl="xla")
+    return make_model(cfg, name="tiny")

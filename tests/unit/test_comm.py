@@ -1,0 +1,85 @@
+"""Collectives facade tests on the 8-device CPU mesh (reference:
+tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu import comm
+
+
+@pytest.fixture()
+def mesh1d(devices8):
+    return Mesh(np.asarray(devices8), ("data",))
+
+
+def _run(mesh, fn, x, in_spec, out_spec):
+    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
+    return f(x)
+
+
+def test_psum(mesh1d):
+    x = jnp.arange(8.0)
+    out = _run(mesh1d, lambda v: comm.psum(v, "data"), x, P("data"), P("data"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+
+
+def test_pmean(mesh1d):
+    x = jnp.arange(8.0)
+    out = _run(mesh1d, lambda v: comm.pmean(v, "data"), x, P("data"), P("data"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.mean()))
+
+
+def test_all_gather(mesh1d):
+    x = jnp.arange(8.0)
+    out = _run(mesh1d, lambda v: comm.all_gather(v, "data"), x, P("data"), P("data"))
+    # each shard gathers the full vector -> output global shape (8*8,)
+    assert out.shape == (64,)
+    np.testing.assert_allclose(np.asarray(out)[:8], np.arange(8.0))
+
+
+def test_reduce_scatter(mesh1d):
+    # every shard holds [0..7]; psum_scatter sums -> 8*x, shard i keeps elem i
+    x = jnp.tile(jnp.arange(8.0), (8,))
+    out = _run(mesh1d, lambda v: comm.reduce_scatter(v, "data"), x, P("data"), P("data"))
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 8)
+
+
+def test_all_to_all(mesh1d):
+    x = jnp.arange(64.0)  # shard i holds [8i..8i+8)
+    out = _run(mesh1d,
+               lambda v: comm.all_to_all(v, "data", split_axis=0, concat_axis=0),
+               x, P("data"), P("data"))
+    got = np.asarray(out).reshape(8, 8)
+    np.testing.assert_allclose(got, np.arange(64).reshape(8, 8).T)
+
+
+def test_ppermute_ring(mesh1d):
+    x = jnp.arange(8.0)
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    out = _run(mesh1d, lambda v: comm.ppermute(v, "data", perm), x, P("data"), P("data"))
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_broadcast(mesh1d):
+    x = jnp.arange(8.0)
+    out = _run(mesh1d, lambda v: comm.broadcast(v, "data", src_index=3), x,
+               P("data"), P("data"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+
+def test_comms_logger_records():
+    comm.comms_logger.configure(enabled=True)
+    comm.comms_logger.reset()
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    x = jnp.arange(8.0)
+    _run(mesh, lambda v: comm.psum(v, "data"), x, P("data"), P("data"))
+    summary = comm.log_summary()
+    assert "all_reduce" in summary
+    comm.comms_logger.configure(enabled=False)
+
+
+def test_barrier_runs():
+    comm.barrier()

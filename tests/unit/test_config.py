@@ -1,0 +1,84 @@
+"""Config system tests (reference: tests/unit/runtime/test_ds_config_dict.py /
+test_ds_config_model.py)."""
+
+import pytest
+
+from deepspeed_tpu.config import Config, ConfigError
+
+
+def test_defaults():
+    cfg = Config.load({})
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.bf16.enabled
+    assert not cfg.fp16.enabled
+
+
+def test_batch_triad_full():
+    cfg = Config.load({"train_batch_size": 32,
+                       "train_micro_batch_size_per_gpu": 4,
+                       "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triad_mismatch():
+    cfg = Config.load({"train_batch_size": 32,
+                       "train_micro_batch_size_per_gpu": 4,
+                       "gradient_accumulation_steps": 4})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_size(dp_world_size=4)
+
+
+def test_batch_triad_solve_gas():
+    cfg = Config.load({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triad_solve_from_micro_only():
+    cfg = Config.load({"train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.train_batch_size == 16
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_zero_stage_validation():
+    with pytest.raises(ConfigError):
+        Config.load({"zero_optimization": {"stage": 5}})
+
+
+def test_offload_param_requires_stage3():
+    with pytest.raises(ConfigError):
+        Config.load({"zero_optimization": {
+            "stage": 2, "offload_param": {"device": "cpu"}}})
+
+
+def test_fp16_bf16_conflict_resolves():
+    cfg = Config.load({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+    assert cfg.fp16.enabled and not cfg.bf16.enabled
+
+
+def test_optimizer_type_alias():
+    cfg = Config.load({"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    assert cfg.optimizer.name == "AdamW"
+    assert cfg.optimizer.params["lr"] == 1e-3
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(ConfigError):
+        Config.load({"optimizer": {"type": "nope"}})
+
+
+def test_compute_dtype():
+    import jax.numpy as jnp
+    assert Config.load({}).compute_dtype == jnp.bfloat16
+    assert Config.load({"fp16": {"enabled": True}, "bf16": {"enabled": False}}).compute_dtype == jnp.float16
+    assert Config.load({"bf16": {"enabled": False}}).compute_dtype == jnp.float32
+
+
+def test_roundtrip_to_dict():
+    cfg = Config.load({"zero_optimization": {"stage": 2}})
+    d = cfg.to_dict()
+    assert d["zero_optimization"]["stage"] == 2
+    cfg2 = Config.load(d)
+    assert cfg2.zero_optimization.stage == 2

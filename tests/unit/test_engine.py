@@ -1,0 +1,260 @@
+"""End-to-end engine tests on the 8-device CPU mesh.
+
+Reference coverage model: tests/unit/runtime/zero/test_zero.py (stage parity,
+world sizes), test_fp16.py (loss scaling), tests/unit/checkpoint (save/resume
+parity incl. different world layout — here: different mesh/zero stage).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import Config
+from deepspeed_tpu.models import TransformerConfig, make_model
+from tests.conftest import make_batch
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dtype=jnp.float32, attention_impl="xla")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def ds_config(**overrides):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "steps_per_print": 100,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def fixed_batch(n=16, s=32, vocab=64, seed=0):
+    return make_batch(n, s, vocab=vocab, seed=seed)
+
+
+def train_losses(config, steps=12, model=None, seed=0):
+    model = model or make_model(tiny_cfg())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    batch = fixed_batch(n=config.get("train_batch_size", 16), seed=seed)
+    losses = []
+    for _ in range(steps):
+        metrics = engine.train_batch(batch)
+        losses.append(float(metrics["loss"]))
+    return losses, engine
+
+
+class TestTraining:
+    def test_loss_decreases(self):
+        losses, _ = train_losses(ds_config(), steps=15)
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_bf16_trains(self):
+        model = make_model(tiny_cfg(dtype=jnp.bfloat16))
+        losses, engine = train_losses(
+            ds_config(bf16={"enabled": True}), steps=15, model=model)
+        assert losses[-1] < losses[0] * 0.9
+        # params stored in bf16, master in fp32
+        assert engine.state["params"]["tok_embed"].dtype == jnp.bfloat16
+        assert engine.state["opt"]["master"]["tok_embed"].dtype == jnp.float32
+
+    def test_grad_accumulation_equivalence(self):
+        """gas=4 over the same data must match gas=1 (reference: grad-accum
+        boundary semantics)."""
+        l1, e1 = train_losses(
+            ds_config(train_batch_size=32, gradient_accumulation_steps=1), steps=6)
+        l4, e4 = train_losses(
+            ds_config(train_batch_size=32, gradient_accumulation_steps=4), steps=6)
+        p1 = jax.tree.leaves(e1.state["params"])
+        p4 = jax.tree.leaves(e4.state["params"])
+        np.testing.assert_allclose(l1, l4, rtol=1e-4)
+        for a, b in zip(p1, p4):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_gradient_clipping_runs(self):
+        losses, _ = train_losses(ds_config(gradient_clipping=0.5), steps=5)
+        assert all(np.isfinite(l) for l in losses)
+
+    def test_scheduler_warmup(self):
+        cfg = ds_config(scheduler={"type": "WarmupLR", "params": {
+            "warmup_min_lr": 0.0, "warmup_max_lr": 1e-2, "warmup_num_steps": 10}})
+        losses, engine = train_losses(cfg, steps=5)
+        lr = engine.get_lr()
+        assert 0 < lr < 1e-2  # still warming
+
+    def test_eval_batch(self):
+        _, engine = train_losses(ds_config(), steps=2)
+        loss = engine.eval_batch(fixed_batch())
+        assert np.isfinite(float(loss))
+
+
+class TestZeroStages:
+    @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+    def test_stage_parity(self, stage):
+        """All ZeRO stages are rearrangements of the same math — identical
+        losses (reference: test_zero.py parity across stages)."""
+        baseline, _ = train_losses(ds_config(), steps=6)
+        staged, engine = train_losses(
+            ds_config(zero_optimization={
+                "stage": stage, "stage3_param_persistence_threshold": 0}), steps=6)
+        np.testing.assert_allclose(baseline, staged, rtol=2e-4, atol=1e-5)
+        if stage >= 1:
+            # optimizer state must actually be sharded over dp
+            master = engine.state["opt"]["exp_avg"]["layers"]["wq"]
+            axis = "fsdp" if stage >= 3 else "data"
+            specs = [s for s in master.sharding.spec if s is not None]
+            flat = [a for s in specs for a in (s if isinstance(s, tuple) else (s,))]
+            assert axis in flat, f"stage {stage}: {master.sharding}"
+
+    def test_stage3_params_sharded(self):
+        _, engine = train_losses(
+            ds_config(zero_optimization={
+                "stage": 3, "stage3_param_persistence_threshold": 4096}), steps=2)
+        w = engine.state["params"]["layers"]["w_in"]
+        flat = [a for s in w.sharding.spec if s is not None
+                for a in (s if isinstance(s, tuple) else (s,))]
+        assert "fsdp" in flat
+        # small params stay replicated (persistence threshold)
+        norm = engine.state["params"]["final_norm_scale"]
+        assert norm.sharding.is_fully_replicated
+
+    def test_stage3_persistence_threshold_zero(self):
+        cfg = ds_config(zero_optimization={
+            "stage": 3, "stage3_param_persistence_threshold": 0})
+        losses, _ = train_losses(cfg, steps=3)
+        assert all(np.isfinite(l) for l in losses)
+
+
+class TestFP16:
+    def test_fp16_dynamic_scaling_trains(self):
+        model = make_model(tiny_cfg(dtype=jnp.float16))
+        cfg = ds_config(fp16={"enabled": True, "initial_scale_power": 8},
+                        bf16={"enabled": False})
+        losses, engine = train_losses(cfg, steps=15, model=model)
+        assert losses[-1] < losses[0]
+        assert engine.get_loss_scale() >= 1.0
+
+    def test_overflow_skips_step(self):
+        """Inject an inf grad via a huge loss scale; params must not change."""
+        model = make_model(tiny_cfg(dtype=jnp.float16))
+        cfg = ds_config(fp16={"enabled": True, "initial_scale_power": 24,
+                              "loss_scale_window": 1000},
+                        bf16={"enabled": False})
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        before_scale = engine.get_loss_scale()
+        batch = fixed_batch()
+        for _ in range(3):
+            engine.train_batch(batch)
+        # fp16 max ~65504; scale 2^24 on a ~4.x loss overflows the scaled grads
+        after_scale = engine.get_loss_scale()
+        assert after_scale <= before_scale  # shrank (or stayed if no overflow)
+
+
+class TestThreeCallAPI:
+    def test_forward_backward_step(self):
+        """The reference's engine.forward/backward/step loop."""
+        model = make_model(tiny_cfg())
+        cfg = ds_config(train_batch_size=16, gradient_accumulation_steps=2,
+                        train_micro_batch_size_per_gpu=1)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        batch = fixed_batch(n=8)
+        losses = []
+        for it in range(4):
+            loss = engine.forward(batch)
+            engine.backward(loss)
+            result = engine.step()
+            if engine.is_gradient_accumulation_boundary() or result is not None:
+                pass
+            losses.append(float(loss))
+        assert engine.global_steps == 2  # 4 micro / gas=2
+        assert all(np.isfinite(l) for l in losses)
+
+
+class TestCheckpoint:
+    def test_save_load_parity(self, tmp_path):
+        cfg = ds_config()
+        losses, engine = train_losses(cfg, steps=4)
+        engine.save_checkpoint(str(tmp_path), tag="ck")
+        # continue 3 more steps -> record
+        batch = fixed_batch()
+        cont = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+
+        model = make_model(tiny_cfg())
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        engine2.load_checkpoint(str(tmp_path), tag="ck")
+        assert engine2.global_steps == 4
+        resumed = [float(engine2.train_batch(batch)["loss"]) for _ in range(3)]
+        np.testing.assert_allclose(cont, resumed, rtol=2e-4, atol=1e-5)
+
+    def test_latest_tag(self, tmp_path):
+        _, engine = train_losses(ds_config(), steps=2)
+        engine.save_checkpoint(str(tmp_path))
+        assert os.path.exists(tmp_path / "latest")
+        model = make_model(tiny_cfg())
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config())
+        engine2.load_checkpoint(str(tmp_path))  # resolves via latest
+        assert engine2.global_steps == 2
+
+    def test_elastic_restore_across_zero_stage(self, tmp_path):
+        """Save under stage 0 (replicated), restore under stage 3 (sharded) —
+        the universal-checkpoint property (reference: elastic_checkpoint +
+        checkpoint/universal_checkpoint.py, here by construction)."""
+        _, engine = train_losses(ds_config(), steps=3)
+        engine.save_checkpoint(str(tmp_path), tag="x")
+        ref = [float(engine.train_batch(fixed_batch())["loss"]) for _ in range(2)]
+
+        model = make_model(tiny_cfg())
+        cfg3 = ds_config(zero_optimization={"stage": 3})
+        engine3, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg3)
+        engine3.load_checkpoint(str(tmp_path), tag="x")
+        got = [float(engine3.train_batch(fixed_batch())["loss"]) for _ in range(2)]
+        np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+    def test_save_16bit_model(self, tmp_path):
+        _, engine = train_losses(ds_config(), steps=1)
+        path = engine.save_16bit_model(str(tmp_path))
+        assert os.path.exists(path)
+
+
+class TestOptaxInterop:
+    def test_optax_optimizer_drop_in(self):
+        optax = pytest.importorskip("optax")
+        model = make_model(tiny_cfg())
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, optimizer=optax.adamw(1e-2), config=ds_config())
+        batch = fixed_batch()
+        losses = [float(engine.train_batch(batch)["loss"]) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_optax_with_zero1_sharding(self):
+        optax = pytest.importorskip("optax")
+        model = make_model(tiny_cfg())
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, optimizer=optax.sgd(1e-2),
+            config=ds_config(zero_optimization={"stage": 1}))
+        m = engine.train_batch(fixed_batch())
+        assert np.isfinite(float(m["loss"]))
+
+
+def test_save_load_16bit_roundtrip(tmp_path):
+    from deepspeed_tpu.runtime.engine import load_16bit_model
+    model = make_model(tiny_cfg(dtype=jnp.bfloat16))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=ds_config(bf16={"enabled": True}))
+    path = engine.save_16bit_model(str(tmp_path))
+    data = load_16bit_model(path)
+    key = "tok_embed"
+    assert key in data
+    assert "bfloat16" in str(data[key].dtype)
+    np.testing.assert_array_equal(
+        data[key].view(np.uint16),
+        np.asarray(engine.state["params"]["tok_embed"]).view(np.uint16))

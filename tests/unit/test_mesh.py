@@ -1,0 +1,66 @@
+"""Mesh planner + topology tests (reference: tests/unit/runtime/pipe topology
+tests + utils/groups semantics)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config import Config
+from deepspeed_tpu.parallel import MeshPlan, Topology, build_mesh, plan_from_config
+
+
+def test_plan_pure_dp():
+    cfg = Config.load({})
+    plan = plan_from_config(cfg, 8)
+    assert plan.data == 8 and plan.fsdp == 1
+    assert plan.dp_world_size == 8
+
+
+def test_plan_zero3_uses_fsdp():
+    cfg = Config.load({"zero_optimization": {"stage": 3}})
+    plan = plan_from_config(cfg, 8)
+    assert plan.fsdp == 8 and plan.data == 1
+
+
+def test_plan_tp():
+    cfg = Config.load({"tensor_parallel": {"size": 2}})
+    plan = plan_from_config(cfg, 8)
+    assert plan.tensor == 2 and plan.data == 4
+
+
+def test_plan_pp_tp():
+    cfg = Config.load({"tensor_parallel": {"size": 2}, "pipeline": {"stages": 2}})
+    plan = plan_from_config(cfg, 8)
+    assert plan.pipe == 2 and plan.tensor == 2 and plan.data == 2
+
+
+def test_plan_explicit_mesh():
+    cfg = Config.load({"mesh": {"axes": {"data": 2, "tensor": 4}}})
+    plan = plan_from_config(cfg, 8)
+    assert plan.data == 2 and plan.tensor == 4
+
+
+def test_plan_indivisible_raises():
+    cfg = Config.load({"tensor_parallel": {"size": 3}})
+    with pytest.raises(ValueError):
+        plan_from_config(cfg, 8)
+
+
+def test_build_mesh(devices8):
+    plan = MeshPlan(data=4, tensor=2)
+    mesh = build_mesh(plan)
+    assert mesh.shape["data"] == 4
+    assert mesh.shape["tensor"] == 2
+    assert mesh.shape["pipe"] == 1
+
+
+def test_topology_grid():
+    topo = Topology(MeshPlan(pipe=2, data=2, tensor=2))
+    assert topo.world_size() == 8
+    # rank layout: pipe-major (AXIS_ORDER)
+    assert topo.get_rank(pipe=0, data=0, tensor=0) == 0
+    assert topo.get_rank(pipe=1, data=0, tensor=0) == 4
+    coord = topo.get_coord(5)
+    assert coord["pipe"] == 1
+    lists = topo.get_axis_comm_lists("tensor")
+    assert [0, 1] in lists
+    assert topo.filter_match(pipe=1) == [4, 5, 6, 7]

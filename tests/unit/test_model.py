@@ -1,0 +1,110 @@
+"""Model zoo tests: shapes, loss sanity, determinism, GQA/rotary variants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import (
+    TransformerConfig, make_model, gpt2_config, llama_config, logical_axes)
+from tests.conftest import make_batch
+
+
+def test_forward_shapes(tiny_model, rng):
+    params = tiny_model.init(rng)
+    batch = make_batch(2, 16)
+    logits = tiny_model.apply(params, jnp.asarray(batch["input_ids"]))
+    assert logits.shape == (2, 16, 256)
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_finite_and_near_uniform_init(tiny_model, rng):
+    params = tiny_model.init(rng)
+    batch = make_batch(4, 32)
+    loss = tiny_model.loss_fn(params, batch, None, True)
+    assert np.isfinite(float(loss))
+    # at init, loss should be near ln(vocab)
+    assert abs(float(loss) - np.log(256)) < 1.0
+
+
+def test_causality(tiny_model, rng):
+    """Changing a future token must not affect earlier logits."""
+    params = tiny_model.init(rng)
+    ids = jnp.asarray(make_batch(1, 16)["input_ids"])
+    logits1 = tiny_model.apply(params, ids)
+    ids2 = ids.at[0, 10].set((ids[0, 10] + 7) % 256)
+    logits2 = tiny_model.apply(params, ids2)
+    np.testing.assert_allclose(np.asarray(logits1[0, :10]),
+                               np.asarray(logits2[0, :10]), atol=1e-5)
+    assert not np.allclose(np.asarray(logits1[0, 10:]), np.asarray(logits2[0, 10:]))
+
+
+def test_gqa_rotary_rmsnorm(rng):
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, num_kv_heads=2, position_type="rotary",
+                            activation="silu_glu", norm_type="rmsnorm",
+                            tie_embeddings=False, dtype=jnp.float32,
+                            attention_impl="xla", max_seq_len=64)
+    model = make_model(cfg)
+    params = model.init(rng)
+    assert "lm_head" in params
+    assert "w_gate" in params["layers"]
+    assert "bq" not in params["layers"]
+    logits = model.apply(params, jnp.asarray(make_batch(2, 16, vocab=128)["input_ids"]))
+    assert logits.shape == (2, 16, 128)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_logical_axes_structure_matches_params(rng):
+    for cfg in [gpt2_config("125m", num_layers=2, hidden_size=64, num_heads=4,
+                            vocab_size=128, dtype=jnp.float32),
+                llama_config("tiny", dtype=jnp.float32)]:
+        model = make_model(cfg)
+        params = jax.eval_shape(model.init, rng)
+        axes = model.logical_axes
+        assert jax.tree.structure(jax.tree.map(lambda x: 0, params)) == \
+            jax.tree.structure(jax.tree.map(lambda x: 0, axes,
+                                            is_leaf=lambda x: x is None or isinstance(x, tuple)))
+        # every axes tuple rank must match the param rank
+        flat_p = jax.tree.leaves_with_path(params)
+        axes_map = {jax.tree_util.keystr(k): v for k, v in
+                    jax.tree.leaves_with_path(axes, is_leaf=lambda x: x is None or isinstance(x, tuple))}
+        for path, leaf in flat_p:
+            a = axes_map[jax.tree_util.keystr(path)]
+            assert a is None or len(a) == len(leaf.shape), f"{path}: {a} vs {leaf.shape}"
+
+
+def test_scan_vs_unrolled(rng):
+    kw = dict(vocab_size=128, hidden_size=64, num_layers=3, num_heads=4,
+              dtype=jnp.float32, attention_impl="xla", max_seq_len=64)
+    m_scan = make_model(TransformerConfig(scan_layers=True, **kw))
+    m_unroll = make_model(TransformerConfig(scan_layers=False, **kw))
+    params = m_scan.init(rng)
+    ids = jnp.asarray(make_batch(2, 16, vocab=128)["input_ids"])
+    np.testing.assert_allclose(np.asarray(m_scan.apply(params, ids)),
+                               np.asarray(m_unroll.apply(params, ids)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_remat_matches(rng):
+    kw = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+              dtype=jnp.float32, attention_impl="xla", max_seq_len=64)
+    m = make_model(TransformerConfig(**kw))
+    m_remat = make_model(TransformerConfig(remat=True, remat_policy="dots_saveable", **kw))
+    params = m.init(rng)
+    batch = make_batch(2, 16, vocab=128)
+    g1 = jax.grad(lambda p: m.loss_fn(p, batch, None, True))(params)
+    g2 = jax.grad(lambda p: m_remat.loss_fn(p, batch, None, True))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_grad_flows_to_all_params(tiny_model, rng):
+    params = tiny_model.init(rng)
+    batch = make_batch(2, 16)
+    grads = jax.grad(lambda p: tiny_model.loss_fn(p, batch, None, True))(params)
+    for path, g in jax.tree.leaves_with_path(grads):
+        assert np.isfinite(np.asarray(g)).all(), path
+        # pos_embed rows beyond seq_len legitimately have zero grad
+        if "pos_embed" not in str(path):
+            assert np.abs(np.asarray(g)).sum() > 0, f"zero grad at {path}"

@@ -1,0 +1,164 @@
+"""Optimizer numerical parity vs torch.optim (reference:
+tests/unit/ops/adam/test_cpu_adam.py compares against torch.optim.AdamW)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import adam, adamw, lamb, sgd, adagrad, lion, onebit_adam
+
+
+def _tree(seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(r.normal(size=(16, 8)), jnp.float32),
+        "b": jnp.asarray(r.normal(size=(8,)), jnp.float32),
+    }
+
+
+def _grads(seed=1):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(r.normal(size=(16, 8)), jnp.float32),
+        "b": jnp.asarray(r.normal(size=(8,)), jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("adam_w_mode", [False, True])
+def test_adam_matches_torch(adam_w_mode):
+    torch = pytest.importorskip("torch")
+    params = _tree()
+    grads = _grads()
+    lr, wd = 1e-2, 0.1
+    opt = adam(lr=lr, weight_decay=wd, adam_w_mode=adam_w_mode,
+               use_master_weights=False)
+    state = opt.init(params)
+
+    tparams = {k: torch.tensor(np.asarray(v), requires_grad=True)
+               for k, v in params.items()}
+    topt_cls = torch.optim.AdamW if adam_w_mode else torch.optim.Adam
+    topt = topt_cls(list(tparams.values()), lr=lr, weight_decay=wd)
+
+    for step in range(5):
+        params, state = opt.update(grads, state, params)
+        for k, t in tparams.items():
+            t.grad = torch.tensor(np.asarray(grads[k]))
+        topt.step()
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(params[k]), tparams[k].detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_sgd_momentum_matches_torch():
+    torch = pytest.importorskip("torch")
+    params, grads = _tree(), _grads()
+    opt = sgd(lr=0.1, momentum=0.9, use_master_weights=False)
+    state = opt.init(params)
+    tparams = {k: torch.tensor(np.asarray(v), requires_grad=True) for k, v in params.items()}
+    topt = torch.optim.SGD(list(tparams.values()), lr=0.1, momentum=0.9)
+    for _ in range(3):
+        params, state = opt.update(grads, state, params)
+        for k, t in tparams.items():
+            t.grad = torch.tensor(np.asarray(grads[k]))
+        topt.step()
+    for k in params:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   tparams[k].detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_matches_torch():
+    torch = pytest.importorskip("torch")
+    params, grads = _tree(), _grads()
+    opt = adagrad(lr=0.05, use_master_weights=False)
+    state = opt.init(params)
+    tparams = {k: torch.tensor(np.asarray(v), requires_grad=True) for k, v in params.items()}
+    topt = torch.optim.Adagrad(list(tparams.values()), lr=0.05, eps=1e-10)
+    for _ in range(3):
+        params, state = opt.update(grads, state, params)
+        for k, t in tparams.items():
+            t.grad = torch.tensor(np.asarray(grads[k]))
+        topt.step()
+    for k in params:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   tparams[k].detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_master_weights_bf16():
+    """bf16 params with fp32 master should track fp32 training closely."""
+    params32, grads = _tree(), _grads()
+    params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params32)
+    opt16 = adam(lr=1e-2, use_master_weights=True)
+    opt32 = adam(lr=1e-2, use_master_weights=False)
+    s16, s32 = opt16.init(params32), opt32.init(params32)
+    # master initialized from fp32 originals
+    p16, p32 = params16, params32
+    g16 = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
+    for _ in range(10):
+        p16, s16 = opt16.update(g16, s16, p16)
+        p32, s32 = opt32.update(grads, s32, p32)
+    for k in p32:
+        master = s16["master"][k]
+        np.testing.assert_allclose(np.asarray(master), np.asarray(p32[k]),
+                                   rtol=5e-2, atol=5e-3)
+
+
+def test_lamb_trust_ratio_bounds():
+    params, grads = _tree(), _grads()
+    opt = lamb(lr=1e-2, use_master_weights=False)
+    state = opt.init(params)
+    new_params, _ = opt.update(grads, state, params)
+    delta = np.abs(np.asarray(new_params["w"]) - np.asarray(params["w"]))
+    assert delta.max() > 0
+
+
+def test_lion_sign_update():
+    params, grads = _tree(), _grads()
+    opt = lion(lr=1e-2, use_master_weights=False)
+    state = opt.init(params)
+    new_params, _ = opt.update(grads, state, params)
+    delta = np.asarray(params["w"]) - np.asarray(new_params["w"])
+    # first step: update = sign((1-b1)*g) * lr
+    np.testing.assert_allclose(np.abs(delta), 1e-2, rtol=1e-4)
+
+
+def test_onebit_adam_warmup_matches_adam():
+    params, grads = _tree(), _grads()
+    ob = onebit_adam(lr=1e-2, freeze_step=100, use_master_weights=False)
+    ad = adam(lr=1e-2, use_master_weights=False)
+    s1, s2 = ob.init(params), ad.init(params)
+    p1 = p2 = params
+    for _ in range(3):  # inside warmup
+        p1, s1 = ob.update(grads, s1, p1)
+        p2, s2 = ad.update(grads, s2, p2)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_onebit_adam_compressed_stage_converges():
+    """After freeze_step, optimization should still reduce a quadratic loss."""
+    target = jnp.ones((8, 8))
+    params = {"w": jnp.zeros((8, 8))}
+    opt = onebit_adam(lr=0.05, freeze_step=5, use_master_weights=False)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((p["w"] - target) ** 2)
+
+    losses = []
+    for _ in range(60):
+        g = jax.grad(loss_fn)(params)
+        params, state = opt.update(g, state, params)
+        losses.append(float(loss_fn(params)))
+    assert losses[-1] < 0.1 * losses[0]
+
+
+def test_lr_schedule_callable():
+    params, grads = _tree(), _grads()
+    sched = lambda step: 0.1 / step.astype(jnp.float32)
+    opt = sgd(lr=sched, use_master_weights=False)
+    state = opt.init(params)
+    p1, state = opt.update(grads, state, params)
+    d1 = np.asarray(params["w"] - p1["w"])
+    np.testing.assert_allclose(d1, 0.1 * np.asarray(grads["w"]), rtol=1e-3, atol=1e-7)
