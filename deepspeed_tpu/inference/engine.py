@@ -59,6 +59,11 @@ class InferenceEngine:
             plan = MeshPlan(data=n_dev // tp, tensor=tp)
             mesh = build_mesh(plan)
         self.mesh = mesh
+        from deepspeed_tpu.parallel.context import set_parallel_context
+        from deepspeed_tpu.parallel import MeshPlan as _MP
+        self._plan = _MP(data=mesh.shape.get("data", 1),
+                         tensor=mesh.shape.get("tensor", 1))
+        set_parallel_context(mesh, self._plan)
         self.dtype = config.dtype or jnp.bfloat16
 
         # AutoTP equivalent: logical axes -> tensor-axis sharding
@@ -87,6 +92,8 @@ class InferenceEngine:
 
     def forward(self, input_ids):
         """Full-sequence logits (prefill path)."""
+        from deepspeed_tpu.parallel.context import set_parallel_context
+        set_parallel_context(self.mesh, self._plan)
         input_ids = jnp.asarray(input_ids)
         with self.mesh:
             return self._forward(self.params, input_ids)

@@ -1,4 +1,5 @@
 from deepspeed_tpu.models.transformer import (
     TransformerConfig, ModelSpec, make_model, gpt2_config, llama_config,
-    init_params, forward, lm_loss, cross_entropy_loss, logical_axes,
+    mixtral_config, init_params, forward, lm_loss, cross_entropy_loss,
+    logical_axes,
 )

@@ -1,0 +1,88 @@
+"""Pipelined model wrapper: transformer + compiled pipeline schedule.
+
+Reference: ``runtime/pipe/module.py`` expresses the model as a layer list and
+``runtime/pipe/engine.py`` drives it; here the same transformer ModelSpec is
+re-wired so its scanned layer stack executes under
+parallel/pipeline.pipeline_spmd (layers sharded over `pipe`, microbatches
+rotated by ppermute). Embedding/head run replicated over pipe under GSPMD
+(they are sharded over tensor/fsdp as usual) — the equivalent of the
+reference's tied first/last stages without the TiedLayerSpec allreduce
+machinery (GSPMD keeps tied weights consistent by construction).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.parallel.pipeline import pipeline_spmd
+from deepspeed_tpu.utils.logging import logger
+
+
+def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
+                         num_microbatches: int, name: str = "pipelined",
+                         pipe_axis: str = "pipe") -> T.ModelSpec:
+    n_stages = mesh.shape[pipe_axis]
+    if cfg.num_layers % n_stages:
+        raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
+                         f"pipeline stages={n_stages}")
+
+    if cfg.num_experts > 1:
+        raise NotImplementedError("MoE layers inside the pipelined stack are "
+                                  "not supported yet (use pp=1 with EP)")
+    if cfg.dropout_rate > 0:
+        raise NotImplementedError("dropout inside the pipelined stack is not "
+                                  "supported yet (set dropout_rate=0)")
+
+    def stage_fn(stage_layers, x):
+        def body(carry, layer_p):
+            y, _aux = T.transformer_layer(carry, layer_p, cfg, deterministic=True)
+            return y, None
+        x, _ = jax.lax.scan(body, x, stage_layers)
+        return x
+
+    pipe_fn = pipeline_spmd(stage_fn, mesh, num_microbatches=num_microbatches,
+                            pipe_axis=pipe_axis,
+                            remat_stage=cfg.remat or cfg.remat_policy not in ("none", None))
+
+    def forward(params, input_ids, **kw):
+        B, S = input_ids.shape
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        x = params["tok_embed"][input_ids].astype(cfg.dtype)
+        if cfg.position_type == "learned":
+            x = x + params["pos_embed"][jnp.arange(S)][None].astype(cfg.dtype)
+        x_mb = x.reshape(M, B // M, S, -1)
+        y_mb = pipe_fn(params["layers"], x_mb)
+        y = y_mb.reshape(B, S, -1)
+        y = T._norm(y, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["tok_embed"].T
+        return (y @ head.astype(y.dtype)).astype(jnp.float32)
+
+    def loss_fn(params, batch, rng=None, deterministic=True):
+        if batch.get("attention_mask") is not None:
+            raise NotImplementedError("attention_mask is not supported in "
+                                      "pipeline mode yet (causal only)")
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
+        logits = forward(params, ids)
+        return T.cross_entropy_loss(logits, labels)
+
+    return T.ModelSpec(
+        init=lambda key: T.init_params(key, cfg),
+        loss_fn=loss_fn,
+        apply=forward,
+        logical_axes=T.logical_axes(cfg),
+        config=cfg,
+        name=name,
+    )

@@ -49,6 +49,16 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16                   # activation/compute dtype
     param_dtype: Any = jnp.float32              # storage dtype (engine may cast)
     attention_impl: str = "auto"                # auto | pallas | xla
+    # MoE (reference: deepspeed/moe/*; config keys from MoEConfig)
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: str = None               # None | Jitter | RSample
+    drop_tokens: bool = True
+    use_residual: bool = False                  # PR-MoE
+    moe_aux_loss_weight: float = 0.01
     remat: bool = False
     remat_policy: str = "none"                  # none|dots_saveable|save_nothing
     scan_layers: bool = True
@@ -107,6 +117,23 @@ def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def mixtral_config(size: str = "8x7b", **overrides) -> TransformerConfig:
+    """Mixtral-style MoE (top-2, 8 experts) — the BASELINE.json MoE config."""
+    dims = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=4, num_kv_heads=2,
+                     intermediate_size=512, vocab_size=32000, max_seq_len=2048,
+                     num_experts=4),
+        "8x7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                     num_kv_heads=8, intermediate_size=14336, vocab_size=32000,
+                     max_seq_len=4096, num_experts=8),
+    }[size]
+    base = dict(position_type="rotary", activation="silu_glu",
+                norm_type="rmsnorm", tie_embeddings=False, top_k=2)
+    base.update(dims)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 # --------------------------------------------------------------------------
 # init
 # --------------------------------------------------------------------------
@@ -122,7 +149,7 @@ def init_params(key, cfg: TransformerConfig) -> Params:
         return (jax.random.normal(key, shape) * scale).astype(dt)
 
     # per-layer params, stacked on a leading L dim
-    lkeys = jax.random.split(next(k), 8)
+    lkeys = jax.random.split(next(k), 12)
 
     def stacked(key, shape, scale=std):
         return (jax.random.normal(key, (L,) + shape) * scale).astype(dt)
@@ -138,7 +165,19 @@ def init_params(key, cfg: TransformerConfig) -> Params:
         "w_in": stacked(lkeys[4], (H, F)),
         "w_out": stacked(lkeys[5], (F, H), scale=out_scale),
     }
-    if "glu" in cfg.activation:
+    if cfg.num_experts > 1:
+        E = cfg.num_experts
+        layers["wg"] = stacked(lkeys[7], (H, E))
+        layers["moe_w_in"] = (jax.random.normal(lkeys[8], (L, E, H, F)) * std).astype(dt)
+        layers["moe_w_out"] = (jax.random.normal(lkeys[9], (L, E, F, H)) * out_scale).astype(dt)
+        if "glu" in cfg.activation:
+            layers["moe_w_gate"] = (jax.random.normal(lkeys[10], (L, E, H, F)) * std).astype(dt)
+        if not cfg.use_residual:
+            # experts REPLACE the dense MLP; PR-MoE keeps both
+            del layers["w_in"], layers["w_out"]
+        else:
+            layers["moe_coef"] = jnp.zeros((L, H, 2), dt)
+    if "glu" in cfg.activation and "w_in" in layers:
         layers["w_gate"] = stacked(lkeys[6], (H, F))
     if cfg.norm_type == "layernorm":
         layers["ln1_bias"] = jnp.zeros((L, H), dt)
@@ -147,8 +186,9 @@ def init_params(key, cfg: TransformerConfig) -> Params:
         layers["bk"] = jnp.zeros((L, nkv * hd), dt)
         layers["bv"] = jnp.zeros((L, nkv * hd), dt)
         layers["bo"] = jnp.zeros((L, H), dt)
-        layers["b_in"] = jnp.zeros((L, F), dt)
-        layers["b_out"] = jnp.zeros((L, H), dt)
+        if "w_in" in layers:
+            layers["b_in"] = jnp.zeros((L, F), dt)
+            layers["b_out"] = jnp.zeros((L, H), dt)
 
     params: Params = {
         "tok_embed": normal(next(k), (cfg.vocab_size, H)),
@@ -176,15 +216,27 @@ def logical_axes(cfg: TransformerConfig) -> Params:
         "w_in": ("layers", "embed", "mlp"),
         "w_out": ("layers", "mlp", "embed"),
     }
-    if "glu" in cfg.activation:
+    if cfg.num_experts > 1:
+        layers["wg"] = ("layers", "embed", None)
+        layers["moe_w_in"] = ("layers", "expert", "embed", "mlp")
+        layers["moe_w_out"] = ("layers", "expert", "mlp", "embed")
+        if "glu" in cfg.activation:
+            layers["moe_w_gate"] = ("layers", "expert", "embed", "mlp")
+        if not cfg.use_residual:
+            del layers["w_in"], layers["w_out"]
+        else:
+            layers["moe_coef"] = ("layers", "embed", None)
+    if "glu" in cfg.activation and "w_in" in layers:
         layers["w_gate"] = ("layers", "embed", "mlp")
     if cfg.norm_type == "layernorm":
         layers.update({
             "ln1_bias": ("layers", "unmodeled"), "ln2_bias": ("layers", "unmodeled"),
             "bq": ("layers", "qkv"), "bk": ("layers", "qkv"), "bv": ("layers", "qkv"),
-            "bo": ("layers", "unmodeled"), "b_in": ("layers", "mlp"),
-            "b_out": ("layers", "unmodeled"),
+            "bo": ("layers", "unmodeled"),
         })
+        if "w_in" in layers:
+            layers["b_in"] = ("layers", "mlp")
+            layers["b_out"] = ("layers", "unmodeled")
     axes: Params = {
         "tok_embed": ("vocab", "embed"),
         "layers": layers,
@@ -253,6 +305,12 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         rep = Nq // Nkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    # sequence parallelism: ring attention over the seq mesh axis
+    from deepspeed_tpu.parallel.context import seq_parallel_degree, current_mesh
+    if seq_parallel_degree() > 1 and mask is None and segment_ids is None:
+        from deepspeed_tpu.ops.ring_attention import ring_attention
+        return ring_attention(q, k, v, current_mesh(), causal=causal,
+                              sm_scale=1.0 / math.sqrt(D))
     if _use_pallas(cfg, S) and mask is None and segment_ids is None:
         from deepspeed_tpu.ops.flash_attention import flash_attention as fa
         return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D))
@@ -303,16 +361,40 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     x = x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
 
     h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
-    up = h @ p["w_in"].astype(h.dtype)
-    if "b_in" in p:
-        up = up + p["b_in"].astype(h.dtype)
-    gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
-    act = _activation(up, gate, cfg)
-    out = act @ p["w_out"].astype(h.dtype)
-    if "b_out" in p:
-        out = out + p["b_out"].astype(h.dtype)
+    aux = jnp.float32(0.0)
+    if "wg" in p:  # MoE layer (reference: deepspeed/moe/layer.py MoE)
+        from deepspeed_tpu.moe.sharded_moe import moe_ffn
+        moe_params = {"wg": p["wg"], "w_in": p["moe_w_in"],
+                      "w_out": p["moe_w_out"]}
+        if "moe_w_gate" in p:
+            moe_params["w_gate"] = p["moe_w_gate"]
+        moe_out, aux = moe_ffn(moe_params, h, cfg, rng=dropout_rng,
+                               train=not deterministic)
+        if "w_in" in p:  # PR-MoE residual (reference: layer.py use_residual)
+            up = h @ p["w_in"].astype(h.dtype)
+            if "b_in" in p:
+                up = up + p["b_in"].astype(h.dtype)
+            gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
+            dense_out = _activation(up, gate, cfg) @ p["w_out"].astype(h.dtype)
+            if "b_out" in p:
+                dense_out = dense_out + p["b_out"].astype(h.dtype)
+            coef = jax.nn.softmax(
+                (h @ p["moe_coef"].astype(h.dtype)).astype(jnp.float32), axis=-1)
+            out = dense_out * coef[..., 0:1].astype(h.dtype) + \
+                moe_out * coef[..., 1:2].astype(h.dtype)
+        else:
+            out = moe_out
+    else:
+        up = h @ p["w_in"].astype(h.dtype)
+        if "b_in" in p:
+            up = up + p["b_in"].astype(h.dtype)
+        gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
+        act = _activation(up, gate, cfg)
+        out = act @ p["w_out"].astype(h.dtype)
+        if "b_out" in p:
+            out = out + p["b_out"].astype(h.dtype)
     x = x + _dropout(out, cfg, dropout_rng, deterministic, 1)
-    return x
+    return x, aux
 
 
 def _dropout(x, cfg, rng, deterministic, salt: int):
@@ -346,7 +428,8 @@ def _remat_policy(cfg: TransformerConfig):
 
 def forward(params: Params, input_ids, cfg: TransformerConfig, *,
             attention_mask=None, positions=None, dropout_rng=None,
-            deterministic: bool = True, layer_override=None):
+            deterministic: bool = True, layer_override=None,
+            return_aux: bool = False):
     """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32)."""
     B, S = input_ids.shape
     x = params["tok_embed"][input_ids].astype(cfg.dtype)
@@ -357,35 +440,38 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     layers = layer_override if layer_override is not None else params["layers"]
 
     def body(carry, layer_p):
-        rng = carry[1]
+        x_c, rng, aux_acc = carry
         if rng is not None:
             rng, sub = jax.random.split(rng)
         else:
             sub = None
-        y = transformer_layer(carry[0], layer_p, cfg, mask=attention_mask,
-                              positions=positions, dropout_rng=sub,
-                              deterministic=deterministic)
-        return (y, rng), None
+        y, aux = transformer_layer(x_c, layer_p, cfg, mask=attention_mask,
+                                   positions=positions, dropout_rng=sub,
+                                   deterministic=deterministic)
+        return (y, rng, aux_acc + aux), None
 
     if cfg.remat or cfg.remat_policy not in ("none", None):
         policy = _remat_policy(cfg)
         body = jax.checkpoint(body, policy=policy, prevent_cse=False)
 
+    aux_total = jnp.float32(0.0)
     if cfg.scan_layers:
-        (x, _), _ = lax.scan(body, (x, dropout_rng), layers)
+        (x, _, aux_total), _ = lax.scan(body, (x, dropout_rng, aux_total), layers)
     else:
         n_layers = jax.tree.leaves(layers)[0].shape[0]
-        carry = (x, dropout_rng)
+        carry = (x, dropout_rng, aux_total)
         for i in range(n_layers):
             layer_p = jax.tree.map(lambda a: a[i], layers)
             carry, _ = body(carry, layer_p)
-        x = carry[0]
+        x, aux_total = carry[0], carry[2]
 
     x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["tok_embed"].T
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if return_aux:
+        return logits, aux_total
     return logits
 
 
@@ -411,9 +497,13 @@ def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
         labels = jnp.concatenate(
             [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
     mask = batch.get("attention_mask")
-    logits = forward(params, ids, cfg, attention_mask=mask,
-                     dropout_rng=dropout_rng, deterministic=deterministic)
-    return cross_entropy_loss(logits, labels)
+    logits, aux = forward(params, ids, cfg, attention_mask=mask,
+                          dropout_rng=dropout_rng, deterministic=deterministic,
+                          return_aux=True)
+    loss = cross_entropy_loss(logits, labels)
+    if cfg.num_experts > 1:
+        loss = loss + cfg.moe_aux_loss_weight * aux
+    return loss
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,1 @@
+from deepspeed_tpu.moe.sharded_moe import moe_ffn, top_k_gating

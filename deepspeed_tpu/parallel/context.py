@@ -1,0 +1,33 @@
+"""Ambient parallel context.
+
+The engine publishes its mesh/plan here so that model-internal ops (ring
+attention over the `seq` axis, MoE dispatch) can build shard_maps without
+threading the mesh through every model signature. Mirrors how the reference
+publishes process groups via the global ``deepspeed.utils.groups`` registry
+(``utils/groups.py``) rather than passing them explicitly.
+"""
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_MESH: Optional[Mesh] = None
+_PLAN = None
+
+
+def set_parallel_context(mesh: Mesh, plan) -> None:
+    global _MESH, _PLAN
+    _MESH = mesh
+    _PLAN = plan
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _MESH
+
+
+def current_plan():
+    return _PLAN
+
+
+def seq_parallel_degree() -> int:
+    return getattr(_PLAN, "seq", 1) if _PLAN is not None else 1

@@ -28,8 +28,7 @@ from deepspeed_tpu.utils.logging import logger
 
 # canonical axis order, outermost first — pipe outermost so that PP crosses
 # the slowest links (DCN) and tensor innermost so TP rides fastest ICI links.
-AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "tensor")
-EXPERT_AXIS = "expert"
+AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,22 +37,24 @@ class MeshPlan:
     pipe: int = 1
     data: int = 1
     fsdp: int = 1
+    expert: int = 1    # expert parallelism: carved out of the dp degree
     seq: int = 1
     tensor: int = 1
-    expert: int = 1     # must divide data*fsdp; realized by folding dp axes
 
     @property
     def world_size(self) -> int:
-        return self.pipe * self.data * self.fsdp * self.seq * self.tensor
+        return (self.pipe * self.data * self.fsdp * self.expert * self.seq
+                * self.tensor)
 
     @property
     def dp_world_size(self) -> int:
-        """Total data-parallel degree (how many model replicas' worth of batch)."""
-        return self.data * self.fsdp
+        """Total data-parallel degree: expert groups also consume distinct
+        data (= the reference's expert-data-parallel groups)."""
+        return self.data * self.fsdp * self.expert
 
     def axis_sizes(self) -> Dict[str, int]:
         return {"pipe": self.pipe, "data": self.data, "fsdp": self.fsdp,
-                "seq": self.seq, "tensor": self.tensor}
+                "expert": self.expert, "seq": self.seq, "tensor": self.tensor}
 
     def describe(self) -> str:
         return "x".join(f"{k}={v}" for k, v in self.axis_sizes().items() if v > 1) or "single"
@@ -69,11 +70,13 @@ def plan_from_config(config, world_size: int) -> MeshPlan:
     """
     explicit = dict(config.mesh.axes or {})
     if explicit:
+        ep_default = (config.moe.expert_parallel_size
+                      if config.moe.enabled else 1)
         plan = MeshPlan(
             pipe=explicit.get("pipe", 1), data=explicit.get("data", 1),
-            fsdp=explicit.get("fsdp", 1), seq=explicit.get("seq", 1),
-            tensor=explicit.get("tensor", 1),
-            expert=explicit.get("expert", config.moe.expert_parallel_size))
+            fsdp=explicit.get("fsdp", 1),
+            expert=explicit.get("expert", ep_default),
+            seq=explicit.get("seq", 1), tensor=explicit.get("tensor", 1))
         if plan.world_size != world_size:
             raise ValueError(f"mesh.axes product {plan.world_size} != world size {world_size}")
         return plan
@@ -85,15 +88,16 @@ def plan_from_config(config, world_size: int) -> MeshPlan:
     if world_size % denom != 0:
         raise ValueError(f"world size {world_size} not divisible by pipe({pp})*tensor({tp})*seq({sp})")
     dp = world_size // denom
+    ep = max(1, config.moe.expert_parallel_size) if config.moe.enabled else 1
+    if dp % ep != 0:
+        raise ValueError(f"expert_parallel_size {ep} must divide dp degree {dp}")
+    dp //= ep
     stage = config.zero_optimization.stage
     if stage >= 3:
         data, fsdp = 1, dp
     else:
         data, fsdp = dp, 1
-    ep = max(1, config.moe.expert_parallel_size) if config.moe.enabled else 1
-    if dp % ep != 0:
-        raise ValueError(f"expert_parallel_size {ep} must divide dp degree {dp}")
-    return MeshPlan(pipe=pp, data=data, fsdp=fsdp, seq=sp, tensor=tp, expert=ep)
+    return MeshPlan(pipe=pp, data=data, fsdp=fsdp, expert=ep, seq=sp, tensor=tp)
 
 
 def build_mesh(plan: MeshPlan, devices: Optional[List] = None) -> Mesh:

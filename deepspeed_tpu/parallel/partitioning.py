@@ -57,15 +57,18 @@ class ShardingRules:
 #   "unmodeled"— small params (biases, norms)
 #   "layers"   — scanned-layer stacking dim
 
-def make_rules(zero_stage: int, tp: bool = True, fsdp_axis: str = "fsdp",
-               tensor_axis: str = "tensor") -> ShardingRules:
-    """Build the rules table realizing a ZeRO stage + optional TP.
+def make_rules(zero_stage: int, tp: bool = True, pipe: bool = False,
+               fsdp_axis: str = "fsdp", tensor_axis: str = "tensor") -> ShardingRules:
+    """Build the rules table realizing a ZeRO stage + optional TP + PP.
 
     stage <= 2: params replicated across DP — logical axes map only to tensor.
     stage == 3: the largest logical dim additionally shards over `fsdp`
     (all-gather-on-use inserted by GSPMD = ZeRO-3 fetch/release).
+    pipe: the stacked `layers` dim shards over `pipe` (= the reference's
+    PipelineModule layer partitioning, as a sharding choice).
     """
     t = tensor_axis if tp else None
+    layers_axis = "pipe" if pipe else None
     if zero_stage >= 3:
         rules = (
             ("vocab", (fsdp_axis, t) if t else fsdp_axis),
@@ -75,7 +78,7 @@ def make_rules(zero_stage: int, tp: bool = True, fsdp_axis: str = "fsdp",
             ("qkv", t if t else fsdp_axis),
             ("kv", None),
             ("expert", "expert"),
-            ("layers", None),
+            ("layers", layers_axis),
             ("unmodeled", None),
         )
     else:
@@ -87,10 +90,9 @@ def make_rules(zero_stage: int, tp: bool = True, fsdp_axis: str = "fsdp",
             ("qkv", t),
             ("kv", None),
             ("expert", "expert"),
-            ("layers", None),
+            ("layers", layers_axis),
             ("unmodeled", None),
         )
-    # drop tensor-axis entries that are None targets
     return ShardingRules(rules=tuple((k, v) for k, v in rules))
 
 

@@ -90,6 +90,8 @@ class Engine:
         n_devices = len(devices) if devices is not None else jax.device_count()
         self.plan: MeshPlan = plan_from_config(config, n_devices)
         self.mesh: Mesh = mesh if mesh is not None else build_mesh(self.plan, devices)
+        from deepspeed_tpu.parallel.context import set_parallel_context
+        set_parallel_context(self.mesh, self.plan)
         config.resolve_batch_size(self.plan.dp_world_size)
         logger.info(zero_mod.describe(config.zero_optimization, self.plan))
         logger.info(f"batch: train={config.train_batch_size} "
@@ -97,9 +99,30 @@ class Engine:
                     f"gas={config.gradient_accumulation_steps} "
                     f"dp={self.plan.dp_world_size}")
 
+        # --- pipeline wrapping (reference: PipelineEngine construction)
+        self._pp_mode = self.plan.pipe > 1
+        if self._pp_mode and self.plan.seq > 1:
+            raise ValueError("pipe>1 with seq>1 is not supported: ring "
+                             "attention cannot nest inside the pipelined "
+                             "manual mesh region")
+        if self._pp_mode:
+            from deepspeed_tpu.models.transformer import TransformerConfig
+            from deepspeed_tpu.models.pipeline_wrapper import make_pipelined_model
+            if not isinstance(getattr(model, "config", None), TransformerConfig):
+                raise ValueError("pipeline parallelism requires a transformer "
+                                 "ModelSpec (stacked-layer params)")
+            model = make_pipelined_model(
+                model.config, self.mesh,
+                num_microbatches=config.gradient_accumulation_steps,
+                name=f"{model.name}-pp{self.plan.pipe}")
+            self.model = model
+            logger.info(f"pipeline mode: {self.plan.pipe} stages, "
+                        f"{config.gradient_accumulation_steps} microbatches")
+
         # --- sharding rules
         zero_cfg = config.zero_optimization
-        self.rules = make_rules(zero_cfg.stage, tp=self.plan.tensor > 1)
+        self.rules = make_rules(zero_cfg.stage, tp=self.plan.tensor > 1,
+                                pipe=self._pp_mode)
         laxes = model.logical_axes
         base_specs = spec_tree(laxes, self.rules)
         # shapes via eval_shape (no memory)
@@ -260,14 +283,18 @@ class Engine:
 
     # ------------------------------------------------------------------
     def _batch_spec(self):
-        axes = ("data", "fsdp")
-        return P(axes)
+        # expert groups consume distinct data (expert-data-parallelism);
+        # sequence dim shards over `seq` when sequence parallelism is on
+        if self.plan.seq > 1:
+            return P(("data", "fsdp", "expert"), "seq")
+        return P(("data", "fsdp", "expert"))
 
     def _compile_steps(self):
         cfg = self.config
-        gas = cfg.gradient_accumulation_steps
+        # in pipeline mode grad accumulation IS the microbatch rotation inside
+        # the pipelined loss; the outer step consumes the whole global batch
+        gas = 1 if self._pp_mode else cfg.gradient_accumulation_steps
         mesh = self.mesh
-        batch_sharding = NamedSharding(mesh, self._batch_spec())
         grad_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
                                       self.grad_specs,
                                       is_leaf=lambda x: isinstance(x, P))
@@ -366,7 +393,7 @@ class Engine:
 
         self._train_step = jax.jit(
             train_step,
-            in_shardings=(self.state_shardings, batch_sharding, None),
+            in_shardings=(self.state_shardings, None, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,))
 
@@ -375,7 +402,7 @@ class Engine:
             return loss
 
         self._eval_step = jax.jit(
-            eval_step, in_shardings=(self.state_shardings, batch_sharding))
+            eval_step, in_shardings=(self.state_shardings, None))
 
         # --- 3-call API pieces (forward/backward/step)
         def grad_only(state, batch, rng):
@@ -384,7 +411,7 @@ class Engine:
             return (loss / scale if fp16 else loss), grads
 
         self._grad_only = jax.jit(
-            grad_only, in_shardings=(self.state_shardings, batch_sharding, None),
+            grad_only, in_shardings=(self.state_shardings, None, None),
             out_shardings=(None, grad_shardings))
         self._accum = jax.jit(
             lambda acc, g: jax.tree.map(jnp.add, acc, g),
@@ -403,6 +430,7 @@ class Engine:
         """Consume one *global* batch (train_batch_size rows) and take one
         optimizer step (reference: PipelineEngine.train_batch:282 semantics,
         also covers engine fwd/bwd/step loop for non-pipe)."""
+        self._activate_context()
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
         batch = self._device_batch(batch)
@@ -417,7 +445,15 @@ class Engine:
         self._log_step(metrics)
         return metrics
 
+    def _activate_context(self):
+        """Republish this engine's mesh/plan as the ambient parallel context
+        (another Engine/InferenceEngine in the same process may have
+        overwritten it)."""
+        from deepspeed_tpu.parallel.context import set_parallel_context
+        set_parallel_context(self.mesh, self.plan)
+
     def eval_batch(self, batch):
+        self._activate_context()
         batch = self._device_batch(batch)
         with self.mesh:
             return self._eval_step(self.state, batch)
@@ -426,6 +462,7 @@ class Engine:
     def forward(self, batch):
         """Compute loss+grads for one microbatch; grads are buffered until
         step(). Returns the (unscaled) loss."""
+        self._activate_context()
         self._rng, sub = jax.random.split(self._rng)
         batch = self._device_batch(batch)
         with self.mesh:
@@ -452,7 +489,9 @@ class Engine:
         return loss
 
     def is_gradient_accumulation_boundary(self) -> bool:
-        return self._accum_count >= self.config.gradient_accumulation_steps
+        # pp mode: the pipelined loss consumes all microbatches in one call
+        needed = 1 if self._pp_mode else self.config.gradient_accumulation_steps
+        return self._accum_count >= needed
 
     def step(self):
         """Apply the optimizer if at a grad-accum boundary (reference:
@@ -473,10 +512,11 @@ class Engine:
 
     # ------------------------------------------------------------------
     def _device_batch(self, batch):
-        sharding = NamedSharding(self.mesh, self._batch_spec())
+        spec = self._batch_spec()
         def put(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            return jax.device_put(x, sharding)
+            s = P(*spec[:max(1, min(x.ndim, len(spec)))])
+            return jax.device_put(x, NamedSharding(self.mesh, s))
         return jax.tree.map(put, batch)
 
     def _log_step(self, metrics):
