@@ -33,7 +33,7 @@ def adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
     def init(params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
         state = {
-            "step": jnp.zeros((), jnp.int32),
+            "step": jnp.zeros((1,), jnp.int32),
             "exp_avg": jax.tree.map(zeros, params),
             "exp_avg_sq": jax.tree.map(zeros, params),
             "master": _master_init(params, use_master_weights),
@@ -112,7 +112,7 @@ def onebit_adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e
     def init(params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
         return {
-            "step": jnp.zeros((), jnp.int32),
+            "step": jnp.zeros((1,), jnp.int32),
             "exp_avg": jax.tree.map(zeros, params),
             "exp_avg_sq": jax.tree.map(zeros, params),
             "error": jax.tree.map(zeros, params),        # error feedback buffer

@@ -1,0 +1,110 @@
+"""Python binding for the native async-IO library (ctypes).
+
+Reference: ``csrc/aio/py_lib/py_ds_aio.cpp:12-44`` (`aio_handle` with
+sync/async pread/pwrite) + ``op_builder`` JIT build. We compile the C++ on
+first use with g++ (no torch extension machinery needed) and cache the .so
+next to the source.
+"""
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "aio", "dstpu_aio.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libdstpu_aio.so")
+
+_LIB = None
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception as e:
+        logger.warning(f"aio build failed: {e}")
+        return None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    so = _build()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    lib.dstpu_aio_open.restype = ctypes.c_void_p
+    lib.dstpu_aio_open.argtypes = [ctypes.c_uint, ctypes.c_uint, ctypes.c_int]
+    lib.dstpu_aio_close.argtypes = [ctypes.c_void_p]
+    lib.dstpu_aio_uses_uring.argtypes = [ctypes.c_void_p]
+    lib.dstpu_aio_uses_uring.restype = ctypes.c_int
+    for fn in (lib.dstpu_aio_pread, lib.dstpu_aio_pwrite):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                       ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+    lib.dstpu_aio_alloc.restype = ctypes.c_void_p
+    lib.dstpu_aio_alloc.argtypes = [ctypes.c_int64]
+    lib.dstpu_aio_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def aio_available() -> bool:
+    return _load() is not None
+
+
+class AIOHandle:
+    """Reference: ``aio_handle``. block_size/queue_depth/thread_count map to
+    the same-named config keys (AIOConfig)."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 thread_count: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native aio library unavailable (g++ build failed)")
+        self._lib = lib
+        self._h = lib.dstpu_aio_open(block_size, queue_depth, thread_count)
+        self.block_size = block_size
+
+    @property
+    def uses_io_uring(self) -> bool:
+        return bool(self._lib.dstpu_aio_uses_uring(self._h))
+
+    def pwrite(self, path: str, array: np.ndarray, file_offset: int = 0,
+               direct: bool = False) -> None:
+        arr = np.ascontiguousarray(array)
+        rc = self._lib.dstpu_aio_pwrite(
+            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, file_offset, int(direct))
+        if rc != 0:
+            raise IOError(f"aio pwrite failed: {path}")
+
+    def pread(self, path: str, shape, dtype, file_offset: int = 0,
+              direct: bool = False, out: Optional[np.ndarray] = None) -> np.ndarray:
+        arr = out if out is not None else np.empty(shape, dtype)
+        rc = self._lib.dstpu_aio_pread(
+            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, file_offset, int(direct))
+        if rc != 0:
+            raise IOError(f"aio pread failed: {path}")
+        return arr
+
+    def close(self):
+        if self._h:
+            self._lib.dstpu_aio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

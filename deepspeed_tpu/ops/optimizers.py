@@ -91,7 +91,7 @@ def sgd(lr: ScalarOrSchedule = 1e-2, momentum: float = 0.0,
         weight_decay: float = 0.0, nesterov: bool = False,
         use_master_weights: bool = True) -> Optimizer:
     def init(params):
-        state = {"step": jnp.zeros((), jnp.int32)}
+        state = {"step": jnp.zeros((1,), jnp.int32)}
         if momentum:
             state["momentum"] = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         state["master"] = _master_init(params, use_master_weights)
@@ -125,7 +125,7 @@ def adagrad(lr: ScalarOrSchedule = 1e-2, eps: float = 1e-10,
     """Reference: ``csrc/adagrad/cpu_adagrad.cpp`` / ``ops/adagrad``. """
     def init(params):
         return {
-            "step": jnp.zeros((), jnp.int32),
+            "step": jnp.zeros((1,), jnp.int32),
             "accum": jax.tree.map(
                 lambda p: jnp.full(p.shape, initial_accumulator, jnp.float32), params),
             "master": _master_init(params, use_master_weights),
@@ -153,7 +153,7 @@ def lion(lr: ScalarOrSchedule = 1e-4, beta1: float = 0.9, beta2: float = 0.99,
     1-bit update is a natural fit for compressed DCN gradients."""
     def init(params):
         return {
-            "step": jnp.zeros((), jnp.int32),
+            "step": jnp.zeros((1,), jnp.int32),
             "mu": jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
             "master": _master_init(params, use_master_weights),
         }

@@ -145,6 +145,19 @@ class Engine:
         self._fp16 = config.fp16.enabled
         use_master = self.compute_dtype != jnp.float32
 
+        # --- optimizer-state host offload (ZeRO-Offload; reference:
+        # runtime/zero/offload_config.py + cpu Adam path). States live in
+        # pinned host DRAM and stream through HBM inside the step.
+        self._offload_opt = config.zero_optimization.offload_optimizer.enabled
+        if self._offload_opt:
+            kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+            if "pinned_host" not in kinds:
+                logger.warning("offload_optimizer requested but pinned_host "
+                               "memory unavailable; disabling")
+                self._offload_opt = False
+            else:
+                logger.info("optimizer state offload: pinned_host DRAM")
+
         # --- optimizer (reference: _configure_optimizer:1175)
         self.lr_scheduler = lr_scheduler
         self._schedule = None
@@ -234,6 +247,8 @@ class Engine:
         init_fn = jax.jit(make_state, out_shardings=self.state_shardings)
         with self.mesh:
             state = init_fn(self._rng)
+        if self._offload_opt:
+            state["opt"] = self._opt_to_host(state["opt"])
         return state
 
     def _state_shardings_from(self, state_shapes):
@@ -275,6 +290,14 @@ class Engine:
         out = {}
         out["params"] = shard_like_params(params_shapes, self.param_specs)
         out["opt"] = assign(state_shapes["opt"])
+        if self._offload_opt:
+            # the jitted step stays memory-kind-free (XLA SPMD drops sharding
+            # attributes on placement custom-calls for replicated tensors);
+            # host residency is managed EAGERLY at step boundaries instead
+            self._opt_host_shardings = jax.tree.map(
+                lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
+                if s is not None else None,
+                out["opt"], is_leaf=lambda x: x is None or isinstance(x, NamedSharding))
         out["step"] = NamedSharding(mesh, P())
         if "loss_scale" in state_shapes:
             out["loss_scale"] = jax.tree.map(
@@ -332,10 +355,13 @@ class Engine:
             new_params, new_opt = self.optimizer.update(grads, opt, params)
             if fp16:
                 # skip the step on overflow (reference: step:1635 overflow path)
+                # (both trees are in device memory here — where() before the
+                # host writeback)
                 new_params = jax.tree.map(
                     lambda n, o: jnp.where(overflow, o, n), new_params, params)
                 new_opt = jax.tree.map(
                     lambda n, o: jnp.where(overflow, o, n), new_opt, opt)
+            if fp16:
                 new_ls = fp16_mod.update_loss_scale(
                     ls, overflow, dynamic=fp16_cfg.dynamic,
                     scale_window=fp16_cfg.loss_scale_window,
@@ -434,8 +460,12 @@ class Engine:
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
         batch = self._device_batch(batch)
+        if self._offload_opt:
+            self.state["opt"] = self._opt_to_device(self.state["opt"])
         with self.mesh:
             self.state, metrics = self._train_step(self.state, batch, sub)
+        if self._offload_opt:
+            self.state["opt"] = self._opt_to_host(self.state["opt"])
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         if self._fp16 and bool(metrics["overflow"]):
@@ -444,6 +474,19 @@ class Engine:
         metrics = {k: v for k, v in metrics.items()}
         self._log_step(metrics)
         return metrics
+
+    def _opt_to_host(self, opt):
+        """Move optimizer state to pinned host DRAM (ZeRO-Offload residency)."""
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, s) if x is not None and s is not None
+            else x,
+            opt, self._opt_host_shardings, is_leaf=lambda x: x is None)
+
+    def _opt_to_device(self, opt):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(s.mesh, s.spec))
+            if x is not None and s is not None else x,
+            opt, self._opt_host_shardings, is_leaf=lambda x: x is None)
 
     def _activate_context(self):
         """Republish this engine's mesh/plan as the ambient parallel context
@@ -499,9 +542,13 @@ class Engine:
         if not self.is_gradient_accumulation_boundary():
             return None
         mean_loss = self._loss_sum / self._accum_count
+        if self._offload_opt:
+            self.state["opt"] = self._opt_to_device(self.state["opt"])
         with self.mesh:
             self.state, metrics = self._apply(
                 self.state, self._grad_buffer, mean_loss)
+        if self._offload_opt:
+            self.state["opt"] = self._opt_to_host(self.state["opt"])
         self._grad_buffer = None
         self._accum_count = 0
         self.global_steps += 1
@@ -592,6 +639,8 @@ class Engine:
             load_dir, tag, template=self.state, shardings=self.state_shardings)
         if not load_optimizer_states:
             state["opt"] = self.state["opt"]
+        if self._offload_opt:
+            state["opt"] = self._opt_to_host(state["opt"])
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
