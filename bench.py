@@ -4,37 +4,75 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Baseline anchor (BASELINE.md): the reference's headline is 45% MFU for
 Llama-2-7B ZeRO-3 on v5p; on one chip we measure the largest Llama-family
-model that fits and report MFU as value, vs_baseline = MFU / 0.45.
+model that FITS and report MFU as value, vs_baseline = MFU / 0.45.
+
+Fit logic (round-1 postmortem: a blind llama-1b/seq-2048/bs-8 pick OOM'd the
+v5e and the whole round produced no number): we estimate the resident bytes of
+each ladder rung from first principles, skip rungs that can't fit the probed
+HBM, and still wrap each attempt in an OOM catch-and-step-down so a bad
+estimate degrades to a smaller config instead of rc=1.
 """
 
 import argparse
+import gc
 import json
 import sys
 import time
 
 import numpy as np
 
+GiB = 1 << 30
 
-def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
-              batch: int = None, steps: int = None):
+# (model size, seq len, global batch) from most to least ambitious.
+LADDER = [
+    ("7b", 2048, 8),
+    ("3b", 2048, 8),
+    ("1b", 2048, 8),
+    ("1b", 2048, 4),
+    ("350m", 2048, 8),
+    ("350m", 2048, 4),
+    ("tiny", 1024, 8),
+    ("tiny", 512, 4),
+]
+
+
+def estimate_resident_bytes(cfg, n_params: int, batch: int, seq: int) -> int:
+    """Single-chip ZeRO-1 resident bytes: bf16 params (2) + bf16 grads (2) +
+    fp32 master/m/v (12) per param, plus saved activations under the
+    dots_saveable remat policy, plus fp32 logits + softmax workspace."""
+    state = 16 * n_params
+    # fp32 logits and their grad/softmax temp dominate activation memory
+    logits = 12 * batch * seq * cfg.vocab_size
+    # per-layer saved residuals/dots under remat: a handful of [B,S,H] bf16
+    acts = 14 * batch * seq * cfg.hidden_size * cfg.num_layers
+    workspace = 1 * GiB  # compiler temps, infeed, fragmentation headroom
+    return state + logits + acts + workspace
+
+
+def _is_oom(err: BaseException) -> bool:
+    s = str(err)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s or "Allocator" in s)
+
+
+def _count_params(cfg) -> int:
+    """Closed-form param count — avoids materializing weights just to size."""
+    h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    inter = cfg.intermediate_size
+    kvh = (cfg.num_kv_heads or cfg.num_heads)
+    head_dim = h // cfg.num_heads
+    attn = h * h + 2 * h * kvh * head_dim + h * h  # q, k+v, o
+    mlp = 3 * h * inter if cfg.activation == "silu_glu" else 2 * h * inter
+    norms = 2 * h
+    embed = V * h * (1 if cfg.tie_embeddings else 2)
+    return L * (attn + mlp + norms) + embed + h
+
+
+def _try_rung(size, S, B, nsteps):
     import jax
-    import jax.numpy as jnp
     import deepspeed_tpu
-    from deepspeed_tpu.accelerator import get_accelerator
     from deepspeed_tpu.models import llama_config, make_model
     from deepspeed_tpu.parallel import num_params
-
-    accel = get_accelerator()
-    on_tpu = accel.platform not in ("cpu",)
-
-    if quick or not on_tpu:
-        size, S, B, nsteps = "tiny", 512, 8, 10
-    else:
-        size, S, B, nsteps = "1b", 2048, 8, 20
-    size = model_size or size
-    S = seq or S
-    B = batch or B
-    nsteps = steps or nsteps
 
     cfg = llama_config(size, max_seq_len=S, remat=True,
                        remat_policy="dots_saveable")
@@ -70,24 +108,63 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
         engine.train_batch(make_batch())
     sync()
     dt = time.perf_counter() - t0
+    n = num_params(engine.state["params"])
+    return cfg, engine, n, dt
 
-    m = None
-    tokens = B * S * nsteps
-    tok_per_sec = tokens / dt
-    n_params = num_params(engine.state["params"])
-    model_flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * S
-    achieved_flops = tok_per_sec * model_flops_per_token
-    peak = accel.peak_flops_per_device("bf16") * max(1, jax.device_count())
-    mfu = achieved_flops / peak
-    return {
-        "metric": f"llama-{size} bf16 zero1 train MFU (seq={S}, bs={B}, "
-                  f"{n_params/1e6:.0f}M params, {accel.device_kind()})",
-        "value": round(mfu, 4),
-        "unit": "MFU",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "tokens_per_sec_per_chip": round(tok_per_sec / max(1, jax.device_count()), 1),
-        "step_ms": round(dt / nsteps * 1000, 2),
-    }
+
+def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
+              batch: int = None, steps: int = None):
+    import jax
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models import llama_config
+
+    accel = get_accelerator()
+    on_tpu = accel.platform not in ("cpu",)
+    hbm = accel.hbm_bytes()
+
+    if model_size:  # explicit override: single rung, no ladder
+        ladder = [(model_size, seq or 2048, batch or 8)]
+    elif quick or not on_tpu:
+        ladder = [("tiny", 512, 8)]
+    else:
+        ladder = []
+        for size, S, B in LADDER:
+            cfg = llama_config(size, max_seq_len=S)
+            est = estimate_resident_bytes(cfg, _count_params(cfg), B, S)
+            if est <= 0.90 * hbm:
+                ladder.append((size, S, B))
+        if not ladder:
+            ladder = [LADDER[-1]]
+    nsteps = steps or (10 if (quick or not on_tpu) else 20)
+
+    last_err = None
+    for size, S, B in ladder:
+        try:
+            cfg, engine, n_params, dt = _try_rung(size, S, B, nsteps)
+        except Exception as e:  # noqa: BLE001 — OOM ladder fallback
+            if _is_oom(e):
+                print(f"bench: llama-{size} seq={S} bs={B} OOM'd; stepping down",
+                      file=sys.stderr)
+                last_err = e
+                gc.collect()
+                continue
+            raise
+        tokens = B * S * nsteps
+        tok_per_sec = tokens / dt
+        flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * S
+        achieved = tok_per_sec * flops_per_token
+        peak = accel.peak_flops_per_device("bf16") * max(1, jax.device_count())
+        mfu = achieved / peak
+        return {
+            "metric": f"llama-{size} bf16 zero1 train MFU (seq={S}, bs={B}, "
+                      f"{n_params/1e6:.0f}M params, {accel.device_kind()})",
+            "value": round(mfu, 4),
+            "unit": "MFU",
+            "vs_baseline": round(mfu / 0.45, 4),
+            "tokens_per_sec_per_chip": round(tok_per_sec / max(1, jax.device_count()), 1),
+            "step_ms": round(dt / nsteps * 1000, 2),
+        }
+    raise RuntimeError(f"every bench rung OOM'd; last error: {last_err}")
 
 
 if __name__ == "__main__":

@@ -100,6 +100,27 @@ class Accelerator:
         except Exception:
             return 0
 
+    def hbm_bytes(self, device=None) -> int:
+        """Per-device HBM capacity. Prefers live ``memory_stats``; falls back
+        to a device-kind table because some transports (e.g. the axon relay)
+        return no stats. Used by bench auto-sizing and the autotuner."""
+        limit = self.total_memory(device)
+        if limit:
+            return limit
+        GiB = 1 << 30
+        kind = self.device_kind().lower()
+        table = {
+            "v5 lite": 16 * GiB, "v5e": 16 * GiB, "v5litepod": 16 * GiB,
+            "v5p": 95 * GiB, "v6 lite": 32 * GiB, "v6e": 32 * GiB,
+            "v4": 32 * GiB, "v3": 16 * GiB, "v2": 8 * GiB,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        if self._platform == "cpu":
+            return 8 * GiB
+        return 16 * GiB  # conservative default for unknown TPU kinds
+
     def available_memory(self, device=None) -> int:
         return max(0, self.total_memory(device) - self.memory_allocated(device))
 

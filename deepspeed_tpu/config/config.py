@@ -52,6 +52,7 @@ class FP16Config(ConfigModel):
     initial_scale_power: int = 16
     loss_scale_window: int = 1000
     hysteresis: int = 2
+    consecutive_hysteresis: bool = False
     min_loss_scale: float = 1.0
     auto_cast: bool = True
 

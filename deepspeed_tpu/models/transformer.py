@@ -101,8 +101,13 @@ def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
     dims = {
         "tiny": dict(hidden_size=256, num_layers=4, num_heads=4, num_kv_heads=2,
                      intermediate_size=768, vocab_size=32000, max_seq_len=2048),
+        "350m": dict(hidden_size=1024, num_layers=24, num_heads=16,
+                     num_kv_heads=8, intermediate_size=2816, vocab_size=32000,
+                     max_seq_len=4096),
         "1b": dict(hidden_size=2048, num_layers=16, num_heads=32, num_kv_heads=8,
                    intermediate_size=5632, vocab_size=32000, max_seq_len=4096),
+        "3b": dict(hidden_size=3072, num_layers=28, num_heads=24, num_kv_heads=8,
+                   intermediate_size=8192, vocab_size=32000, max_seq_len=4096),
         "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
                    intermediate_size=11008, vocab_size=32000, max_seq_len=4096),
         "13b": dict(hidden_size=5120, num_layers=40, num_heads=40,

@@ -88,8 +88,10 @@ def top_k_gating(logits, k: int, capacity: int, *, rng=None,
         combine = combine + (gate_val[:, None] * onehot * keep[:, None])[..., None] \
             * pos_oh[:, None, :]
         gate_sum = gate_sum + gate_val
-        claimed = claimed + jnp.sum(onehot * keep[:, None],
-                                    axis=0).astype(jnp.int32)
+        # offset next choice by the FULL pre-drop count (reference top2gating
+        # offsets locations2 by sum(mask1)): choice-2 tokens must not reuse
+        # slots freed by dropped choice-1 tokens, or drop statistics diverge.
+        claimed = claimed + jnp.sum(onehot, axis=0).astype(jnp.int32)
         # mask out the chosen expert for the next choice
         masked_gates = masked_gates * (1.0 - onehot)
 

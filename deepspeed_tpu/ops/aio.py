@@ -7,6 +7,7 @@ next to the source.
 """
 
 import ctypes
+import hashlib
 import os
 import subprocess
 from typing import Optional
@@ -17,18 +18,32 @@ from deepspeed_tpu.utils.logging import logger
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc", "aio", "dstpu_aio.cpp")
-_SO = os.path.join(os.path.dirname(_SRC), "libdstpu_aio.so")
 
 _LIB = None
 
 
+def _cache_dir() -> str:
+    base = os.environ.get("DSTPU_CACHE_DIR") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "deepspeed_tpu")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
 def _build() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC]
+    # Key the cached .so by source hash (never by mtime): a stale or
+    # pre-committed binary must never shadow the audited source.
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_cache_dir(), f"libdstpu_aio-{digest}.so")
+    if os.path.exists(so):
+        return so
+    tmp = f"{so}.tmp.{os.getpid()}"  # per-process: concurrent builds must not race
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _SO
+        os.replace(tmp, so)
+        return so
     except Exception as e:
         logger.warning(f"aio build failed: {e}")
         return None

@@ -26,16 +26,53 @@ from deepspeed_tpu.utils.logging import logger
 LATEST_FILE = "latest"
 
 
+def _pointer_file(path: str) -> str:
+    return f"{path}.current"
+
+
+def _read_pointer(path: str) -> Optional[str]:
+    """Absolute path of the live version dir for `path`, or None."""
+    try:
+        with open(_pointer_file(path)) as f:
+            name = f.read().strip()
+        return os.path.join(os.path.dirname(path), name)
+    except FileNotFoundError:
+        return None
+
+
+def _write_pointer(path: str, version_name: str) -> None:
+    """Atomically publish version_name as the live version of `path`."""
+    ptr = _pointer_file(path)
+    tmp = f"{ptr}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(version_name)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, ptr)
+
+
+def _resolve_pointer(path: str) -> str:
+    """Follow `<path>.current` if present; fall back to `path` itself
+    (legacy layout and checkpoints written by other tools)."""
+    target = _read_pointer(path)
+    if target is not None and os.path.exists(target):
+        return target
+    return path
+
+
 class CheckpointEngine:
     """Base checkpoint engine (reference: checkpoint_engine.py:6). The Orbax
     engine below is the default; TorchCheckpointEngine's role (one file per
     rank) has no TPU equivalent — sharding lives inside TensorStore."""
 
-    def save(self, state, path: str):
+    def save(self, state, path: str, on_complete=None):
         raise NotImplementedError
 
     def load(self, path: str, template=None, shardings=None):
         raise NotImplementedError
+
+    def wait(self):
+        return None
 
     def commit(self, tag: str):
         return True
@@ -46,25 +83,58 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self.async_save = async_save
+        self._pending = None
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler()) \
             if async_save else ocp.StandardCheckpointer()
+        if async_save:
+            # the final save of a run must still land: finalize (tmp->path
+            # swap, meta.json, `latest`) at interpreter exit if nobody waited
+            import atexit
+            atexit.register(self.wait)
 
-    def save(self, state, path: str):
+    def save(self, state, path: str, on_complete=None):
+        # Crash-safe overwrite via a pointer file: the state is written to a
+        # unique versioned dir (`<path>-v<token>`) and `<path>.current` is
+        # atomically os.replace()'d to name it only once the write is durable.
+        # A crash at ANY point leaves the pointer naming the previous good
+        # version — there is no window where `latest` points at nothing.
+        # For async_save the publish + on_complete are deferred to wait(),
+        # so training overlaps the TensorStore write.
+        if self._pending is not None:
+            self.wait()  # finalize the previous in-flight save first
         path = os.path.abspath(path)
-        if os.path.exists(path):
-            shutil.rmtree(path)
-        self._ckptr.save(path, state)
+        prev = _read_pointer(path)
+        token = f"{os.getpid()}-{int.from_bytes(os.urandom(4), 'big'):08x}"
+        vdir = f"{path}-v{token}"
+        self._ckptr.save(vdir, state)
+        self._pending = (vdir, path, prev, on_complete)
         if not self.async_save:
             self.wait()
 
     def wait(self):
+        pending, self._pending = getattr(self, "_pending", None), None
         try:
             self._ckptr.wait_until_finished()
         except AttributeError:
             pass
+        except Exception:
+            # failed async write: drop the partial version dir, never publish
+            if pending is not None:
+                shutil.rmtree(pending[0], ignore_errors=True)
+            raise
+        if pending is None:
+            return
+        vdir, path, prev, on_complete = pending
+        _write_pointer(path, os.path.basename(vdir))  # atomic publish
+        if prev is not None and prev != vdir and os.path.exists(prev):
+            shutil.rmtree(prev, ignore_errors=True)
+        if os.path.isdir(path):  # legacy un-versioned layout superseded
+            shutil.rmtree(path, ignore_errors=True)
+        if on_complete is not None:
+            on_complete()
 
     def load(self, path: str, template=None, shardings=None):
-        path = os.path.abspath(path)
+        path = _resolve_pointer(os.path.abspath(path))
         if template is not None and shardings is not None:
             abstract = jax.tree.map(
                 lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s),
@@ -87,20 +157,24 @@ def save_checkpoint(save_dir: str, tag: str, state, *,
     engine = engine or OrbaxCheckpointEngine()
     ckpt_path = os.path.join(save_dir, str(tag))
     os.makedirs(save_dir, exist_ok=True)
-    engine.save(state, os.path.join(ckpt_path, "state"))
-    meta = {
-        "tag": str(tag),
-        "client_state": client_state or {},
-        "config": config_dict or {},
-        "world_size": jax.device_count(),
-        "framework_version": "deepspeed_tpu-0.1",
-    }
-    with open(os.path.join(ckpt_path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
-    if save_latest:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
-    logger.info(f"saved checkpoint {ckpt_path}")
+
+    def finalize():
+        # runs only after the state dir is durable (possibly async)
+        meta = {
+            "tag": str(tag),
+            "client_state": client_state or {},
+            "config": config_dict or {},
+            "world_size": jax.device_count(),
+            "framework_version": "deepspeed_tpu-0.1",
+        }
+        with open(os.path.join(ckpt_path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        logger.info(f"saved checkpoint {ckpt_path}")
+
+    engine.save(state, os.path.join(ckpt_path, "state"), on_complete=finalize)
     return ckpt_path
 
 

@@ -194,6 +194,8 @@ class Engine:
         # --- bookkeeping (reference: engine timers/monitor wiring)
         self.global_steps = 0
         self.skipped_steps = 0
+        self._ckpt_engine = None  # persistent async checkpoint engine
+        self._last_grad_norm = None
         self.micro_steps = 0
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -213,7 +215,9 @@ class Engine:
         try:
             from deepspeed_tpu.monitor import MonitorMaster
             return MonitorMaster(self.config)
-        except Exception:
+        except Exception as e:
+            # a typo'd W&B/TB config must not silently disable monitoring
+            logger.warning(f"monitor disabled — backend init failed: {e!r}")
             return None
 
     def _init_state(self):
@@ -366,7 +370,8 @@ class Engine:
                     ls, overflow, dynamic=fp16_cfg.dynamic,
                     scale_window=fp16_cfg.loss_scale_window,
                     min_scale=fp16_cfg.min_loss_scale,
-                    max_hysteresis=fp16_cfg.hysteresis)
+                    max_hysteresis=fp16_cfg.hysteresis,
+                    consecutive_hysteresis=fp16_cfg.consecutive_hysteresis)
                 loss_scale_state = {"scale": new_ls.scale,
                                     "good_steps": new_ls.good_steps,
                                     "hysteresis": new_ls.hysteresis}
@@ -562,11 +567,14 @@ class Engine:
         spec = self._batch_spec()
         def put(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            s = P(*spec[:max(1, min(x.ndim, len(spec)))])
+            s = P(*spec[:min(x.ndim, len(spec))])  # 0-d leaves → replicated
             return jax.device_put(x, NamedSharding(self.mesh, s))
         return jax.tree.map(put, batch)
 
     def _log_step(self, metrics):
+        # keep the device array; get_global_grad_norm() fetches on demand
+        if "grad_norm" in metrics:
+            self._last_grad_norm = metrics["grad_norm"]
         cfg = self.config
         if self.global_steps % max(1, cfg.steps_per_print) == 0:
             loss = float(metrics["loss"])
@@ -600,7 +608,11 @@ class Engine:
         return 1.0
 
     def get_global_grad_norm(self) -> Optional[float]:
-        return None  # available in step metrics
+        """Pre-clip global grad norm of the last applied step (reference:
+        engine.get_global_grad_norm). None before the first step."""
+        if self._last_grad_norm is None:
+            return None
+        return float(np.asarray(jax.device_get(self._last_grad_norm)))
 
     def train_micro_batch_size_per_gpu(self) -> int:
         return self.config.train_micro_batch_size_per_gpu
@@ -628,13 +640,26 @@ class Engine:
             "skipped_steps": self.skipped_steps,
             "micro_steps": self.micro_steps,
         })
+        engine = None
+        if self.config.checkpoint.async_save:
+            if self._ckpt_engine is None:
+                self._ckpt_engine = ckpt_mod.OrbaxCheckpointEngine(async_save=True)
+            engine = self._ckpt_engine  # .save() finalizes any in-flight save
         return ckpt_mod.save_checkpoint(
             save_dir, tag, self.state, client_state=client_state,
-            config_dict=self.config.to_dict(), save_latest=save_latest)
+            config_dict=self.config.to_dict(), save_latest=save_latest,
+            engine=engine)
+
+    def wait_checkpoint(self):
+        """Block until an in-flight async checkpoint is durable (and its
+        `latest` pointer written). No-op when async_save is off."""
+        if self._ckpt_engine is not None:
+            self._ckpt_engine.wait()
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
+        self.wait_checkpoint()
         state, client_state = ckpt_mod.load_checkpoint(
             load_dir, tag, template=self.state, shardings=self.state_shardings)
         if not load_optimizer_states:

@@ -51,12 +51,16 @@ def has_overflow(grads) -> jnp.ndarray:
 def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray,
                       dynamic: bool = True, scale_window: int = 1000,
                       scale_factor: float = 2.0, min_scale: float = 1.0,
-                      max_hysteresis: int = 2) -> LossScaleState:
+                      max_hysteresis: int = 2,
+                      consecutive_hysteresis: bool = False) -> LossScaleState:
     if not dynamic:
         return state
-    # overflow: consume hysteresis; only shrink when exhausted
-    hys_left = jnp.maximum(state.hysteresis - 1, 0)
+    # overflow: consume hysteresis; shrink when exhausted (hysteresis is NOT
+    # replenished by the shrink itself — reference update_scale keeps
+    # cur_hysteresis at 1 after a shrink)
     shrink = jnp.logical_and(overflow, state.hysteresis <= 1)
+    hys = jnp.where(jnp.logical_and(overflow, jnp.logical_not(shrink)),
+                    state.hysteresis - 1, state.hysteresis)
     new_scale = jnp.where(
         shrink, jnp.maximum(state.scale / scale_factor, min_scale), state.scale)
     # growth on scale_window consecutive good steps
@@ -64,8 +68,14 @@ def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray,
     grow = good >= scale_window
     new_scale = jnp.where(grow, new_scale * scale_factor, new_scale)
     good = jnp.where(grow, 0, good)
-    new_hys = jnp.where(overflow, hys_left, jnp.asarray(max_hysteresis, jnp.int32))
-    return LossScaleState(scale=new_scale, good_steps=good, hysteresis=new_hys)
+    full = jnp.asarray(max_hysteresis, jnp.int32)
+    if consecutive_hysteresis:
+        # replenish on every overflow-free step (reference's opt-in mode)
+        hys = jnp.where(overflow, hys, full)
+    else:
+        # reference default: replenish only at a scale-growth boundary
+        hys = jnp.where(grow, full, hys)
+    return LossScaleState(scale=new_scale, good_steps=good, hysteresis=hys)
 
 
 def scale_loss(loss, state: LossScaleState):

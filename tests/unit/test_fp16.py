@@ -1,0 +1,87 @@
+"""Dynamic loss scaler state machine vs the reference semantics.
+
+Reference: ``deepspeed/runtime/fp16/loss_scaler.py`` DynamicLossScaler
+.update_scale — shrink-on-exhausted-hysteresis, growth every scale_window
+clean steps, hysteresis replenished at the growth boundary (default) or every
+clean step (consecutive_hysteresis=True).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.fp16 import (LossScaleState, has_overflow,
+                                        init_loss_scale, update_loss_scale)
+
+
+def step(state, overflow, **kw):
+    return update_loss_scale(state, jnp.asarray(overflow), **kw)
+
+
+def scale(state):
+    return float(np.asarray(state.scale))
+
+
+def hys(state):
+    return int(np.asarray(state.hysteresis))
+
+
+class TestDynamicLossScale:
+    def test_overflow_consumes_hysteresis_before_shrink(self):
+        s = init_loss_scale(initial_scale_power=16, hysteresis=2)
+        s = step(s, True, max_hysteresis=2)
+        assert scale(s) == 2.0 ** 16 and hys(s) == 1  # tolerated
+        s = step(s, True, max_hysteresis=2)
+        assert scale(s) == 2.0 ** 15  # exhausted -> shrink
+
+    def test_shrink_does_not_replenish_hysteresis(self):
+        # reference keeps cur_hysteresis at 1 after a shrink: the next
+        # overflow shrinks again immediately
+        s = init_loss_scale(initial_scale_power=16, hysteresis=2)
+        s = step(s, True, max_hysteresis=2)
+        s = step(s, True, max_hysteresis=2)   # shrink, hys stays 1
+        assert hys(s) == 1
+        s = step(s, True, max_hysteresis=2)
+        assert scale(s) == 2.0 ** 14
+
+    def test_default_replenishes_only_at_growth_boundary(self):
+        s = init_loss_scale(initial_scale_power=16, hysteresis=2)
+        s = step(s, True, max_hysteresis=2, scale_window=4)
+        assert hys(s) == 1
+        # clean steps below the window do NOT replenish
+        for _ in range(3):
+            s = step(s, False, max_hysteresis=2, scale_window=4)
+            assert hys(s) == 1
+        # 4th clean step: growth boundary -> scale grows AND hysteresis refills
+        s = step(s, False, max_hysteresis=2, scale_window=4)
+        assert scale(s) == 2.0 ** 17 and hys(s) == 2
+
+    def test_consecutive_hysteresis_replenishes_every_clean_step(self):
+        s = init_loss_scale(initial_scale_power=16, hysteresis=2)
+        s = step(s, True, max_hysteresis=2, consecutive_hysteresis=True)
+        assert hys(s) == 1
+        s = step(s, False, max_hysteresis=2, consecutive_hysteresis=True)
+        assert hys(s) == 2
+
+    def test_overflow_resets_growth_window(self):
+        s = init_loss_scale(initial_scale_power=16, hysteresis=1)
+        for _ in range(3):
+            s = step(s, False, scale_window=4, max_hysteresis=1)
+        s = step(s, True, scale_window=4, max_hysteresis=1)  # shrink + reset
+        for _ in range(3):
+            s = step(s, False, scale_window=4, max_hysteresis=1)
+        assert scale(s) == 2.0 ** 15  # not yet regrown
+
+    def test_min_scale_floor(self):
+        s = LossScaleState(scale=jnp.asarray(2.0, jnp.float32),
+                           good_steps=jnp.zeros((), jnp.int32),
+                           hysteresis=jnp.ones((), jnp.int32))
+        s = step(s, True, min_scale=1.0, max_hysteresis=1)
+        s = step(s, True, min_scale=1.0, max_hysteresis=1)
+        assert scale(s) == 1.0
+
+    def test_has_overflow(self):
+        good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+        bad = {"a": jnp.ones((4,)), "b": jnp.array([[1.0, jnp.inf], [0, 0]])}
+        assert not bool(has_overflow(good))
+        assert bool(has_overflow(bad))
