@@ -221,6 +221,10 @@ struct Handle {
   UringQueue ring;
   Pool pool;
   std::atomic<int64_t> sync_err{0};
+  // One ring (and one pool wait_all) per handle: concurrent submissions from
+  // different threads would interleave inflight accounting and deadlock.
+  // Callers wanting read/write overlap open two handles.
+  std::mutex op_mu;
 };
 
 int do_chunked_uring(Handle* h, int fd, bool write, char* buf, int64_t len,
@@ -298,6 +302,7 @@ void dstpu_aio_close(void* hp) {
 int dstpu_aio_pread(void* hp, const char* path, void* buf, int64_t len,
                     int64_t file_offset, int direct) {
   auto* h = (Handle*)hp;
+  std::lock_guard<std::mutex> op_lk(h->op_mu);
   int flags = O_RDONLY | (direct ? O_DIRECT : 0);
   int fd = open(path, flags);
   if (fd < 0 && direct) fd = open(path, O_RDONLY);  // fs may refuse O_DIRECT
@@ -312,6 +317,7 @@ int dstpu_aio_pread(void* hp, const char* path, void* buf, int64_t len,
 int dstpu_aio_pwrite(void* hp, const char* path, const void* buf, int64_t len,
                      int64_t file_offset, int direct) {
   auto* h = (Handle*)hp;
+  std::lock_guard<std::mutex> op_lk(h->op_mu);
   int flags = O_WRONLY | O_CREAT | (direct ? O_DIRECT : 0);
   int fd = open(path, flags, 0644);
   if (fd < 0 && direct) fd = open(path, O_WRONLY | O_CREAT, 0644);

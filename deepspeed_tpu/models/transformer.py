@@ -62,6 +62,13 @@ class TransformerConfig:
     remat: bool = False
     remat_policy: str = "none"                  # none|dots_saveable|save_nothing
     scan_layers: bool = True
+    # ZeRO-Infinity param offload: stacked layer weights live in pinned host
+    # DRAM; each scan step transfers ONE layer into HBM (and the remat replay
+    # re-fetches it during backward), so peak HBM holds ~1 layer of params.
+    # Reference: runtime/swap_tensor/partitioned_param_swapper.py:35 (the
+    # fetch-on-use coordinator); here the transfer is a compiled memory-space
+    # move XLA overlaps with compute.
+    offload_params: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -446,6 +453,15 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
 
     def body(carry, layer_p):
         x_c, rng, aux_acc = carry
+        if cfg.offload_params:
+            # host -> HBM move for this layer only; sits inside the remat
+            # region so backward re-fetches instead of keeping it live.
+            # Host copies stay fp32 (sub-word host DMA is broken on some
+            # TPU transports); cast to compute dtype after the transfer.
+            from jax.memory import Space
+            layer_p = jax.tree.map(
+                lambda a: jax.device_put(a, Space.Device).astype(cfg.dtype),
+                layer_p)
         if rng is not None:
             rng, sub = jax.random.split(rng)
         else:

@@ -145,10 +145,27 @@ class Engine:
         self._fp16 = config.fp16.enabled
         use_master = self.compute_dtype != jnp.float32
 
-        # --- optimizer-state host offload (ZeRO-Offload; reference:
-        # runtime/zero/offload_config.py + cpu Adam path). States live in
-        # pinned host DRAM and stream through HBM inside the step.
-        self._offload_opt = config.zero_optimization.offload_optimizer.enabled
+        # --- optimizer-state offload (ZeRO-Offload / ZeRO-Infinity; reference:
+        # runtime/zero/offload_config.py + swap_tensor/*). device=cpu keeps
+        # states in pinned host DRAM; device=nvme streams fp32 state through
+        # HBM from NVMe chunk files (swap_tensor.NVMeOptimizerSwapper).
+        off_opt_cfg = config.zero_optimization.offload_optimizer
+        self._nvme_opt = off_opt_cfg.enabled and off_opt_cfg.device == "nvme"
+        self._offload_opt = off_opt_cfg.enabled and off_opt_cfg.device == "cpu"
+        self._swapper = None
+        if self._nvme_opt:
+            if not off_opt_cfg.nvme_path:
+                raise ValueError("offload_optimizer.device=nvme requires "
+                                 "offload_optimizer.nvme_path")
+            opt_name = (config.optimizer.name if config.optimizer else "adamw").lower()
+            if opt_name not in ("adam", "adamw", "cpuadam", "fusedadam"):
+                raise ValueError(
+                    f"offload_optimizer.device=nvme supports the Adam family "
+                    f"only (got '{opt_name}') — the flat-chunk swap kernel is "
+                    f"Adam; reference has the same restriction (cpu-adam)")
+            if optimizer is not None:
+                raise ValueError("offload_optimizer.device=nvme requires a "
+                                 "config-built optimizer, not a client one")
         if self._offload_opt:
             kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
             if "pinned_host" not in kinds:
@@ -157,6 +174,66 @@ class Engine:
                 self._offload_opt = False
             else:
                 logger.info("optimizer state offload: pinned_host DRAM")
+
+        # --- param offload (ZeRO-Infinity param path; reference:
+        # swap_tensor/partitioned_param_swapper.py). Stacked layer weights
+        # live in pinned host DRAM; the forward scan streams one layer at a
+        # time into HBM (models/transformer.py body device_put).
+        off_p_cfg = config.zero_optimization.offload_param
+        self._offload_param = off_p_cfg.enabled
+        if self._offload_param:
+            if off_p_cfg.device == "nvme":
+                raise ValueError(
+                    "offload_param.device=nvme is not implemented; use "
+                    "device=cpu (pinned host DRAM, layer-streamed)")
+            if not self._nvme_opt:
+                # in-graph host writeback of updated params is broken in this
+                # XLA/runtime (TPU backend Internal); the working path updates
+                # params through the NVMe swapper (device outputs, eager host
+                # writeback), so param offload requires it
+                raise ValueError(
+                    "offload_param.device=cpu requires "
+                    "offload_optimizer.device=nvme (the ZeRO-Infinity "
+                    "configuration): the optimizer step produces the updated "
+                    "host-resident params")
+            from deepspeed_tpu.models.transformer import TransformerConfig
+            if not isinstance(getattr(model, "config", None), TransformerConfig):
+                raise ValueError("offload_param requires a transformer "
+                                 "ModelSpec (stacked scanned layers)")
+            if self._pp_mode:
+                raise ValueError("offload_param with pipeline parallelism is "
+                                 "not supported (stages already partition "
+                                 "the layer stack)")
+            if self.plan.world_size > 1:
+                # XLA's SPMD partitioner rejects sharded device-placement
+                # annotations ("Side-effect ops cannot be replicated") in this
+                # version; the single-chip capacity path is the ZeRO-Infinity
+                # headline anyway (40B on one V100, BASELINE.md)
+                raise ValueError("offload_param requires a single-device mesh "
+                                 "in this version; use ZeRO-3 sharding for "
+                                 "multi-chip capacity")
+            if get_accelerator().platform == "cpu":
+                logger.warning("offload_param requires a TPU runtime (CPU has "
+                               "no device-placement support); disabling")
+                self._offload_param = False
+            else:
+                import dataclasses as _dc
+                from deepspeed_tpu.models import make_model as _mk
+                if not model.config.scan_layers or not model.config.offload_params:
+                    model = _mk(_dc.replace(model.config, scan_layers=True,
+                                            offload_params=True),
+                                name=model.name)
+                    self.model = model
+                logger.info("param offload: layer stack in pinned_host DRAM, "
+                            "streamed per scan step")
+        if self._offload_param:
+            self._param_dev_shardings = self.param_shardings
+            self.param_shardings = {
+                k: (jax.tree.map(
+                        lambda s: NamedSharding(self.mesh, s.spec,
+                                                memory_kind="pinned_host"),
+                        v) if k == "layers" else v)
+                for k, v in self.param_shardings.items()}
 
         # --- optimizer (reference: _configure_optimizer:1175)
         self.lr_scheduler = lr_scheduler
@@ -229,8 +306,18 @@ class Engine:
 
         def make_state(key):
             params32 = self.model.init(key)
-            opt_state = self.optimizer.init(params32)
-            params = jax.tree.map(lambda p: p.astype(self.compute_dtype), params32)
+            # nvme offload: fp32 state lives on NVMe chunks, never in HBM
+            opt_state = None if self._nvme_opt else self.optimizer.init(params32)
+            if self._offload_param:
+                # host-resident layer stacks stay fp32: sub-word (bf16) host
+                # DMA is broken on some TPU transports; the forward casts
+                # after the per-layer transfer
+                params = {k: (v if k == "layers" else jax.tree.map(
+                    lambda p: p.astype(self.compute_dtype), v))
+                    for k, v in params32.items()}
+            else:
+                params = jax.tree.map(
+                    lambda p: p.astype(self.compute_dtype), params32)
             state = {"params": params, "opt": opt_state,
                      "step": jnp.zeros((), jnp.int32)}
             if self._fp16:
@@ -253,7 +340,43 @@ class Engine:
             state = init_fn(self._rng)
         if self._offload_opt:
             state["opt"] = self._opt_to_host(state["opt"])
+        if self._nvme_opt:
+            self._swapper = self._build_swapper(state_shapes["params"])
+            self._swapper.initialize(state["params"])
         return state
+
+    def _build_swapper(self, param_shapes):
+        from deepspeed_tpu.runtime.swap_tensor import NVMeOptimizerSwapper
+        cfg = self.config
+        off = cfg.zero_optimization.offload_optimizer
+        p = dict(cfg.optimizer.params) if cfg.optimizer else {}
+        name = (cfg.optimizer.name if cfg.optimizer else "adamw").lower()
+        grad_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.grad_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        # the swapper always emits device-resident compute-dtype params:
+        # in-graph host writebacks crash this TPU runtime; offload_param host
+        # residency (fp32, sub-word host DMA is broken) is restored eagerly
+        # per leaf in _nvme_apply instead
+        out_shardings = (self._param_dev_shardings if self._offload_param
+                         else self.param_shardings)
+        if self._offload_param:
+            param_shapes = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, self.compute_dtype),
+                param_shapes)
+        return NVMeOptimizerSwapper(
+            param_shapes, mesh=self.mesh, nvme_path=off.nvme_path,
+            betas=tuple(p.get("betas", (0.9, 0.999))), eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay",
+                               0.01 if name == "adamw" else 0.0),
+            adam_w_mode=(name == "adamw" or p.get("adam_w_mode", False)),
+            bias_correction=p.get("bias_correction", True),
+            chunk_elems=max(1, off.buffer_size // 4),  # buffer_size is bytes
+            param_shardings=out_shardings,
+            grad_shardings=grad_shardings,
+            compute_dtype=self.compute_dtype,
+            pipeline=off.pipeline_read or off.pipeline_write or True,
+            host_inputs=self._offload_param)
 
     def _state_shardings_from(self, state_shapes):
         """Build shardings for the full train-state pytree: params use
@@ -292,7 +415,9 @@ class Engine:
             return jax.tree.map(lambda s: NamedSharding(mesh, P()), sub)
 
         out = {}
-        out["params"] = shard_like_params(params_shapes, self.param_specs)
+        # reuse the prebuilt param shardings (they may carry memory kinds,
+        # e.g. pinned_host layer stacks under offload_param)
+        out["params"] = self.param_shardings
         out["opt"] = assign(state_shapes["opt"])
         if self._offload_opt:
             # the jitted step stays memory-kind-free (XLA SPMD drops sharding
@@ -389,8 +514,8 @@ class Engine:
                 metrics["loss_scale"] = state["loss_scale"]["scale"]
             return new_state, metrics
 
-        def train_step(state, batch, rng):
-            """One full optimizer step over `gas` microbatches.
+        def batch_grads(state, batch, rng):
+            """Averaged grads + mean loss over `gas` microbatches.
             batch leaves: [global_batch, ...], sharded over (data, fsdp)."""
             params = state["params"]
             scale = state["loss_scale"]["scale"] if fp16 else jnp.float32(1.0)
@@ -420,13 +545,27 @@ class Engine:
                 mean_loss = jnp.mean(losses)
             if fp16:
                 mean_loss = mean_loss / scale
+            return mean_loss, grads
+
+        def train_step(state, batch, rng):
+            """One full optimizer step over `gas` microbatches."""
+            mean_loss, grads = batch_grads(state, batch, rng)
             return apply_grads(state, grads, mean_loss)
 
-        self._train_step = jax.jit(
-            train_step,
-            in_shardings=(self.state_shardings, None, None),
-            out_shardings=(self.state_shardings, None),
-            donate_argnums=(0,))
+        if self._nvme_opt:
+            # optimizer apply happens chunk-wise through the NVMe swapper;
+            # only the grad computation is a monolithic jitted program
+            self._batch_grads = jax.jit(
+                batch_grads,
+                in_shardings=(self.state_shardings, None, None),
+                out_shardings=(None, grad_shardings))
+            self._train_step = None
+        else:
+            self._train_step = jax.jit(
+                train_step,
+                in_shardings=(self.state_shardings, None, None),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=(0,))
 
         def eval_step(state, batch):
             loss = model.loss_fn(state["params"], batch, None, True)
@@ -448,11 +587,14 @@ class Engine:
             lambda acc, g: jax.tree.map(jnp.add, acc, g),
             in_shardings=(grad_shardings, grad_shardings),
             out_shardings=grad_shardings, donate_argnums=(0,))
-        self._apply = jax.jit(
-            lambda state, grads, loss: apply_grads(
-                state, jax.tree.map(lambda g: g / gas, grads), loss),
-            in_shardings=(self.state_shardings, grad_shardings, None),
-            out_shardings=(self.state_shardings, None), donate_argnums=(0, 1))
+        if self._nvme_opt:
+            self._apply = None  # step() routes through _nvme_apply
+        else:
+            self._apply = jax.jit(
+                lambda state, grads, loss: apply_grads(
+                    state, jax.tree.map(lambda g: g / gas, grads), loss),
+                in_shardings=(self.state_shardings, grad_shardings, None),
+                out_shardings=(self.state_shardings, None), donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
     # primary API
@@ -465,12 +607,17 @@ class Engine:
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
         batch = self._device_batch(batch)
-        if self._offload_opt:
-            self.state["opt"] = self._opt_to_device(self.state["opt"])
-        with self.mesh:
-            self.state, metrics = self._train_step(self.state, batch, sub)
-        if self._offload_opt:
-            self.state["opt"] = self._opt_to_host(self.state["opt"])
+        if self._nvme_opt:
+            with self.mesh:
+                mean_loss, grads = self._batch_grads(self.state, batch, sub)
+            metrics = self._nvme_apply(grads, mean_loss)
+        else:
+            if self._offload_opt:
+                self.state["opt"] = self._opt_to_device(self.state["opt"])
+            with self.mesh:
+                self.state, metrics = self._train_step(self.state, batch, sub)
+            if self._offload_opt:
+                self.state["opt"] = self._opt_to_host(self.state["opt"])
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         if self._fp16 and bool(metrics["overflow"]):
@@ -478,6 +625,49 @@ class Engine:
         self.tput_timer.stop()
         metrics = {k: v for k, v in metrics.items()}
         self._log_step(metrics)
+        return metrics
+
+    def _nvme_apply(self, grads, mean_loss) -> Dict[str, Any]:
+        """Optimizer apply through the NVMe swapper (ZeRO-Infinity path).
+        Grad scale/overflow handling happens host-side: on overflow the NVMe
+        state is untouched and only the loss scale shrinks."""
+        scale = float(self.state["loss_scale"]["scale"]) if self._fp16 else 1.0
+        applied = int(np.asarray(jax.device_get(self.state["step"]))) + 1
+        new_params, gnorm, overflow = self._swapper.step(
+            grads, lr=self.get_lr(), step_num=applied,
+            clip=self.config.gradient_clipping, grad_scale=scale)
+        if not overflow:
+            if self._offload_param:
+                # eager host writeback of the layer stack, per leaf (in-graph
+                # host outputs crash this TPU runtime; host copies are fp32
+                # because sub-word host DMA is broken on this transport)
+                new_params = {
+                    k: (jax.tree.map(
+                            lambda a, s: jax.device_put(
+                                a.astype(jnp.float32), s), v,
+                            self.state_shardings["params"][k])
+                        if k == "layers" else v)
+                    for k, v in new_params.items()}
+            self.state["params"] = new_params
+            self.state["step"] = jax.tree.map(lambda s: s + 1, self.state["step"])
+        if self._fp16:
+            ls = fp16_mod.LossScaleState(
+                scale=jnp.asarray(scale, jnp.float32),
+                good_steps=self.state["loss_scale"]["good_steps"],
+                hysteresis=self.state["loss_scale"]["hysteresis"])
+            cfgf = self.config.fp16
+            new_ls = fp16_mod.update_loss_scale(
+                ls, jnp.asarray(overflow), dynamic=cfgf.dynamic,
+                scale_window=cfgf.loss_scale_window,
+                min_scale=cfgf.min_loss_scale, max_hysteresis=cfgf.hysteresis,
+                consecutive_hysteresis=cfgf.consecutive_hysteresis)
+            self.state["loss_scale"] = {"scale": new_ls.scale,
+                                        "good_steps": new_ls.good_steps,
+                                        "hysteresis": new_ls.hysteresis}
+        metrics = {"loss": mean_loss, "grad_norm": jnp.asarray(gnorm),
+                   "overflow": jnp.asarray(overflow)}
+        if self._fp16:
+            metrics["loss_scale"] = jnp.asarray(scale)
         return metrics
 
     def _opt_to_host(self, opt):
@@ -547,6 +737,17 @@ class Engine:
         if not self.is_gradient_accumulation_boundary():
             return None
         mean_loss = self._loss_sum / self._accum_count
+        if self._nvme_opt:
+            gas = self.config.gradient_accumulation_steps
+            grads = jax.tree.map(lambda g: g / gas, self._grad_buffer)
+            metrics = self._nvme_apply(grads, mean_loss)
+            self._grad_buffer = None
+            self._accum_count = 0
+            self.global_steps += 1
+            if self._fp16 and bool(metrics["overflow"]):
+                self.skipped_steps += 1
+            self._log_step(metrics)
+            return metrics
         if self._offload_opt:
             self.state["opt"] = self._opt_to_device(self.state["opt"])
         with self.mesh:
@@ -645,10 +846,18 @@ class Engine:
             if self._ckpt_engine is None:
                 self._ckpt_engine = ckpt_mod.OrbaxCheckpointEngine(async_save=True)
             engine = self._ckpt_engine  # .save() finalizes any in-flight save
-        return ckpt_mod.save_checkpoint(
+        path = ckpt_mod.save_checkpoint(
             save_dir, tag, self.state, client_state=client_state,
             config_dict=self.config.to_dict(), save_latest=save_latest,
             engine=engine)
+        if self._nvme_opt:
+            # fp32 optimizer chunks live on NVMe, not in self.state — persist
+            # them alongside the Orbax state (reference: optimizer swap files
+            # are re-read into the checkpoint, optimizer_utils.py)
+            os.makedirs(path, exist_ok=True)
+            np.savez(os.path.join(path, "optswap.npz"),
+                     **self._swapper.export_state())
+        return path
 
     def wait_checkpoint(self):
         """Block until an in-flight async checkpoint is durable (and its
@@ -666,6 +875,14 @@ class Engine:
             state["opt"] = self.state["opt"]
         if self._offload_opt:
             state["opt"] = self._opt_to_host(state["opt"])
+        if self._nvme_opt and load_optimizer_states:
+            resolved = tag
+            if resolved is None:
+                with open(os.path.join(load_dir, ckpt_mod.LATEST_FILE)) as f:
+                    resolved = f.read().strip()
+            swap_file = os.path.join(load_dir, str(resolved), "optswap.npz")
+            with np.load(swap_file) as z:
+                self._swapper.import_state({k: z[k] for k in z.files})
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
