@@ -11,18 +11,15 @@ equivalent via logical axes), the KV cache, and a compiled decode loop.
 """
 
 import dataclasses
-from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.config import Config
 from deepspeed_tpu.parallel import (
     MeshPlan, build_mesh, make_rules, spec_tree)
-from deepspeed_tpu.utils.logging import logger
 
 
 def init_inference(model, config=None, mesh=None, dtype=None, **kwargs):
@@ -85,16 +82,84 @@ class InferenceEngine:
                 params, self.param_shardings)
         self.params = params
 
-        self._forward = jax.jit(
-            lambda p, ids: model.apply(p, ids),
-            in_shardings=(self.param_shardings, NamedSharding(mesh, P("data"))))
-        self._decode = None  # built lazily by generate()
+        self._forward = jax.jit(lambda p, ids: model.apply(p, ids))
+        self._rules = rules
+        self._prefill_cache = {}   # (B, pad_prompt, max_len); prompt_len
+        # is a traced argument, NOT part of the compile key
+        self._decode_loop_cache = {}  # (B, max_len, n_steps, temperature)
+        self._init_cache_cache = {}   # (B, max_len)
+
+    def _batch_spec(self, batch_size: int) -> P:
+        """Shard batch over `data` only when it divides evenly (small ad-hoc
+        batches replicate instead of erroring)."""
+        dp = self.mesh.shape.get("data", 1)
+        return P("data") if dp > 1 and batch_size % dp == 0 else P()
+
+    def _cache_shardings(self, batch_size: int):
+        """KV cache shardings: batch over data (when divisible), kv heads over
+        tensor — the cache shards exactly like the attention weights do."""
+        if self.model.cache_axes is None:
+            return None
+        batch_axis = self._batch_spec(batch_size)
+        rules = type(self._rules)(
+            self._rules.rules
+            + (("batch", "data" if batch_axis else None),))
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            spec_tree(self.model.cache_axes(), rules),
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _init_cache(self, batch_size: int, max_len: int):
+        key = (batch_size, max_len)
+        init = self._init_cache_cache.get(key)
+        if init is None:
+            init = jax.jit(
+                lambda: self.model.init_cache(batch_size, max_len,
+                                              dtype=self.dtype),
+                out_shardings=self._cache_shardings(batch_size))
+            self._init_cache_cache[key] = init
+        with self.mesh:
+            return init()
+
+    def _cached_decode_fns(self, B, pad_prompt, prompt_len, max_len, n_steps,
+                           temperature):
+        """Two jitted programs, memoized per shape bucket (the reference gets
+        the same effect from CUDA-graph capture; here it is jit caching by
+        construction). The expensive decode scan is keyed only on
+        (B, max_len, n_steps, temperature); prefill on (B, pad_prompt,
+        max_len) with the true prompt length as a traced argument — a new
+        prompt length inside the same bucket compiles nothing."""
+        pkey = (B, pad_prompt, max_len)
+        prefill_raw = self._prefill_cache.get(pkey)
+        if prefill_raw is None:
+            data_sh = NamedSharding(self.mesh, self._batch_spec(B))
+            repl = NamedSharding(self.mesh, P())
+            prefill_raw = jax.jit(
+                lambda p, ids, cache, length: self.model.prefill(
+                    p, ids, cache, length=length),
+                in_shardings=(self.param_shardings, data_sh,
+                              self._cache_shardings(B), repl),
+                donate_argnums=(2,))
+            self._prefill_cache[pkey] = prefill_raw
+        prefill_fn = lambda p, ids, cache: prefill_raw(  # noqa: E731
+            p, ids, cache, jnp.int32(prompt_len))
+        dkey = (B, max_len, n_steps, temperature)
+        decode_fn = self._decode_loop_cache.get(dkey)
+        if decode_fn is None:
+            from deepspeed_tpu.inference.generation import make_decode_loop
+            loop = make_decode_loop(self.model, n_steps, temperature)
+            decode_fn = jax.jit(loop, donate_argnums=(2,))
+            self._decode_loop_cache[dkey] = decode_fn
+        return prefill_fn, decode_fn
 
     def forward(self, input_ids):
         """Full-sequence logits (prefill path)."""
         from deepspeed_tpu.parallel.context import set_parallel_context
         set_parallel_context(self.mesh, self._plan)
         input_ids = jnp.asarray(input_ids)
+        input_ids = jax.device_put(
+            input_ids,
+            NamedSharding(self.mesh, self._batch_spec(input_ids.shape[0])))
         with self.mesh:
             return self._forward(self.params, input_ids)
 

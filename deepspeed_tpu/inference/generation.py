@@ -1,19 +1,18 @@
-"""Decode loop.
+"""Decode loops.
 
-Fixed-shape buffer decode: the token buffer is padded to prompt+max_new
-rounded up, so the jitted forward compiles ONCE regardless of how many tokens
-are generated (causality guarantees the padding beyond the cursor cannot
-influence the logits that are read). The KV-cache incremental path (reference:
-``csrc/transformer/inference/.../inference_context.h`` workspace) lands with
-the cache manager; this full-recompute loop is the correct fallback and is
-O(n^2) in sequence, not in compiles.
+Primary path — KV cache (reference: the fixed decode workspace of
+``csrc/transformer/inference/includes/inference_context.h`` plus the
+incremental-forward contract of ``model_implementations/transformers/
+ds_transformer.py:18``): one jitted prefill seeds per-layer K/V ring buffers,
+then a single jitted ``lax.scan`` produces all new tokens — O(n) in sequence
+and exactly two compilations per (batch, bucket) shape.
+
+Fallback — fixed-shape full recompute for models without the cache protocol:
+the token buffer is padded so the forward compiles once; correct but O(n^2).
 """
-
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def _round_up(n: int, m: int = 64) -> int:
@@ -25,10 +24,80 @@ def generate(engine, input_ids, max_new_tokens: int = 32,
     ids = jnp.asarray(input_ids)
     if ids.ndim == 1:
         ids = ids[None]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # pin the ambient parallel context to THIS engine's mesh (a training loop
+    # may have left a seq/expert mesh active; tracing under it would mis-route
+    # attention to ring/sharded paths)
+    from deepspeed_tpu.parallel.context import set_parallel_context
+    set_parallel_context(engine.mesh, engine._plan)
+    model = engine.model
+    if (model.decode_step is not None and model.init_cache is not None
+            and model.prefill is not None):
+        return _generate_cached(engine, ids, max_new_tokens, temperature, rng)
+    return _generate_recompute(engine, ids, max_new_tokens, temperature, rng)
+
+
+def _generate_cached(engine, ids, max_new_tokens, temperature, rng):
+    B, prompt_len = ids.shape
+    # shape buckets: prompt padded to 64, token budget to 32 — so repeated
+    # calls with nearby sizes reuse the two compiled programs.
+    pad_prompt = _round_up(prompt_len)
+    n_steps = _round_up(max_new_tokens, 32)
+    max_len = pad_prompt + n_steps
+    cfg = getattr(engine.model, "config", None)
+    limit = getattr(cfg, "max_seq_len", None)
+    if limit:
+        if prompt_len + max_new_tokens > limit:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the model's max_seq_len {limit} (learned positions "
+                "/ cache would silently clamp)")
+        # shrink bucket padding to stay within the position table; decode
+        # steps beyond the valid range only touch rows that are discarded
+        pad_prompt = min(pad_prompt, limit)
+        max_len = min(max_len, limit)
+    buf = jnp.zeros((B, pad_prompt), ids.dtype).at[:, :prompt_len].set(ids)
+
+    prefill_fn, decode_fn = engine._cached_decode_fns(
+        B, pad_prompt, prompt_len, max_len, n_steps, float(temperature))
+    cache = engine._init_cache(B, max_len)
+    with engine.mesh:
+        last_logits, cache = prefill_fn(engine.params, buf, cache)
+        tokens = decode_fn(engine.params, last_logits, cache, rng)
+    out = jnp.concatenate([ids, tokens[:, :max_new_tokens].astype(ids.dtype)],
+                         axis=1)
+    return out
+
+
+def make_decode_loop(model, n_steps: int, temperature: float):
+    """Whole decode as one jittable program: scan over n_steps single-token
+    steps, sampling inside the scan (greedy at temperature 0)."""
+
+    def sample(logits, key):
+        if temperature and temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def loop(params, first_logits, cache, rng):
+        tok0 = sample(first_logits, rng)
+
+        def step(carry, key):
+            tok, cache = carry
+            logits, cache = model.decode_step(params, tok, cache)
+            nxt = sample(logits, key)
+            return (nxt, cache), tok
+
+        keys = jax.random.split(jax.random.fold_in(rng, 1), n_steps)
+        (_, _), toks = jax.lax.scan(step, (tok0, cache), keys)
+        return toks.T  # [n_steps, B] -> [B, n_steps]
+
+    return loop
+
+
+def _generate_recompute(engine, ids, max_new_tokens, temperature, rng):
     B, prompt_len = ids.shape
     total = _round_up(prompt_len + max_new_tokens)
     buf = jnp.zeros((B, total), ids.dtype).at[:, :prompt_len].set(ids)
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     for i in range(max_new_tokens):
         cur = prompt_len + i

@@ -1,5 +1,8 @@
 from deepspeed_tpu.models.transformer import (
     TransformerConfig, ModelSpec, make_model, gpt2_config, llama_config,
     mixtral_config, init_params, forward, lm_loss, cross_entropy_loss,
-    logical_axes,
+    logical_axes, init_cache, prefill, decode_step,
+)
+from deepspeed_tpu.models.hf_import import (
+    load_hf_params, export_hf_state_dict, hf_config_to_transformer,
 )

@@ -1,13 +1,22 @@
-"""Pipelined model wrapper: transformer + compiled pipeline schedule.
+"""Pipelined model wrapper: transformer + compiled pipeline schedules.
 
 Reference: ``runtime/pipe/module.py`` expresses the model as a layer list and
-``runtime/pipe/engine.py`` drives it; here the same transformer ModelSpec is
-re-wired so its scanned layer stack executes under
-parallel/pipeline.pipeline_spmd (layers sharded over `pipe`, microbatches
-rotated by ppermute). Embedding/head run replicated over pipe under GSPMD
-(they are sharded over tensor/fsdp as usual) — the equivalent of the
-reference's tied first/last stages without the TiedLayerSpec allreduce
-machinery (GSPMD keeps tied weights consistent by construction).
+``runtime/pipe/engine.py`` drives it with the 1F1B TrainSchedule
+(``runtime/pipe/schedule.py:186``); here the same transformer ModelSpec is
+re-wired so its scanned layer stack executes under the pipe mesh axis:
+
+- training: parallel/pipeline.make_pipeline_1f1b — loss AND grads from one
+  interleaved fwd/bwd tick loop (live activations bounded by ~2·stages);
+- inference/apply: parallel/pipeline.pipeline_spmd — forward-only GPipe
+  rotation (no backward, so 1F1B buys nothing there).
+
+Embedding runs on stage 0, the loss head on the last stage (both under
+`lax.cond`, so no stage wastes the other's FLOPs). Tied embeddings need no
+TiedLayerSpec allreduce machinery: the embed and head cotangents meet in the
+same psum over the pipe axis. Dropout and attention masks are supported
+(dropout RNG is derived deterministically from (microbatch, layer) so the
+1F1B backward's recompute sees the same mask). MoE layers inside the
+pipelined stack are still rejected — use pp=1 with expert parallelism.
 """
 
 import dataclasses
@@ -19,7 +28,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from deepspeed_tpu.models import transformer as T
-from deepspeed_tpu.parallel.pipeline import pipeline_spmd
+from deepspeed_tpu.parallel.pipeline import (
+    as_loss_fn, make_pipeline_1f1b, pipeline_spmd)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -27,56 +37,119 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
                          num_microbatches: int, name: str = "pipelined",
                          pipe_axis: str = "pipe") -> T.ModelSpec:
     n_stages = mesh.shape[pipe_axis]
+    M = num_microbatches
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pipeline stages={n_stages}")
-
     if cfg.num_experts > 1:
         raise NotImplementedError("MoE layers inside the pipelined stack are "
                                   "not supported yet (use pp=1 with EP)")
-    if cfg.dropout_rate > 0:
-        raise NotImplementedError("dropout inside the pipelined stack is not "
-                                  "supported yet (set dropout_rate=0)")
 
-    def stage_fn(stage_layers, x):
-        def body(carry, layer_p):
-            y, _aux = T.transformer_layer(carry, layer_p, cfg, deterministic=True)
-            return y, None
-        x, _ = jax.lax.scan(body, x, stage_layers)
+    remat_policy = T._remat_policy(cfg)
+    use_remat = cfg.remat or cfg.remat_policy not in ("none", None)
+
+    # ---------------- stage pieces (collective-free) ----------------
+    def embed_fn(other_params, tokens):
+        x = other_params["tok_embed"][tokens].astype(cfg.dtype)
+        if cfg.position_type == "learned":
+            S = tokens.shape[-1]
+            x = x + other_params["pos_embed"][jnp.arange(S)][None].astype(
+                cfg.dtype)
         return x
 
-    pipe_fn = pipeline_spmd(stage_fn, mesh, num_microbatches=num_microbatches,
-                            pipe_axis=pipe_axis,
-                            remat_stage=cfg.remat or cfg.remat_policy not in ("none", None))
+    def make_stage_fn(deterministic: bool):
+        has_dropout = (not deterministic) and cfg.dropout_rate > 0
+
+        def layer_body(carry, xs):
+            x, mask, rng = carry
+            layer_p, salt = xs
+            sub = jax.random.fold_in(rng, salt) if has_dropout else None
+            y, _aux = T.transformer_layer(
+                x, layer_p, cfg, mask=mask, dropout_rng=sub,
+                deterministic=deterministic)
+            return (y, mask, rng), None
+
+        def stage_fn(stage_layers, x, mb_idx, mask, rng):
+            n_local = jax.tree.leaves(stage_layers)[0].shape[0]
+            # globally-unique dropout salt per (microbatch, layer): the same
+            # salts reappear in the 1F1B backward's recompute, so the remat
+            # sees identical masks
+            try:
+                s_idx = jax.lax.axis_index(pipe_axis)
+            except NameError:  # outside shard_map (direct stage call)
+                s_idx = 0
+            salts = (mb_idx * cfg.num_layers + s_idx * n_local
+                     + jnp.arange(n_local))
+            body = layer_body
+            if use_remat:
+                body = jax.checkpoint(body, policy=remat_policy,
+                                      prevent_cse=False)
+            rng_mb = rng if has_dropout else jnp.zeros((2,), jnp.uint32)
+            (y, _, _), _ = jax.lax.scan(body, (x, mask, rng_mb),
+                                        (stage_layers, salts))
+            return y, jnp.float32(0.0)
+
+        return stage_fn
+
+    def head_loss_fn(other_params, y, labels):
+        y = T._norm(y, other_params["final_norm_scale"],
+                    other_params.get("final_norm_bias"), cfg)
+        head = other_params.get("lm_head")
+        if head is None:
+            head = other_params["tok_embed"].T
+        logits = (y @ head.astype(y.dtype)).astype(jnp.float32)
+        return T.cross_entropy_loss(logits, labels)
+
+    pipe_train = as_loss_fn(make_pipeline_1f1b(
+        embed_fn, make_stage_fn(deterministic=False), head_loss_fn, mesh,
+        num_microbatches=M, pipe_axis=pipe_axis))
+    pipe_eval = as_loss_fn(make_pipeline_1f1b(
+        embed_fn, make_stage_fn(deterministic=True), head_loss_fn, mesh,
+        num_microbatches=M, pipe_axis=pipe_axis))
+
+    # ---------------- forward-only (inference/apply) ----------------
+    fwd_stage = make_stage_fn(deterministic=True)
+    pipe_fwd = pipeline_spmd(
+        lambda sp, x: fwd_stage(sp, x, 0, None, None)[0], mesh,
+        num_microbatches=M, pipe_axis=pipe_axis, remat_stage=False)
 
     def forward(params, input_ids, **kw):
         B, S = input_ids.shape
-        M = num_microbatches
         if B % M:
             raise ValueError(f"batch {B} not divisible by microbatches {M}")
-        x = params["tok_embed"][input_ids].astype(cfg.dtype)
-        if cfg.position_type == "learned":
-            x = x + params["pos_embed"][jnp.arange(S)][None].astype(cfg.dtype)
+        other = {k: v for k, v in params.items() if k != "layers"}
+        x = embed_fn(other, input_ids)
         x_mb = x.reshape(M, B // M, S, -1)
-        y_mb = pipe_fn(params["layers"], x_mb)
+        y_mb = pipe_fwd(params["layers"], x_mb)
         y = y_mb.reshape(B, S, -1)
-        y = T._norm(y, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+        y = T._norm(y, params["final_norm_scale"],
+                    params.get("final_norm_bias"), cfg)
         head = params.get("lm_head")
         if head is None:
             head = params["tok_embed"].T
         return (y @ head.astype(y.dtype)).astype(jnp.float32)
 
     def loss_fn(params, batch, rng=None, deterministic=True):
-        if batch.get("attention_mask") is not None:
-            raise NotImplementedError("attention_mask is not supported in "
-                                      "pipeline mode yet (causal only)")
         ids = batch["input_ids"]
+        B, S = ids.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.concatenate(
-                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
-        logits = forward(params, ids)
-        return T.cross_entropy_loss(logits, labels)
+                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)],
+                axis=1)
+        mask = batch.get("attention_mask")
+        mb = B // M
+        tokens_mb = ids.reshape(M, mb, S)
+        labels_mb = labels.reshape(M, mb, S)
+        mask_mb = (None if mask is None
+                   else mask.reshape(M, mb, S).astype(jnp.bool_))
+        rng_arr = rng if rng is not None else jax.random.PRNGKey(0)
+        fn = pipe_eval if (deterministic or rng is None) else pipe_train
+        sp = params["layers"]
+        other = {k: v for k, v in params.items() if k != "layers"}
+        return fn(sp, other, tokens_mb, labels_mb, mask_mb, rng_arr)
 
     return T.ModelSpec(
         init=lambda key: T.init_params(key, cfg),

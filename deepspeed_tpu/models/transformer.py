@@ -345,9 +345,36 @@ def _activation(x, gate, cfg: TransformerConfig):
     return jax.nn.gelu(x)
 
 
+def _decode_attention(q, ck, cv, index):
+    """Single-token GQA attention against a KV ring buffer, with NO repeat of
+    the kv heads in memory (reference's decode kernels repeat in registers:
+    ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``; here the
+    grouped einsum keeps HBM traffic at the true kv size).
+
+    q: [B, 1, Nq, D]; ck/cv: [B, T, Nkv, D]; index: current position (scalar).
+    """
+    B, _, Nq, D = q.shape
+    T, Nkv = ck.shape[1], ck.shape[2]
+    rep = Nq // Nkv
+    qg = q.reshape(B, Nkv, rep, D)
+    scores = jnp.einsum("bgrd,btgd->bgrt", qg, ck).astype(jnp.float32)
+    scores = scores / math.sqrt(D)
+    valid = (jnp.arange(T) <= index)[None, None, None, :]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrt,btgd->bgrd", probs, cv)
+    return out.reshape(B, 1, Nq, D)
+
+
 def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
-                      positions=None, dropout_rng=None, deterministic=True):
-    """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+                      positions=None, dropout_rng=None, deterministic=True,
+                      cache=None, return_kv: bool = False):
+    """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x)).
+
+    cache=(ck, cv, index): decode mode — x is [B, 1, H], the new K/V row is
+    written at `index` and attention runs over the buffer. return_kv: also
+    return the (post-rotary) K/V so a prefill pass can seed the cache.
+    """
     p = layer_params
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
@@ -366,7 +393,17 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
             positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
         q = rotary_embed(q, positions, cfg.rope_theta)
         k = rotary_embed(k, positions, cfg.rope_theta)
-    attn_out = attention(q, k, v, mask=mask, causal=True, cfg=cfg)
+    new_kv = None
+    if cache is not None:
+        ck, cv, index = cache
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
+        attn_out = _decode_attention(q, ck, cv, index)
+        new_kv = (ck, cv)
+    else:
+        if return_kv:
+            new_kv = (k, v)
+        attn_out = attention(q, k, v, mask=mask, causal=True, cfg=cfg)
     attn_out = attn_out.reshape(B, S, nh * hd) @ p["wo"].astype(h.dtype)
     if "bo" in p:
         attn_out = attn_out + p["bo"].astype(h.dtype)
@@ -406,6 +443,8 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         if "b_out" in p:
             out = out + p["b_out"].astype(h.dtype)
     x = x + _dropout(out, cfg, dropout_rng, deterministic, 1)
+    if cache is not None or return_kv:
+        return x, aux, new_kv
     return x, aux
 
 
@@ -438,11 +477,25 @@ def _remat_policy(cfg: TransformerConfig):
     return policies.get(cfg.remat_policy)
 
 
+def _fetch_layer(layer_p, cfg: TransformerConfig):
+    """ZeRO-Infinity param residency: move ONE layer's weights host -> HBM.
+    Inside the remat region backward re-fetches instead of keeping them live.
+    Host copies stay fp32 (sub-word host DMA is broken on some TPU
+    transports); cast to compute dtype after the transfer. NOTE for decode:
+    this runs per generated token — offloaded decode is host-DMA-bound."""
+    from jax.memory import Space
+    return jax.tree.map(
+        lambda a: jax.device_put(a, Space.Device).astype(cfg.dtype), layer_p)
+
+
 def forward(params: Params, input_ids, cfg: TransformerConfig, *,
             attention_mask=None, positions=None, dropout_rng=None,
             deterministic: bool = True, layer_override=None,
-            return_aux: bool = False):
-    """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32)."""
+            return_aux: bool = False, return_kv: bool = False):
+    """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32).
+
+    return_kv: also return the per-layer (post-rotary) K/V stacked on a
+    leading layer dim — the prefill path's cache seed."""
     B, S = input_ids.shape
     x = params["tok_embed"][input_ids].astype(cfg.dtype)
     if cfg.position_type == "learned":
@@ -454,43 +507,49 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     def body(carry, layer_p):
         x_c, rng, aux_acc = carry
         if cfg.offload_params:
-            # host -> HBM move for this layer only; sits inside the remat
-            # region so backward re-fetches instead of keeping it live.
-            # Host copies stay fp32 (sub-word host DMA is broken on some
-            # TPU transports); cast to compute dtype after the transfer.
-            from jax.memory import Space
-            layer_p = jax.tree.map(
-                lambda a: jax.device_put(a, Space.Device).astype(cfg.dtype),
-                layer_p)
+            layer_p = _fetch_layer(layer_p, cfg)
         if rng is not None:
             rng, sub = jax.random.split(rng)
         else:
             sub = None
-        y, aux = transformer_layer(x_c, layer_p, cfg, mask=attention_mask,
-                                   positions=positions, dropout_rng=sub,
-                                   deterministic=deterministic)
-        return (y, rng, aux_acc + aux), None
+        out = transformer_layer(x_c, layer_p, cfg, mask=attention_mask,
+                                positions=positions, dropout_rng=sub,
+                                deterministic=deterministic,
+                                return_kv=return_kv)
+        if return_kv:
+            y, aux, kv = out
+        else:
+            (y, aux), kv = out, None
+        return (y, rng, aux_acc + aux), kv
 
     if cfg.remat or cfg.remat_policy not in ("none", None):
         policy = _remat_policy(cfg)
         body = jax.checkpoint(body, policy=policy, prevent_cse=False)
 
     aux_total = jnp.float32(0.0)
+    kv_stack = None
     if cfg.scan_layers:
-        (x, _, aux_total), _ = lax.scan(body, (x, dropout_rng, aux_total), layers)
+        (x, _, aux_total), kv_stack = lax.scan(
+            body, (x, dropout_rng, aux_total), layers)
     else:
         n_layers = jax.tree.leaves(layers)[0].shape[0]
         carry = (x, dropout_rng, aux_total)
+        kvs = []
         for i in range(n_layers):
             layer_p = jax.tree.map(lambda a: a[i], layers)
-            carry, _ = body(carry, layer_p)
+            carry, kv = body(carry, layer_p)
+            kvs.append(kv)
         x, aux_total = carry[0], carry[2]
+        if return_kv:
+            kv_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *kvs)
 
     x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["tok_embed"].T
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if return_kv:
+        return logits, kv_stack
     if return_aux:
         return logits, aux_total
     return logits
@@ -507,6 +566,100 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * valid
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+# --------------------------------------------------------------------------
+# KV-cache decode (reference: csrc/transformer/inference/includes/
+# inference_context.h — the fixed workspace the decode kernels write K/V
+# into — and model_implementations/transformers/ds_transformer.py:18)
+# --------------------------------------------------------------------------
+
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
+               dtype=None) -> Params:
+    """Preallocated KV buffers [L, B, max_len, n_kv, head_dim] + cursor.
+
+    Fixed shapes so prefill/decode each compile exactly once; the kv-head dim
+    carries the "heads" logical axis so TP shards the cache like the weights.
+    """
+    dtype = dtype or cfg.dtype
+    L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
+    return {
+        "k": jnp.zeros((L, batch_size, max_len, nkv, hd), dtype),
+        "v": jnp.zeros((L, batch_size, max_len, nkv, hd), dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def cache_logical_axes() -> Params:
+    return {"k": ("layers", "batch", None, "heads", None),
+            "v": ("layers", "batch", None, "heads", None),
+            "index": None}
+
+
+def prefill(params: Params, input_ids, cfg: TransformerConfig, cache: Params,
+            attention_mask=None, length: Optional[int] = None
+            ) -> Tuple[jnp.ndarray, Params]:
+    """Process the prompt, seed the cache, return logits at the last real
+    position [B, V].
+
+    The prompt K/V come out of the same scan that computes the logits (the ys
+    of the layer scan), so prefill costs one forward pass. `length` marks the
+    true prompt length when input_ids is right-padded for shape bucketing:
+    causality keeps logits at length-1 exact, and the cursor is set so decode
+    overwrites the pad rows before they can ever be attended.
+    """
+    logits, kv = forward(params, input_ids, cfg, attention_mask=attention_mask,
+                         return_kv=True)
+    S = input_ids.shape[1]
+    # traced length is fine: the index ops below are dynamic, so one program
+    # serves every prompt length in the same padded-shape bucket
+    true_len = jnp.asarray(S if length is None else length, jnp.int32)
+    k, v = kv  # [L, B, S, nkv, hd]
+    new_cache = {
+        "k": lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
+        "index": true_len,
+    }
+    last = lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                    keepdims=False)
+    return last, new_cache
+
+
+def decode_step(params: Params, token, cfg: TransformerConfig,
+                cache: Params) -> Tuple[jnp.ndarray, Params]:
+    """One incremental decode step. token: [B] or [B,1] int32 -> logits [B, V].
+
+    O(cache_len) per token (vs O(n^2) full recompute); the layer scan carries
+    each layer's cache slice through `xs` and re-stacks the updated buffers.
+    """
+    if token.ndim == 1:
+        token = token[:, None]
+    B = token.shape[0]
+    index = cache["index"]
+    x = params["tok_embed"][token].astype(cfg.dtype)
+    if cfg.position_type == "learned":
+        x = x + params["pos_embed"][index[None, None]].astype(cfg.dtype)
+    positions = jnp.broadcast_to(index[None, None], (B, 1))
+
+    def body(x_c, xs):
+        layer_p, ck, cv = xs
+        if cfg.offload_params:
+            layer_p = _fetch_layer(layer_p, cfg)
+        y, _, (nck, ncv) = transformer_layer(
+            x_c, layer_p, cfg, positions=positions, deterministic=True,
+            cache=(ck, cv, index), return_kv=False)
+        return y, (nck, ncv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"],
+                                           cache["v"]))
+    x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["tok_embed"].T
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits[:, 0, :], {"k": new_k, "v": new_v, "index": index + 1}
 
 
 def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
@@ -541,6 +694,14 @@ class ModelSpec:
     logical_axes: Params
     config: Any = None
     name: str = "model"
+    # KV-cache decode protocol (None -> InferenceEngine falls back to
+    # full-recompute). init_cache(batch, max_len) -> cache;
+    # prefill(params, ids, cache) -> (last_logits, cache);
+    # decode_step(params, token, cache) -> (logits, cache).
+    init_cache: Optional[Callable[..., Params]] = None
+    prefill: Optional[Callable[..., Tuple[jnp.ndarray, Params]]] = None
+    decode_step: Optional[Callable[..., Tuple[jnp.ndarray, Params]]] = None
+    cache_axes: Optional[Callable[[], Params]] = None
 
     def flops_per_token(self) -> float:
         """Approximate train FLOPs/token (6N rule + attention)."""
@@ -565,4 +726,11 @@ def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
         logical_axes=logical_axes(cfg),
         config=cfg,
         name=name,
+        init_cache=lambda batch_size, max_len, dtype=None:
+            init_cache(cfg, batch_size, max_len, dtype=dtype),
+        prefill=lambda params, input_ids, cache, **kw:
+            prefill(params, input_ids, cfg, cache, **kw),
+        decode_step=lambda params, token, cache:
+            decode_step(params, token, cfg, cache),
+        cache_axes=cache_logical_axes,
     )

@@ -103,3 +103,224 @@ def bubble_fraction(num_microbatches: int, stages: int) -> float:
     """Pipeline bubble overhead of the compiled schedule (same as GPipe/1F1B
     forward bubble: (P-1)/(M+P-1))."""
     return (stages - 1) / (num_microbatches + stages - 1)
+
+
+# --------------------------------------------------------------------------
+# 1F1B training schedule
+# --------------------------------------------------------------------------
+
+def _tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def make_pipeline_1f1b(embed_fn: Callable, stage_fn: Callable,
+                       head_loss_fn: Callable, mesh: Mesh, *,
+                       num_microbatches: int, aux_weight: float = 0.0,
+                       pipe_axis: str = "pipe"):
+    """Compiled 1F1B schedule producing loss AND grads in one interleaved
+    tick loop.
+
+    Reference: ``runtime/pipe/schedule.py:186`` (TrainSchedule — the 1F1B
+    instruction stream that bounds live activations to ~stages instead of
+    ~microbatches) executed by ``runtime/pipe/engine.py:37``.
+
+    TPU-native re-design: the reference interprets per-rank instruction lists
+    in Python with eager p2p. Here one `lax.scan` over M + 2(P-1) ticks runs
+    inside `shard_map` over the pipe axis; each tick every stage does (at
+    most) one microbatch FORWARD and one microbatch BACKWARD — the backward
+    via a local `jax.vjp` of the stage (which recomputes the stage forward:
+    remat by construction), with `lax.ppermute` carrying activations down
+    and cotangents up the pipe. Stage inputs wait in a ring buffer of 2P
+    slots, so peak live activations are O(P) microbatches vs O(M) for the
+    all-forward-then-backward autodiff schedule. The loss head runs under a
+    `lax.cond` on the last stage only (TPU control flow is per-core; the
+    branches contain no collectives, so non-uniform predicates are legal and
+    the head matmul is NOT wasted on every stage).
+
+    Schedule (stage s, microbatch i, P stages):
+        forward  tick  f(s, i) = s + i
+        backward tick  b(s, i) = 2(P-1) - s + i
+    — the last stage backpropagates a microbatch in the same tick it
+    forwards it, earlier stages 2 ticks later per hop; in steady state each
+    tick is exactly one F and one B (hence the name).
+
+    Contracts (all collective-free so they can sit under `lax.cond`):
+        embed_fn(other_params, tokens[mb,S]) -> x [mb,S,H]
+        stage_fn(stage_params, x, mb_idx, mask, rng) -> (y [mb,S,H], aux)
+        head_loss_fn(other_params, y, labels[mb,S]) -> scalar mean loss
+    Returns loss_and_grads(stage_params, other_params, tokens [M,mb,S],
+    labels [M,mb,S], mask [M,mb,S]|None, rng) -> (loss, dstage, dother);
+    wrap with `as_loss_fn` for a jax.grad-compatible scalar loss.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    M = num_microbatches
+    Pn = n_stages
+    R = 2 * Pn
+    T = M + 2 * (Pn - 1)
+    fwd_perm = [(i, i + 1) for i in range(Pn - 1)]
+    bwd_perm = [(i + 1, i) for i in range(Pn - 1)]
+
+    def body(stage_params, other_params, tokens, labels, mask, rng):
+        s = lax.axis_index(pipe_axis)
+        is_first = s == 0
+        is_last = s == Pn - 1
+
+        x0 = embed_fn(other_params, tokens[0])  # shape/dtype probe (cheap)
+        mb_shape, mb_dtype = x0.shape, x0.dtype
+        zeros_other = _tree_zeros_like(other_params)
+
+        def run_stage(sp, x, mb_idx):
+            return stage_fn(sp, x, mb_idx,
+                            None if mask is None else mask[mb_idx], rng)
+
+        def tick(carry, t):
+            fwd_recv, bwd_recv, ring, dstage, dother, loss_sum = carry
+
+            # ---------------- forward subtick ----------------
+            f_i = t - s
+            f_valid = jnp.logical_and(f_i >= 0, f_i < M)
+            f_ic = jnp.clip(f_i, 0, M - 1)
+            tok_f = lax.dynamic_index_in_dim(tokens, f_ic, 0, keepdims=False)
+            lab_f = lax.dynamic_index_in_dim(labels, f_ic, 0, keepdims=False)
+            # real branch: the gather only runs on stage 0 (collective-free)
+            x_in = lax.cond(is_first,
+                            lambda r: embed_fn(other_params, tok_f).astype(
+                                mb_dtype),
+                            lambda r: r, fwd_recv)
+
+            y, aux = lax.cond(
+                f_valid,
+                lambda x: run_stage(stage_params, x, f_ic),
+                lambda x: (jnp.zeros(mb_shape, mb_dtype), jnp.float32(0.0)),
+                x_in)
+            loss_sum = loss_sum + jnp.where(f_valid,
+                                            (aux_weight / M) * aux, 0.0)
+
+            slot = jnp.mod(f_ic, R)
+            old = lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
+            ring = lax.dynamic_update_index_in_dim(
+                ring, jnp.where(f_valid, x_in, old), slot, 0)
+
+            # loss head + backward seed — last stage only (real branch:
+            # collective-free, so neither the head matmul nor the grad
+            # accumulation into dother runs on the other P-1 stages)
+            def head_branch(ops):
+                yy, lab, acc = ops
+                loss_mb, pull = jax.vjp(
+                    lambda op, a: head_loss_fn(op, a, lab), other_params, yy)
+                dop, dy = pull(jnp.float32(1.0 / M))
+                return loss_mb / M, _tree_add(acc, dop), dy
+
+            def head_zero(ops):
+                yy, _, acc = ops
+                return jnp.float32(0.0), acc, jnp.zeros_like(yy)
+
+            loss_mb, dother, dy = lax.cond(
+                jnp.logical_and(is_last, f_valid), head_branch, head_zero,
+                (y, lab_f, dother))
+            loss_sum = loss_sum + loss_mb
+
+            # ---------------- backward subtick ----------------
+            b_i = t - 2 * (Pn - 1) + s
+            b_valid = jnp.logical_and(b_i >= 0, b_i < M)
+            b_ic = jnp.clip(b_i, 0, M - 1)
+            x_b = lax.dynamic_index_in_dim(ring, jnp.mod(b_ic, R), 0,
+                                           keepdims=False)
+            g_in = jnp.where(is_last, dy, bwd_recv)
+            tok_b = lax.dynamic_index_in_dim(tokens, b_ic, 0, keepdims=False)
+
+            def b_branch(ops):
+                xb, g, acc = ops
+                _, pull = jax.vjp(
+                    lambda sp, xx: run_stage(sp, xx, b_ic), stage_params, xb)
+                dsp, dx = pull((g, jnp.float32(aux_weight / M)))
+                return _tree_add(acc, dsp), dx
+
+            def b_zero(ops):
+                xb, _, acc = ops
+                return acc, jnp.zeros_like(xb)
+
+            dstage, dx = lax.cond(b_valid, b_branch, b_zero,
+                                  (x_b, g_in, dstage))
+
+            # embedding backward — first stage only (recomputes the gather;
+            # the accumulation also only runs there)
+            def e_branch(ops):
+                d, acc = ops
+                _, pull = jax.vjp(lambda op: embed_fn(op, tok_b), other_params)
+                return _tree_add(acc, pull(d)[0])
+
+            dother = lax.cond(
+                jnp.logical_and(b_valid, is_first), e_branch,
+                lambda ops: ops[1], (dx, dother))
+
+            # ---------------- communication (outside all conds) -----------
+            if Pn > 1:
+                fwd_recv = lax.ppermute(y, pipe_axis, fwd_perm)
+                bwd_recv = lax.ppermute(dx, pipe_axis, bwd_perm)
+            else:
+                fwd_recv, bwd_recv = y, dx
+            return (fwd_recv, bwd_recv, ring, dstage, dother, loss_sum), None
+
+        carry0 = (jnp.zeros(mb_shape, mb_dtype),
+                  jnp.zeros(mb_shape, mb_dtype),
+                  jnp.zeros((R,) + mb_shape, mb_dtype),
+                  _tree_zeros_like(stage_params),
+                  zeros_other,
+                  jnp.float32(0.0))
+        (_, _, _, dstage, dother, loss_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        loss = lax.psum(loss_sum, pipe_axis)
+        # reduce in f32: better accumulation numerics for bf16 grads, and it
+        # sidesteps an XLA-CPU AllReducePromotion crash on bf16 all-reduce
+        dother = jax.tree.map(
+            lambda a: lax.psum(a.astype(jnp.float32), pipe_axis).astype(
+                a.dtype), dother)
+        return loss, dstage, dother
+
+    mask_spec = P()
+    wrapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(pipe_axis), P(), P(), P(), mask_spec, P()),
+        out_specs=(P(), P(pipe_axis), P()),
+        axis_names={pipe_axis},
+        check_vma=False)
+    return wrapped
+
+
+def as_loss_fn(pipeline_fn):
+    """Wrap make_pipeline_1f1b's output as scalar-loss fn for jax.grad: the
+    grads computed inside the schedule become the custom-vjp cotangents."""
+    import numpy as np
+
+    def _zero_ct(x):
+        return jax.tree.map(
+            lambda a: np.zeros(a.shape, jax.dtypes.float0)
+            if not jnp.issubdtype(a.dtype, jnp.floating)
+            else jnp.zeros_like(a), x)
+
+    @jax.custom_vjp
+    def ploss(stage_params, other_params, tokens, labels, mask, rng):
+        loss, _, _ = pipeline_fn(stage_params, other_params, tokens, labels,
+                                 mask, rng)
+        return loss
+
+    def fwd(stage_params, other_params, tokens, labels, mask, rng):
+        loss, dsp, dop = pipeline_fn(stage_params, other_params, tokens,
+                                     labels, mask, rng)
+        return loss, (dsp, dop, tokens, labels, mask, rng)
+
+    def bwd(res, g):
+        dsp, dop, tokens, labels, mask, rng = res
+        scale = lambda t: jax.tree.map(  # noqa: E731
+            lambda a: (a.astype(jnp.float32) * g).astype(a.dtype), t)
+        return (scale(dsp), scale(dop), _zero_ct(tokens), _zero_ct(labels),
+                _zero_ct(mask), _zero_ct(rng))
+
+    ploss.defvjp(fwd, bwd)
+    return ploss

@@ -74,6 +74,101 @@ class TestPipelineParity:
                                      config=ds_cfg(pipeline={"stages": 2}))
 
 
+class Test1F1B:
+    """The interleaved fwd/bwd schedule (reference: runtime/pipe/schedule.py
+    TrainSchedule) — grads must match plain autodiff of the unpipelined
+    loss, and the lifted restrictions (mask, dropout) must work."""
+
+    def test_grads_match_unpipelined(self, devices8):
+        from deepspeed_tpu.models.pipeline_wrapper import make_pipelined_model
+        from deepspeed_tpu.models.transformer import init_params, lm_loss
+        from deepspeed_tpu.parallel import MeshPlan, build_mesh
+        cfg = tiny_cfg()
+        mesh = build_mesh(MeshPlan(pipe=4, data=2))
+        pmodel = make_pipelined_model(cfg, mesh, num_microbatches=8)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        batch = make_batch(16, 32, vocab=64, seed=3)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+
+        with mesh:
+            loss_pp, grads_pp = jax.jit(jax.value_and_grad(
+                lambda p: pmodel.loss_fn(p, batch)))(params)
+        # reference: per-microbatch CE means averaged over M (gas semantics)
+        def ref_loss(p):
+            ids = batch["input_ids"].reshape(8, 2, 32)
+            losses = [lm_loss(p, {"input_ids": ids[i]}, cfg) for i in range(8)]
+            return sum(losses) / 8
+        loss_ref, grads_ref = jax.jit(jax.value_and_grad(ref_loss))(params)
+        np.testing.assert_allclose(float(loss_pp), float(loss_ref),
+                                   rtol=1e-5, atol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5),
+            grads_pp, grads_ref)
+
+    def test_pp_with_attention_mask(self):
+        """Padding masks are supported in pipeline mode now."""
+        model = make_model(tiny_cfg())
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, config=ds_cfg(pipeline={"stages": 2}))
+        b = make_batch(32, 32, vocab=64, seed=1)
+        mask = np.ones((32, 32), np.int32)
+        mask[:, 24:] = 0
+        b["attention_mask"] = mask
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(3)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_pp_with_dropout(self):
+        """Dropout inside the pipelined stack is supported now; the 1F1B
+        backward recompute must see the same masks (finite, decreasing)."""
+        model = make_model(tiny_cfg(dropout_rate=0.1))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, config=ds_cfg(pipeline={"stages": 2}))
+        b = make_batch(32, 32, vocab=64, seed=2)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(5)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_pp_bf16_with_tp(self):
+        """bf16 grads psum'd over pipe (regression: XLA-CPU bf16 all-reduce
+        promotion crash — grads now reduce in f32)."""
+        model = make_model(tiny_cfg(dtype=jnp.bfloat16))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, config=ds_cfg(pipeline={"stages": 2},
+                                       tensor_parallel={"size": 2},
+                                       bf16={"enabled": True}))
+        b = make_batch(32, 32, vocab=64, seed=4)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(4)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_live_activation_bound(self, devices8):
+        """1F1B memory contract: the compiled train program's live-buffer
+        requirement must NOT grow with microbatch count M (GPipe's does)."""
+        from deepspeed_tpu.models.pipeline_wrapper import make_pipelined_model
+        from deepspeed_tpu.models.transformer import init_params
+        from deepspeed_tpu.parallel import MeshPlan, build_mesh
+        cfg = tiny_cfg()
+        mesh = build_mesh(MeshPlan(pipe=4, data=2))
+
+        def peak_bytes(M):
+            pmodel = make_pipelined_model(cfg, mesh, num_microbatches=M)
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            batch = {"input_ids": jnp.asarray(
+                make_batch(2 * M, 32, vocab=64)["input_ids"])}
+            with mesh:
+                lowered = jax.jit(jax.grad(
+                    lambda p: pmodel.loss_fn(p, batch))).lower(params)
+                compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+                pytest.skip("memory_analysis unavailable on this backend")
+            return ma.temp_size_in_bytes
+
+        m8, m16 = peak_bytes(8), peak_bytes(16)
+        # batch doubles with M (mb held at 2): allow growth for the batch
+        # itself but temp must stay well below proportional scaling
+        assert m16 < 1.5 * m8, (m8, m16)
+
+
 def test_bubble_fraction():
     assert bubble_fraction(1, 1) == 0.0
     assert abs(bubble_fraction(4, 2) - 1 / 5) < 1e-9
