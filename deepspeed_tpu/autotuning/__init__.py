@@ -1,0 +1,1 @@
+from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune_config

@@ -1,0 +1,88 @@
+"""1-bit compressed collectives.
+
+Reference: ``deepspeed/runtime/comm/nccl.py:53`` (NcclBackend.
+compressed_allreduce — sign-compress to 1 bit/element with per-tensor scale,
+allgather the packed bits + scales, decompress and reduce locally) and the
+MPI twin in ``runtime/comm/mpi.py``.
+
+TPU-native: the packing is a reshape + dot with bit weights (VPU work XLA
+vectorizes), the wire op is a single `lax.all_gather` of uint8 over the
+named mesh axis — 1/32nd the bytes of an f32 all-reduce ring pass. Used from
+inside a `shard_map` region whose grads are per-device local (the engine's
+compressed-optimizer step path).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pack_signs", "unpack_signs", "compressed_allreduce_1bit",
+           "compressed_bytes"]
+
+
+def pack_signs(x) -> Tuple[jnp.ndarray, int]:
+    """Sign-bit pack a float tensor into uint8 (8 elements/byte).
+
+    Returns (packed [ceil(N/8)] uint8, original element count). The sign
+    convention is bit=1 for x >= 0, so exact zeros decompress to +1 — the
+    reference's torch.sign maps 0 -> 0, but 0-valued momentum+error is
+    measure-zero after warmup and the error feedback absorbs the difference.
+    """
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % 8
+    bits = (flat >= 0).astype(jnp.uint8)
+    bits = jnp.pad(bits, (0, pad))
+    bits = bits.reshape(-1, 8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8)).astype(jnp.uint8)
+    packed = (bits * weights[None, :]).sum(axis=1, dtype=jnp.uint8)
+    return packed, n
+
+
+def unpack_signs(packed, n: int) -> jnp.ndarray:
+    """Inverse of pack_signs -> f32 tensor of +-1, first n elements."""
+    bits = jnp.bitwise_and(
+        packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :], 1)
+    signs = bits.astype(jnp.float32) * 2.0 - 1.0
+    return signs.reshape(-1)[:n]
+
+
+def compressed_allreduce_1bit(x, axis_name: str):
+    """Mean over `axis_name` of sign(x)*scale(x), moving only packed sign
+    bits + one f32 scale per participant across the wire.
+
+    x: per-device local f32 tensor (any shape). Returns the decompressed
+    average, identical on every participant (so parameters stay in sync).
+    Wire volume: N/8 bytes + 4, vs 4N (x2 for ring) dense — ~16-32x less.
+    """
+    shape = x.shape
+    scale = jnp.mean(jnp.abs(x))
+    packed, n = pack_signs(x)
+    from deepspeed_tpu.comm.comm import comms_logger
+    comms_logger.record("all_gather_1bit", axis_name,
+                        int(packed.size) + 4)
+    all_packed = lax.all_gather(packed, axis_name)        # [W, ceil(N/8)]
+    all_scales = lax.all_gather(scale, axis_name)         # [W]
+    W = all_scales.shape[0]
+
+    # accumulate worker-by-worker: peak memory stays O(N), not O(W*N)
+    def body(w, acc):
+        return acc + unpack_signs(all_packed[w], n) * all_scales[w]
+
+    init = jnp.zeros((n,), jnp.float32)
+    try:  # under strict shard_map VMA checking the carry must be marked
+        init = lax.pvary(init, axis_name)  # device-varying like the operands
+    except (AttributeError, NameError):
+        pass
+    avg = lax.fori_loop(0, W, body, init) / W
+    return avg.reshape(shape)
+
+
+def compressed_bytes(x) -> int:
+    """Wire bytes for one participant's contribution (packed bits + scale)."""
+    n = 1
+    for d in x.shape:
+        n *= d
+    return (n + 7) // 8 + 4

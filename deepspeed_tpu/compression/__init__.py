@@ -1,0 +1,2 @@
+from deepspeed_tpu.compression.compress import (
+    CompressionTransform, init_compression, redundancy_clean)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityError, compute_elastic_config, get_compatible_gpus)

@@ -1,0 +1,104 @@
+"""Elastic training: batch-size/world-size co-design.
+
+Reference: ``deepspeed/elasticity/elasticity.py:231``
+(compute_elastic_config — picks a global batch size compatible with the
+widest range of GPU counts, given candidate micro-batch sizes and a max
+acceptable batch) and ``elastic_agent.py`` (the torch elastic rendezvous
+driver).
+
+TPU-native scoping: the scheduling half (rendezvous, scale events) belongs
+to the cluster layer (GKE/Borg restart the job; our checkpoints are
+elastic-by-construction — test_elastic_restore_across_zero_stage proves a
+stage-0 save restores into stage-3 on a different mesh). What remains
+load-bearing is the batch arithmetic below, which initialize() runs when
+`elasticity.enabled` to pin a chip-count-compatible global batch.
+"""
+
+from typing import Dict, List, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def _candidate_batches(micro_batches: Sequence[int], max_batch: int
+                       ) -> List[int]:
+    """Highly-divisible candidates: for each micro batch, powers-of-two and
+    small-composite multiples up to max_batch (reference:
+    _get_candidate_batch_sizes uses HCN multiples the same way)."""
+    base = [1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
+            384, 512, 768, 1024, 1536, 2048]
+    out = set()
+    for mbs in micro_batches:
+        for m in base:
+            if mbs * m <= max_batch:
+                out.add(mbs * m)
+    if not out:
+        raise ElasticityError(
+            f"no candidate batch size fits max_train_batch_size={max_batch} "
+            f"with micro_batch_sizes={list(micro_batches)}")
+    return sorted(out)
+
+
+def get_compatible_gpus(batch: int, micro_batches: Sequence[int],
+                        min_gpus: int, max_gpus: int) -> List[int]:
+    """Device counts that can run `batch` exactly: batch % (g * mbs) == 0
+    for some micro batch (reference: _get_compatible_gpus_v01)."""
+    out = []
+    for g in range(min_gpus, max_gpus + 1):
+        if any(batch % (g * mbs) == 0 for mbs in micro_batches):
+            out.append(g)
+    return out
+
+
+def compute_elastic_config(elastic_cfg: Dict, world_size: int = 0
+                           ) -> Tuple[int, List[int], int]:
+    """Pick (final_batch_size, valid_gpus, micro_batch_for_world_size).
+
+    Chooses the candidate batch compatible with the MOST device counts in
+    [min_gpus, max_gpus]; prefer_larger_batch breaks ties upward. When
+    world_size > 0, also returns the largest micro batch that divides the
+    per-replica share (raising if this world size is not compatible) —
+    reference: elasticity.py:231-330.
+    """
+    enabled = elastic_cfg.get("enabled", False)
+    if not enabled:
+        raise ElasticityError("elasticity section is not enabled")
+    micro = list(elastic_cfg.get("micro_batch_sizes", [2, 4, 6]))
+    max_batch = int(elastic_cfg.get("max_train_batch_size", 2000))
+    min_gpus = int(elastic_cfg.get("min_gpus", 1))
+    max_gpus = int(elastic_cfg.get("max_gpus", 10000))
+    prefer_larger = bool(elastic_cfg.get("prefer_larger_batch", True))
+    if min_gpus < 1 or max_gpus < min_gpus:
+        raise ElasticityError(f"bad gpu range [{min_gpus}, {max_gpus}]")
+    if any(m < 1 for m in micro) or not micro:
+        raise ElasticityError(f"bad micro_batch_sizes {micro}")
+
+    best, best_gpus = None, []
+    for cand in _candidate_batches(micro, max_batch):
+        gpus = get_compatible_gpus(cand, micro, min_gpus,
+                                   min(max_gpus, max_batch))
+        better = (len(gpus) > len(best_gpus)
+                  or (len(gpus) == len(best_gpus)
+                      and prefer_larger and best is not None and cand > best))
+        if best is None or better:
+            best, best_gpus = cand, gpus
+    final_batch = best
+
+    micro_for_ws = 0
+    if world_size > 0:
+        if world_size not in best_gpus:
+            raise ElasticityError(
+                f"world size {world_size} is not compatible with elastic "
+                f"batch {final_batch} (valid device counts: "
+                f"{best_gpus[:16]}{'...' if len(best_gpus) > 16 else ''})")
+        per = final_batch // world_size
+        fits = [m for m in micro if per % m == 0]
+        micro_for_ws = max(fits)
+    logger.info(f"elasticity: batch={final_batch}, "
+                f"{len(best_gpus)} valid device counts"
+                + (f", micro={micro_for_ws} at world={world_size}"
+                   if world_size else ""))
+    return final_batch, best_gpus, micro_for_ws

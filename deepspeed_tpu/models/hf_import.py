@@ -395,6 +395,8 @@ def export_hf_state_dict(params, cfg, *, family: Optional[str] = None
         sd["transformer.ln_f.weight"] = params["final_norm_scale"]
         if "final_norm_bias" in params:
             sd["transformer.ln_f.bias"] = params["final_norm_bias"]
+        if "lm_head" in params:
+            sd["lm_head.weight"] = _t(params["lm_head"])
         for i in range(cfg.num_layers):
             pre = f"transformer.h.{i}"
             sd[f"{pre}.ln_1.weight"] = lp["ln1_scale"][i]

@@ -49,6 +49,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16                   # activation/compute dtype
     param_dtype: Any = jnp.float32              # storage dtype (engine may cast)
     attention_impl: str = "auto"                # auto | pallas | xla
+    # block-sparse attention (reference: ops/sparse_attention; configs from
+    # sparsity_config.py). e.g. {"mode": "bigbird", "block": 128,
+    # "num_random_blocks": 1, ...}; None -> dense/flash attention.
+    sparse_attention: Optional[Dict[str, Any]] = None
     # MoE (reference: deepspeed/moe/*; config keys from MoEConfig)
     num_experts: int = 1
     top_k: int = 2
@@ -62,6 +66,13 @@ class TransformerConfig:
     remat: bool = False
     remat_policy: str = "none"                  # none|dots_saveable|save_nothing
     scan_layers: bool = True
+    # Random-LTD (reference: runtime/data_pipeline/data_routing/basic_layer.py
+    # RandomLayerTokenDrop): middle layers process a random kept-token subset
+    # during training. random_ltd_keep is a SHAPE (static); the engine's
+    # RandomLTDScheduler rebuilds the model per schedule bucket. First and
+    # last layers always run dense, matching the reference's reserved layers.
+    random_ltd: bool = False
+    random_ltd_keep: int = 0
     # ZeRO-Infinity param offload: stacked layer weights live in pinned host
     # DRAM; each scan step transfers ONE layer into HBM (and the remat replay
     # re-fetches it during backward), so peak HBM holds ~1 layer of params.
@@ -323,6 +334,13 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         from deepspeed_tpu.ops.ring_attention import ring_attention
         return ring_attention(q, k, v, current_mesh(), causal=causal,
                               sm_scale=1.0 / math.sqrt(D))
+    if cfg.sparse_attention and mask is None and segment_ids is None:
+        from deepspeed_tpu.ops.sparse_attention import (
+            get_sparsity_config, sparse_attention as _sparse_attn)
+        sa = dict(cfg.sparse_attention)
+        mode = sa.pop("mode", "fixed")
+        return _sparse_attn(q, k, v, get_sparsity_config(mode, **sa),
+                            causal=causal, sm_scale=1.0 / math.sqrt(D))
     if _use_pallas(cfg, S) and mask is None and segment_ids is None:
         from deepspeed_tpu.ops.flash_attention import flash_attention as fa
         return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D))
@@ -526,9 +544,12 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
         policy = _remat_policy(cfg)
         body = jax.checkpoint(body, policy=policy, prevent_cse=False)
 
+    use_ltd = (cfg.random_ltd and cfg.random_ltd_keep > 0
+               and not deterministic and dropout_rng is not None
+               and not return_kv)
     aux_total = jnp.float32(0.0)
     kv_stack = None
-    if cfg.scan_layers:
+    if cfg.scan_layers and not use_ltd:
         (x, _, aux_total), kv_stack = lax.scan(
             body, (x, dropout_rng, aux_total), layers)
     else:
@@ -537,7 +558,33 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
         kvs = []
         for i in range(n_layers):
             layer_p = jax.tree.map(lambda a: a[i], layers)
-            carry, kv = body(carry, layer_p)
+            if use_ltd and 1 <= i < n_layers - 1:
+                from deepspeed_tpu.runtime.data_pipeline import (
+                    random_ltd_layer)
+                x_c, rng, aux_acc = carry
+                rng, sub, sel_rng = jax.random.split(rng, 3)
+
+                def ltd_step(x_in, lp):
+                    if cfg.offload_params:
+                        lp = _fetch_layer(lp, cfg)
+
+                    def layer_fn(xs, positions=None, mask=None):
+                        return transformer_layer(
+                            xs, lp, cfg, mask=mask, positions=positions,
+                            dropout_rng=sub, deterministic=deterministic)
+
+                    return random_ltd_layer(
+                        x_in, layer_fn, cfg.random_ltd_keep, sel_rng,
+                        positions=positions, mask=attention_mask)
+
+                if cfg.remat or cfg.remat_policy not in ("none", None):
+                    ltd_step = jax.checkpoint(ltd_step,
+                                              policy=_remat_policy(cfg),
+                                              prevent_cse=False)
+                y, aux = ltd_step(x_c, layer_p)
+                carry, kv = (y, rng, aux_acc + aux), None
+            else:
+                carry, kv = body(carry, layer_p)
             kvs.append(kv)
         x, aux_total = carry[0], carry[2]
         if return_kv:

@@ -1,4 +1,5 @@
 from deepspeed_tpu.ops.registry import SUPPORTED_OPTIMIZERS, get_optimizer_builder, op_report
 from deepspeed_tpu.ops.optimizers import Optimizer, sgd, adagrad, lion, global_grad_norm
-from deepspeed_tpu.ops.adam import adam, adamw, onebit_adam
+from deepspeed_tpu.ops.adam import adam, adamw
+from deepspeed_tpu.ops.onebit import onebit_adam, onebit_lamb, zero_one_adam, PhasedOptimizer
 from deepspeed_tpu.ops.lamb import lamb

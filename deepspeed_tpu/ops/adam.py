@@ -85,88 +85,6 @@ def adamw(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
                 adam_w_mode=True, **kw)
 
 
-def onebit_adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
-                weight_decay: float = 0.0, freeze_step: int = 100,
-                use_master_weights: bool = True,
-                comm_axis: Optional[str] = None) -> Optimizer:
-    """1-bit Adam (reference: ``runtime/fp16/onebit/adam.py:11``).
-
-    Semantics preserved from the reference: a dense warmup for `freeze_step`
-    steps, after which the *variance* (exp_avg_sq) is frozen and the momentum
-    update is communicated compressed to 1 bit (sign + per-tensor scale) with
-    local error feedback.
-
-    SPMD realization: when `comm_axis` is given, gradients entering this
-    transform are expected to be the *local* (un-reduced) grads from a
-    shard_map region; during the compressed stage we sign-compress
-    momentum+error locally, psum the signs over `comm_axis`, and rescale —
-    the 1-bit volume is what crosses the wire, which is the entire point on
-    DCN-connected multislice. Without `comm_axis` grads are already averaged
-    (GSPMD inserted the all-reduce) and the compression acts as
-    error-feedback sign-SGD on the momentum, matching the reference's
-    single-worker behavior.
-    """
-    b1, b2 = betas
-    from jax import lax
-
-    def init(params):
-        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
-        return {
-            "step": jnp.zeros((1,), jnp.int32),
-            "exp_avg": jax.tree.map(zeros, params),
-            "exp_avg_sq": jax.tree.map(zeros, params),
-            "error": jax.tree.map(zeros, params),        # error feedback buffer
-            "master": _master_init(params, use_master_weights),
-        }
-
-    def update(grads, state, params):
-        step = state["step"] + 1
-        lr_t = _lr_at(lr, step)
-        master = _resolve_master(params, state.get("master"))
-        g32 = cast_tree(grads, jnp.float32)
-        if weight_decay:
-            g32 = jax.tree.map(lambda g, p: g + weight_decay * p, g32, master)
-
-        warm = step <= freeze_step
-
-        # dense branch: plain adam moments
-        m_dense = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g,
-                               state["exp_avg"], g32)
-        v_new = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
-                             state["exp_avg_sq"], g32)
-
-        # compressed branch: update momentum then transmit sign(m + error)
-        def compress(m_, err):
-            corrected = m_ + err
-            scale = jnp.mean(jnp.abs(corrected))
-            signed = jnp.sign(corrected)
-            if comm_axis is not None:
-                signed = lax.pmean(signed, comm_axis)
-                scale = lax.pmean(scale, comm_axis)
-            decompressed = signed * scale
-            new_err = corrected - decompressed
-            return decompressed, new_err
-
-        comp = jax.tree.map(lambda m_, e: compress(m_, e), m_dense, state["error"],
-                            is_leaf=lambda x: isinstance(x, jnp.ndarray))
-        m_comp = jax.tree.map(lambda t: t[0], comp, is_leaf=lambda x: isinstance(x, tuple))
-        err_new = jax.tree.map(lambda t: t[1], comp, is_leaf=lambda x: isinstance(x, tuple))
-
-        m = jax.tree.map(lambda md, mc: jnp.where(warm, md, mc), m_dense, m_comp)
-        v = jax.tree.map(lambda vo, vn: jnp.where(warm, vn, vo),
-                         state["exp_avg_sq"], v_new)  # freeze v after warmup
-        err = jax.tree.map(lambda eo, en: jnp.where(warm, eo, en),
-                           state["error"], err_new)
-
-        c1 = 1 - b1 ** step.astype(jnp.float32)
-        c2 = 1 - b2 ** step.astype(jnp.float32)
-
-        def step_fn(p, m_, v_):
-            return p - lr_t * (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
-
-        new_master = jax.tree.map(step_fn, master, m, v)
-        new_params, new_master = _writeback(new_master, params, state.get("master"))
-        return new_params, {"step": step, "exp_avg": m, "exp_avg_sq": v,
-                            "error": err, "master": new_master}
-
-    return Optimizer(init, update)
+# onebit_adam moved to deepspeed_tpu.ops.onebit (phased implementation with
+# a real compressed collective); re-exported here for backward compatibility.
+from deepspeed_tpu.ops.onebit import onebit_adam  # noqa: E402,F401

@@ -1,0 +1,44 @@
+"""Fused normalization ops.
+
+Reference: ``csrc/transformer/inference/csrc/layer_norm.cu`` (fused
+layer-norm / rms-norm with optional residual-add). On TPU, XLA fuses the
+reduction + scale chain into one VPU pass over the row, so these are plain
+jnp formulations — kept as a module so kernels stay swappable (a Pallas
+variant can slot in) and `op_report` reflects a real op.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["layer_norm", "rms_norm", "fused_add_layer_norm",
+           "fused_add_rms_norm"]
+
+
+def layer_norm(x, scale, bias=None, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rms_norm(x, scale, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(
+        x.dtype)
+
+
+def fused_add_layer_norm(x, residual, scale, bias=None, eps: float = 1e-5):
+    """(x + residual) then layer_norm — the reference's fused residual path;
+    returns (normed, new_residual)."""
+    s = x + residual
+    return layer_norm(s, scale, bias, eps), s
+
+
+def fused_add_rms_norm(x, residual, scale, eps: float = 1e-5):
+    s = x + residual
+    return rms_norm(s, scale, eps), s

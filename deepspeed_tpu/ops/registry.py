@@ -13,8 +13,10 @@ SUPPORTED_OPTIMIZERS = {
 
 
 def get_optimizer_builder(name: str):
-    from deepspeed_tpu.ops.adam import adam as adam_fn, adamw, onebit_adam
+    from deepspeed_tpu.ops.adam import adam as adam_fn, adamw
     from deepspeed_tpu.ops.lamb import lamb as lamb_fn
+    from deepspeed_tpu.ops.onebit import (
+        onebit_adam, onebit_lamb, zero_one_adam)
     from deepspeed_tpu.ops.optimizers import sgd, adagrad, lion
     name = name.lower()
     table = {
@@ -25,12 +27,12 @@ def get_optimizer_builder(name: str):
         "sgd": sgd,
         "lamb": lamb_fn,
         "fusedlamb": lamb_fn,
-        "onebitlamb": lamb_fn,
+        "onebitlamb": onebit_lamb,
         "adagrad": adagrad,
         "cpuadagrad": adagrad,
         "lion": lion,
         "onebitadam": onebit_adam,
-        "zerooneadam": onebit_adam,
+        "zerooneadam": zero_one_adam,
     }
     if name not in table:
         raise ValueError(f"unknown optimizer '{name}'")

@@ -1,0 +1,2 @@
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler, get_model_profile, profile_jaxpr)

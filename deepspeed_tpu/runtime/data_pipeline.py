@@ -1,0 +1,206 @@
+"""Data efficiency pipeline: curriculum learning, distributed sampling,
+Random-LTD token dropping.
+
+Reference: ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:9``
+(CurriculumScheduler — fixed_linear/fixed_root/fixed_discrete/custom
+difficulty schedules), ``data_sampler.py:33`` (DeepSpeedDataSampler /
+distributed sampling), and ``data_routing/basic_layer.py`` (Random-LTD:
+middle layers process a random subset of tokens, scattered back into the
+residual stream).
+
+TPU-native notes: difficulty and kept-token counts are SHAPES on TPU, so the
+schedulers quantize their outputs (multiples of `step`) and the engine re-jits
+per distinct value — a handful of compiles over a run, each cached. Random-LTD
+gather/scatter are static-shape `jnp.take_along_axis` ops XLA vectorizes.
+"""
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class CurriculumScheduler:
+    """Difficulty schedule (reference: curriculum_scheduler.py:9).
+
+    schedule_type:
+      fixed_linear:   difficulty grows linearly to max over total_curriculum_step
+      fixed_root:     grows as (step/total)^(1/root_degree)
+      fixed_discrete: explicit difficulty[] + max_step[] breakpoints
+      custom:         user callable step -> difficulty
+    Difficulties are rounded to `difficulty_step` (shape bucketing on TPU).
+    """
+
+    def __init__(self, cfg: Dict[str, Any],
+                 custom_fn: Optional[Callable[[int], int]] = None):
+        self.type = cfg.get("schedule_type", cfg.get("curriculum_type_schedule",
+                                                     "fixed_linear"))
+        self.min_difficulty = int(cfg.get("min_difficulty", 8))
+        self.max_difficulty = int(cfg.get("max_difficulty", 1024))
+        sc = cfg.get("schedule_config", {})
+        self.total_step = int(sc.get("total_curriculum_step", 1000))
+        self.difficulty_step = int(sc.get("difficulty_step", 8))
+        self.root_degree = int(sc.get("root_degree", 2))
+        self.discrete_difficulties = list(sc.get("difficulty", []))
+        self.discrete_steps = list(sc.get("max_step", []))
+        self.custom_fn = custom_fn
+        if self.type == "custom" and custom_fn is None:
+            raise ValueError("custom curriculum schedule needs a callable")
+        self.current = self.min_difficulty
+
+    def _raw(self, step: int) -> float:
+        if self.type == "fixed_linear":
+            frac = min(1.0, step / max(1, self.total_step))
+        elif self.type == "fixed_root":
+            frac = min(1.0, (step / max(1, self.total_step))
+                       ** (1.0 / self.root_degree))
+        elif self.type == "fixed_discrete":
+            for d, s in zip(self.discrete_difficulties, self.discrete_steps):
+                if step <= s:
+                    return float(d)
+            return float(self.discrete_difficulties[-1]
+                         if self.discrete_difficulties else self.max_difficulty)
+        elif self.type == "custom":
+            return float(self.custom_fn(step))
+        else:
+            raise ValueError(f"unknown curriculum schedule {self.type!r}")
+        return (self.min_difficulty
+                + frac * (self.max_difficulty - self.min_difficulty))
+
+    def update_difficulty(self, step: int) -> int:
+        d = self._raw(step)
+        q = self.difficulty_step
+        d = int(min(self.max_difficulty,
+                    max(self.min_difficulty, math.ceil(d / q) * q)))
+        self.current = d
+        return d
+
+    def get_current_difficulty(self) -> int:
+        return self.current
+
+
+def apply_seqlen_curriculum(batch: Dict[str, Any], difficulty: int
+                            ) -> Dict[str, Any]:
+    """Truncate the sequence dim to the current difficulty (reference:
+    megatron curriculum truncates input/labels/mask the same way)."""
+    out = {}
+    for k, v in batch.items():
+        if hasattr(v, "ndim") and v.ndim >= 2 and v.shape[1] > difficulty:
+            out[k] = v[:, :difficulty]
+        else:
+            out[k] = v
+    return out
+
+
+class DistributedSampler:
+    """Per-replica index sampler (reference: ``runtime/dataloader.py``
+    DistributedSampler usage + ``data_sampler.py:33``).
+
+    Under SPMD one *process* feeds all local devices, so num_replicas/rank
+    default to jax.process_count()/process_index() — each host samples its
+    contiguous shard of the epoch permutation."""
+
+    def __init__(self, dataset_len: int, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True):
+        if num_replicas is None or rank is None:
+            import jax
+            num_replicas = num_replicas or jax.process_count()
+            rank = rank if rank is not None else jax.process_index()
+        if rank >= num_replicas:
+            raise ValueError(f"rank {rank} >= num_replicas {num_replicas}")
+        self.n = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        if drop_last:
+            self.num_samples = self.n // num_replicas
+        else:
+            self.num_samples = math.ceil(self.n / num_replicas)
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        order = np.arange(self.n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(order)
+        if not self.drop_last and self.n % self.num_replicas:
+            pad = self.num_replicas * self.num_samples - self.n
+            order = np.concatenate([order, order[:pad]])
+        shard = order[self.rank * self.num_samples:
+                      (self.rank + 1) * self.num_samples]
+        return iter(shard.tolist())
+
+
+# ---------------------------------------------------------------------------
+# Random-LTD (random layerwise token dropping)
+# ---------------------------------------------------------------------------
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference: ``data_pipeline/data_routing/
+    scheduler.py`` RandomLTDScheduler — linearly increases the kept-token
+    count from min to the full sequence over a step budget)."""
+
+    def __init__(self, cfg: Dict[str, Any]):
+        rl = cfg.get("random_ltd", cfg)
+        self.min_value = int(rl.get("random_ltd_schedule", {}).get(
+            "min_value", rl.get("min_value", 128)))
+        self.max_value = int(rl.get("random_ltd_schedule", {}).get(
+            "max_value", rl.get("max_value", 2048)))
+        sched = rl.get("random_ltd_schedule", rl)
+        self.total_steps = int(sched.get("schedule_config", sched).get(
+            "total_layer_tokens_steps", sched.get("total_steps", 1000)))
+        self.step_size = int(sched.get("schedule_config", sched).get(
+            "seq_step", 64))
+
+    def kept_tokens(self, step: int, seq_len: int) -> int:
+        frac = min(1.0, step / max(1, self.total_steps))
+        k = self.min_value + frac * (self.max_value - self.min_value)
+        k = int(min(seq_len, max(self.min_value,
+                                 math.ceil(k / self.step_size) * self.step_size)))
+        return min(k, seq_len)
+
+
+def random_ltd_layer(x, layer_fn, keep: int, rng, *args, **kwargs):
+    """Run `layer_fn` on a random `keep`-token subset of x [B,S,H]; tokens
+    not selected pass through unchanged (reference: data_routing/
+    basic_layer.py RandomLayerTokenDrop forward).
+
+    Static-shape: `keep` is a Python int; selection is a per-row random
+    permutation prefix, gathered with take_along_axis and scattered back.
+    """
+    import jax
+    import jax.numpy as jnp
+    B, S, H = x.shape
+    if keep >= S:
+        return layer_fn(x, *args, **kwargs)
+    # per-row random selection WITHOUT replacement: argsort of uniforms
+    u = jax.random.uniform(rng, (B, S))
+    sel = jnp.argsort(u, axis=1)[:, :keep]                      # [B, keep]
+    sel_sorted = jnp.sort(sel, axis=1)                          # keep order
+    sub = jnp.take_along_axis(x, sel_sorted[..., None], axis=1)  # [B,keep,H]
+    kwargs = dict(kwargs)
+    # rotary/learned positions must be the TRUE token positions of the
+    # selected subset; a padding mask is gathered the same way
+    pos = kwargs.get("positions")
+    kwargs["positions"] = (sel_sorted if pos is None
+                           else jnp.take_along_axis(pos, sel_sorted, axis=1))
+    if kwargs.get("mask") is not None:
+        kwargs["mask"] = jnp.take_along_axis(kwargs["mask"], sel_sorted,
+                                             axis=1)
+    out = layer_fn(sub, *args, **kwargs)
+    y = out[0] if isinstance(out, tuple) else out
+    full = x.at[jnp.arange(B)[:, None], sel_sorted].set(y.astype(x.dtype))
+    if isinstance(out, tuple):
+        return (full,) + out[1:]
+    return full

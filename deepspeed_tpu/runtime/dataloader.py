@@ -18,18 +18,25 @@ class DataLoader:
     callable index -> row)."""
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = False,
-                 seed: int = 0, drop_last: bool = True, collate_fn=None):
+                 seed: int = 0, drop_last: bool = True, collate_fn=None,
+                 sampler=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _default_collate
+        if sampler is not None and shuffle:
+            raise ValueError("pass shuffle to the sampler, not the loader, "
+                             "when a sampler is given")
+        self.sampler = sampler  # e.g. data_pipeline.DistributedSampler
         self.epoch = 0
 
     def __len__(self):
-        n = len(self.dataset) // self.batch_size
-        if not self.drop_last and len(self.dataset) % self.batch_size:
+        total = (len(self.sampler) if self.sampler is not None
+                 else len(self.dataset))
+        n = total // self.batch_size
+        if not self.drop_last and total % self.batch_size:
             n += 1
         return n
 
@@ -37,11 +44,17 @@ class DataLoader:
         self.epoch = epoch
 
     def __iter__(self) -> Iterator:
-        n = len(self.dataset)
-        order = np.arange(n)
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            rng.shuffle(order)
+        if self.sampler is not None:
+            if hasattr(self.sampler, "set_epoch"):
+                self.sampler.set_epoch(self.epoch)
+            order = np.fromiter(iter(self.sampler), dtype=np.int64)
+            n = len(order)
+        else:
+            n = len(self.dataset)
+            order = np.arange(n)
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                rng.shuffle(order)
         for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
                            self.batch_size):
             idx = order[start:start + self.batch_size]

@@ -62,6 +62,45 @@ def initialize(args=None, model=None, config=None, config_params=None,
     cfg = Config.load(config if config is not None else config_params)
     if args is not None and getattr(args, "deepspeed_config", None):
         cfg = Config.load(args.deepspeed_config)
+    if cfg.autotuning.enabled:
+        # reference: autotuning/autotuner.py:39 — search mesh/zero/microbatch/
+        # remat before building the real engine, then build with the winner
+        from deepspeed_tpu.autotuning import autotune_config
+        src = config if config is not None else config_params
+        if src is None and args is not None:
+            src = getattr(args, "deepspeed_config", None)
+        if isinstance(src, dict):
+            raw = json.loads(json.dumps(src))
+        else:
+            with open(src) as f:
+                raw = json.load(f)
+        raw, model = autotune_config(model, raw,
+                                     devices=kwargs.get("devices"))
+        cfg = Config.load(raw)
+    if cfg.elasticity.enabled:
+        # reference: elasticity/elasticity.py:231 — pin a batch size
+        # compatible with the widest device-count range, then derive the
+        # micro/gas split for THIS world size
+        from deepspeed_tpu.elasticity import compute_elastic_config
+        devs = kwargs.get("devices")
+        ws = len(devs) if devs else jax.device_count()
+        if not cfg.elasticity.ignore_non_elastic_batch_info and any(
+                v is not None for v in (cfg.train_batch_size,
+                                        cfg.train_micro_batch_size_per_gpu,
+                                        cfg.gradient_accumulation_steps)):
+            raise ValueError(
+                "elasticity sets the batch triad itself; remove "
+                "train_batch_size/train_micro_batch_size_per_gpu/"
+                "gradient_accumulation_steps or set "
+                "ignore_non_elastic_batch_info")
+        # the batch triad is per DATA-parallel replica, not per chip: a
+        # tensor/pipe-parallel mesh divides the chips among model shards
+        dp = plan_from_config(cfg, ws).dp_world_size
+        fb, _valid, micro = compute_elastic_config(
+            dataclasses.asdict(cfg.elasticity), world_size=dp)
+        cfg.train_batch_size = fb
+        cfg.train_micro_batch_size_per_gpu = micro
+        cfg.gradient_accumulation_steps = fb // (micro * dp)
     engine = Engine(model=model, config=cfg, optimizer=optimizer,
                     lr_scheduler=lr_scheduler, mesh=mesh, rng=rng,
                     devices=kwargs.get("devices"))
@@ -261,6 +300,52 @@ class Engine:
         if config.optimizer and "lr" in config.optimizer.params:
             self._base_lr = config.optimizer.params["lr"]
 
+        # --- 1-bit compressed communication path (reference: the NCCL/MPI
+        # compressed_allreduce backends, runtime/comm/nccl.py:53). Grads stay
+        # per-device local inside a shard_map over `data`; only packed sign
+        # bits cross the wire in the compressed phase.
+        from deepspeed_tpu.ops.onebit import PhasedOptimizer
+        self._onebit_comm = False
+        if isinstance(self.optimizer, PhasedOptimizer) and self.plan.data > 1:
+            pure_dp = (self.plan.tensor == 1 and self.plan.pipe == 1
+                       and self.plan.fsdp == 1 and self.plan.expert == 1
+                       and self.plan.seq == 1)
+            ok = (pure_dp and zero_cfg.stage == 0 and not self._fp16
+                  and not self._offload_opt and not self._nvme_opt)
+            if ok:
+                self._onebit_comm = True
+                if config.gradient_clipping:
+                    logger.warning(
+                        "1-bit compressed path: gradient clipping is ignored "
+                        "(a per-rank clip on local grads would desynchronize "
+                        "parameters; the reference has the same caveat)")
+                logger.info("1-bit optimizer: compressed communication over "
+                            f"data axis ({self.plan.data} ranks), packed "
+                            "sign all-gather in the compressed phase")
+            else:
+                logger.warning(
+                    "1-bit optimizer: compressed communication requires a "
+                    "pure data-parallel mesh, zero stage 0, and no "
+                    "fp16/offload — falling back to dense (error-feedback "
+                    "sign update semantics are preserved, bytes are not "
+                    "reduced)")
+
+        # --- compression (reference: compression/compress.py:92) — a traced
+        # param transform inside the step; masters stay full precision
+        self._compression = None
+        comp_cfg = dataclasses.asdict(config.compression_training)
+        if any((comp_cfg.get(k) or {}).get("shared_parameters", {})
+               .get("enabled")
+               for k in ("weight_quantization", "sparse_pruning",
+                         "row_pruning", "head_pruning",
+                         "activation_quantization", "channel_pruning",
+                         "layer_reduction")):
+            from deepspeed_tpu.compression import init_compression
+            self._compression = init_compression(comp_cfg)
+            if self._onebit_comm:
+                raise ValueError("compression_training with the 1-bit "
+                                 "compressed-comm path is not supported")
+
         # --- state init (sharded at creation; reference: zero.Init equivalent)
         self.state_shardings = None
         self.state = self._init_state()
@@ -282,6 +367,35 @@ class Engine:
         self._accum_count = 0
         self.monitor = self._build_monitor()
         self.losses = None
+        # --- data efficiency (reference: runtime/data_pipeline/*)
+        self._curriculum = None
+        if config.curriculum_learning.enabled:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+            if config.curriculum_learning.curriculum_type != "seqlen":
+                raise ValueError("curriculum_type must be 'seqlen' (the "
+                                 "reference's only in-engine curriculum)")
+            self._curriculum = CurriculumScheduler(dataclasses.asdict(
+                config.curriculum_learning))
+            logger.info("curriculum learning: seqlen "
+                        f"{self._curriculum.min_difficulty} -> "
+                        f"{self._curriculum.max_difficulty} over "
+                        f"{self._curriculum.total_step} steps")
+        self._ltd = None
+        self._ltd_keep = None
+        routing = config.data_efficiency.data_routing or {}
+        if config.data_efficiency.enabled and \
+                routing.get("random_ltd", {}).get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+            from deepspeed_tpu.models.transformer import TransformerConfig
+            if not isinstance(getattr(model, "config", None), TransformerConfig):
+                raise ValueError("random_ltd requires a transformer ModelSpec")
+            if self._pp_mode:
+                raise ValueError("random_ltd with pipeline parallelism is not "
+                                 "supported")
+            self._ltd = RandomLTDScheduler(routing)
+            self._ltd_orig_scan = model.config.scan_layers
+            logger.info(f"random-ltd: kept tokens "
+                        f"{self._ltd.min_value} -> {self._ltd.max_value}")
         n = num_params(param_shapes)
         logger.info(f"engine ready: {model.name if hasattr(model, 'name') else 'model'} "
                     f"{n / 1e6:.1f}M params, dtype={self.compute_dtype.__name__}, "
@@ -338,11 +452,41 @@ class Engine:
         init_fn = jax.jit(make_state, out_shardings=self.state_shardings)
         with self.mesh:
             state = init_fn(self._rng)
+        if self._onebit_comm:
+            state = self._expand_rank_varying(state)
         if self._offload_opt:
             state["opt"] = self._opt_to_host(state["opt"])
         if self._nvme_opt:
             self._swapper = self._build_swapper(state_shapes["params"])
             self._swapper.initialize(state["params"])
+        return state
+
+    def _expand_rank_varying(self, state):
+        """Give each rank-varying optimizer-state subtree (1-bit error
+        feedback buffers, 0/1-Adam local momentum) a leading [dp] dim sharded
+        over `data` — per-worker values that are explicit and checkpointable
+        instead of silently divergent 'replicated' shards."""
+        dp = self.plan.data
+        mesh = self.mesh
+        rv = set(self.optimizer.rank_varying)
+
+        def expand_tree(tree, spec_tree_):
+            sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, P("data", *s.spec)), spec_tree_,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+            fn = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda a: jnp.broadcast_to(a[None], (dp,) + a.shape), t),
+                out_shardings=sh)
+            with mesh:
+                out = fn(tree)
+            return out, sh
+
+        for k in list(state["opt"].keys()):
+            if k in rv and state["opt"][k] is not None:
+                state["opt"][k], sh = expand_tree(
+                    state["opt"][k], self.state_shardings["opt"][k])
+                self.state_shardings["opt"][k] = sh
         return state
 
     def _build_swapper(self, param_shapes):
@@ -441,6 +585,37 @@ class Engine:
             return P(("data", "fsdp", "expert"), "seq")
         return P(("data", "fsdp", "expert"))
 
+    @staticmethod
+    def _accum_micro_grads(micro_fn, params, batch, gas: int, rng,
+                           postprocess=None):
+        """Gradient accumulation over `gas` microbatches, shared by the dense
+        GSPMD step and the 1-bit shard_map step. micro_fn(params, mb, rng) ->
+        (loss, grads); postprocess (e.g. a sharding constraint) is applied to
+        the running accumulator. Returns (summed grads / gas, mean loss)."""
+        if gas == 1:
+            loss, grads = micro_fn(params, batch, rng)
+            return grads, loss
+
+        def split(x):
+            return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+        mbs = jax.tree.map(split, batch)
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        if postprocess is not None:
+            zeros = postprocess(zeros)
+
+        def body(acc, mb_rng):
+            mb, r = mb_rng
+            loss, g = micro_fn(params, mb, r)
+            acc = jax.tree.map(jnp.add, acc, g)
+            if postprocess is not None:
+                acc = postprocess(acc)
+            return acc, loss
+
+        rngs = jax.random.split(rng, gas)
+        grads, losses = jax.lax.scan(body, zeros, (mbs, rngs))
+        return jax.tree.map(lambda g: g / gas, grads), jnp.mean(losses)
+
     def _compile_steps(self):
         cfg = self.config
         # in pipeline mode grad accumulation IS the microbatch rotation inside
@@ -456,8 +631,12 @@ class Engine:
         clip = cfg.gradient_clipping
         compute_dtype = self.compute_dtype
 
-        def micro_grads(params, mb, rng, scale):
+        compression = self._compression
+
+        def micro_grads(params, mb, rng, scale, step=None):
             def loss_fn(p):
+                if compression is not None:
+                    p = compression.apply(p, step if step is not None else 0)
                 loss = model.loss_fn(p, mb, rng, False)
                 if fp16:
                     loss = loss * scale.astype(loss.dtype)
@@ -519,30 +698,12 @@ class Engine:
             batch leaves: [global_batch, ...], sharded over (data, fsdp)."""
             params = state["params"]
             scale = state["loss_scale"]["scale"] if fp16 else jnp.float32(1.0)
-            if gas == 1:
-                loss, grads = micro_grads(params, batch, rng, scale)
-                mean_loss = loss
-            else:
-                def split(x):
-                    return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
-                mbs = jax.tree.map(split, batch)
-                zero_grads = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
-                zero_grads = jax.lax.with_sharding_constraint(
-                    zero_grads, self.grad_specs)
-
-                def body(carry, mb_rng):
-                    acc = carry
-                    mb, r = mb_rng
-                    loss, grads = micro_grads(params, mb, r, scale)
-                    acc = jax.tree.map(jnp.add, acc, grads)
-                    acc = jax.lax.with_sharding_constraint(acc, self.grad_specs)
-                    return acc, loss
-
-                rngs = jax.random.split(rng, gas)
-                grads, losses = jax.lax.scan(body, zero_grads, (mbs, rngs))
-                grads = jax.tree.map(lambda g: g / gas, grads)
-                mean_loss = jnp.mean(losses)
+            grads, mean_loss = self._accum_micro_grads(
+                lambda p, mb, r: micro_grads(p, mb, r, scale,
+                                             step=state["step"]),
+                params, batch, gas, rng,
+                postprocess=lambda t: jax.lax.with_sharding_constraint(
+                    t, self.grad_specs))
             if fp16:
                 mean_loss = mean_loss / scale
             return mean_loss, grads
@@ -567,8 +728,26 @@ class Engine:
                 out_shardings=(self.state_shardings, None),
                 donate_argnums=(0,))
 
+        if self._onebit_comm:
+            # phase-compiled shard_map steps replace the GSPMD train step:
+            # dense pmean in the warm program, 1-bit packed all-gather in the
+            # compressed program, no collective at all in a local program
+            self._train_step = None
+            self._onebit_steps = {}
+            # host mirror of opt["step"] driving phase selection; synced from
+            # device state so mid-run recompiles (e.g. Random-LTD rebuilds)
+            # and load_checkpoint cannot restart the warmup phase
+            if getattr(self, "state", None) is not None:
+                self._onebit_applied = int(np.asarray(jax.device_get(
+                    self.state["opt"]["step"]))[0])
+            else:
+                self._onebit_applied = 0
+
         def eval_step(state, batch):
-            loss = model.loss_fn(state["params"], batch, None, True)
+            p = state["params"]
+            if compression is not None:
+                p = compression.apply(p, state["step"])
+            loss = model.loss_fn(p, batch, None, True)
             return loss
 
         self._eval_step = jax.jit(
@@ -577,7 +756,8 @@ class Engine:
         # --- 3-call API pieces (forward/backward/step)
         def grad_only(state, batch, rng):
             scale = state["loss_scale"]["scale"] if fp16 else jnp.float32(1.0)
-            loss, grads = micro_grads(state["params"], batch, rng, scale)
+            loss, grads = micro_grads(state["params"], batch, rng, scale,
+                                      step=state["step"])
             return (loss / scale if fp16 else loss), grads
 
         self._grad_only = jax.jit(
@@ -597,6 +777,75 @@ class Engine:
                 out_shardings=(self.state_shardings, None), donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
+    # 1-bit compressed step (shard_map over data; grads never dense-reduced
+    # in the compressed phase — reference: runtime/comm/nccl.py:53)
+    # ------------------------------------------------------------------
+    def _get_onebit_step(self, phase: str):
+        if phase in self._onebit_steps:
+            return self._onebit_steps[phase]
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        mesh = self.mesh
+        model = self.model
+        opt = self.optimizer
+        rv = set(opt.rank_varying)
+        from jax import lax
+
+        def per_device(state, batch, rng):
+            params = state["params"]
+            opt_local = {
+                k: (jax.tree.map(lambda a: jnp.squeeze(a, 0), v)
+                    if k in rv and v is not None else v)
+                for k, v in state["opt"].items()}
+            rng = jax.random.fold_in(rng, lax.axis_index("data"))
+
+            def micro(p, mb, r):
+                return jax.value_and_grad(
+                    lambda q: model.loss_fn(q, mb, r, False))(p)
+
+            grads, loss = self._accum_micro_grads(
+                lambda p, mb, r: micro(p, mb, r), params, batch, gas, rng)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+
+            new_params, new_opt = opt.update_phase(
+                grads, opt_local, params, phase=phase, axis="data")
+            new_opt = {
+                k: (jax.tree.map(lambda a: a[None], v)
+                    if k in rv and v is not None else v)
+                for k, v in new_opt.items()}
+            mean_loss = lax.pmean(loss, "data")
+            # diagnostic: RMS of the per-rank local grad norms — an UPPER
+            # bound on the true norm of the averaged gradient (computing that
+            # exactly would need the dense all-reduce this path avoids)
+            gsq = sum(jnp.sum(jnp.square(g))
+                      for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(lax.pmean(gsq, "data"))
+            new_state = {"params": new_params, "opt": new_opt,
+                         "step": state["step"] + 1}
+            metrics = {"loss": mean_loss, "grad_norm": gnorm,
+                       "overflow": jnp.zeros((), jnp.bool_)}
+            return new_state, metrics
+
+        def spec_of(tree, varying_keys=()):
+            return {k: (P("data") if k in varying_keys else P())
+                    for k in tree}
+
+        state_spec = {"params": P(),
+                      "opt": spec_of(self.state["opt"], rv),
+                      "step": P()}
+        out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
+        fn = jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(state_spec, P("data"), P()),
+            out_specs=(state_spec, out_metrics_spec),
+            axis_names={"data"}, check_vma=False)
+        step_fn = jax.jit(fn, in_shardings=(self.state_shardings, None, None),
+                          out_shardings=(self.state_shardings, None),
+                          donate_argnums=(0,))
+        self._onebit_steps[phase] = step_fn
+        return step_fn
+
+    # ------------------------------------------------------------------
     # primary API
     # ------------------------------------------------------------------
     def train_batch(self, batch) -> Dict[str, Any]:
@@ -606,11 +855,24 @@ class Engine:
         self._activate_context()
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
+        if self._curriculum is not None:
+            from deepspeed_tpu.runtime.data_pipeline import (
+                apply_seqlen_curriculum)
+            d = self._curriculum.update_difficulty(self.global_steps + 1)
+            batch = apply_seqlen_curriculum(batch, d)
+        if self._ltd is not None:
+            self._maybe_rebuild_ltd(batch)
         batch = self._device_batch(batch)
         if self._nvme_opt:
             with self.mesh:
                 mean_loss, grads = self._batch_grads(self.state, batch, sub)
             metrics = self._nvme_apply(grads, mean_loss)
+        elif self._onebit_comm:
+            phase = self.optimizer.phase_for(self._onebit_applied)
+            step_fn = self._get_onebit_step(phase)
+            with self.mesh:
+                self.state, metrics = step_fn(self.state, batch, sub)
+            self._onebit_applied += 1
         else:
             if self._offload_opt:
                 self.state["opt"] = self._opt_to_device(self.state["opt"])
@@ -625,7 +887,42 @@ class Engine:
         self.tput_timer.stop()
         metrics = {k: v for k, v in metrics.items()}
         self._log_step(metrics)
+        fp_cfg = self.config.flops_profiler
+        if (fp_cfg.enabled and not getattr(self, "_profiling", False)
+                and self.global_steps == fp_cfg.profile_step):
+            from deepspeed_tpu.profiling import FlopsProfiler
+            self._profiling = True  # run() drives train_batch to time steps
+            try:
+                self.flops_profile = FlopsProfiler(fp_cfg).run(self, batch)
+            finally:
+                self._profiling = False
         return metrics
+
+    def _maybe_rebuild_ltd(self, batch):
+        """Random-LTD: the kept-token count is a SHAPE, so when the schedule
+        crosses a bucket boundary the model + step programs are rebuilt (jit
+        caches the old buckets; a handful of compiles per run)."""
+        seq_leaves = [v for v in batch.values()
+                      if hasattr(v, "ndim") and v.ndim >= 2]
+        if not seq_leaves:
+            return
+        S = seq_leaves[0].shape[1]
+        k = self._ltd.kept_tokens(self.global_steps + 1, S)
+        if k == self._ltd_keep:
+            return
+        import dataclasses as _dc
+        from deepspeed_tpu.models import make_model
+        base = self.model.config
+        active = k < S
+        # saturated schedule -> back to the dense scanned stack (unrolled
+        # layers are only needed while LTD wraps individual layers)
+        self.model = make_model(_dc.replace(
+            base, random_ltd=active, random_ltd_keep=k,
+            scan_layers=self._ltd_orig_scan if not active else False),
+            name=self.model.name)
+        self._ltd_keep = k
+        logger.info(f"random-ltd: kept tokens -> {k} (of {S})")
+        self._compile_steps()
 
     def _nvme_apply(self, grads, mean_loss) -> Dict[str, Any]:
         """Optimizer apply through the NVMe swapper (ZeRO-Infinity path).
@@ -700,6 +997,11 @@ class Engine:
     def forward(self, batch):
         """Compute loss+grads for one microbatch; grads are buffered until
         step(). Returns the (unscaled) loss."""
+        if self._onebit_comm:
+            raise RuntimeError(
+                "the 3-call forward/backward/step API is not available with "
+                "the 1-bit compressed path (grads must stay per-device local "
+                "inside one compiled step) — use train_batch()")
         self._activate_context()
         self._rng, sub = jax.random.split(self._rng)
         batch = self._device_batch(batch)
@@ -887,6 +1189,12 @@ class Engine:
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
+        if self._onebit_comm:
+            # phase selection must track the OPTIMIZER's applied count, which
+            # resets when load_optimizer_states=False while global_steps
+            # doesn't — re-sync the host mirror from device state
+            self._onebit_applied = int(np.asarray(jax.device_get(
+                self.state["opt"]["step"]))[0])
         return load_dir, client_state
 
     def save_16bit_model(self, save_dir: str, name: str = "model_fp16.ckpt"):

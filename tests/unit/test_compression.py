@@ -1,0 +1,132 @@
+"""Quantizer + compression tests (reference: csrc/quantization/*,
+compression/compress.py, compression/basic_layer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (
+    CompressionTransform, init_compression, redundancy_clean)
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.ops.quantizer import (
+    dequantize, fake_quant, quantize, quantize_tree, dequantize_tree)
+from tests.conftest import make_batch
+
+
+class TestQuantizer:
+    @pytest.mark.parametrize("bits,symmetric", [(8, True), (8, False),
+                                                (4, True), (4, False)])
+    def test_roundtrip_error_bounded(self, bits, symmetric):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 128)),
+                        jnp.float32)
+        qt = quantize(x, bits=bits, symmetric=symmetric, num_groups=64)
+        y = dequantize(qt)
+        # max error <= one quantization step per group
+        err = np.abs(np.asarray(y - x, np.float32))
+        steps = np.asarray(qt.scale).reshape(-1, 1)
+        g_err = err.reshape(64, -1)
+        assert (g_err <= steps * 0.75 + 1e-6).all(), g_err.max()
+
+    def test_int4_packs_half_bytes(self):
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 256)))
+        q8 = quantize(x, bits=8, num_groups=4)
+        q4 = quantize(x, bits=4, num_groups=4)
+        assert q4.q.size == q8.q.size // 2
+        assert q4.q.dtype == jnp.uint8
+
+    def test_fake_quant_straight_through(self):
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(32, 32)),
+                        jnp.float32)
+        g = jax.grad(lambda w: jnp.sum(fake_quant(w, bits=8) * 3.0))(x)
+        np.testing.assert_allclose(np.asarray(g), 3.0)  # STE: grad passes
+
+    def test_tree_quantization(self):
+        tree = {"big": jnp.ones((128, 128)), "small": jnp.ones((4,))}
+        qt = quantize_tree(tree, bits=8, min_size=1000)
+        from deepspeed_tpu.ops.quantizer import QuantizedTensor
+        assert isinstance(qt["big"], QuantizedTensor)
+        assert not isinstance(qt["small"], QuantizedTensor)
+        back = dequantize_tree(qt)
+        np.testing.assert_allclose(np.asarray(back["big"]), 1.0, rtol=1e-2)
+
+
+def _comp_cfg(**sections):
+    base = {}
+    for name, params in sections.items():
+        base[name] = {"shared_parameters": {"enabled": True,
+                                            "schedule_offset": 2},
+                      "different_groups": {"g1": {"params": params,
+                                                  "modules": ["*"]}}}
+    return base
+
+
+class TestCompression:
+    def test_sparse_mask_ratio(self):
+        t = CompressionTransform(_comp_cfg(
+            sparse_pruning={"dense_ratio": 0.25}))
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)),
+                        jnp.float32)
+        out = t.apply({"layers": {"w_in": w}}, step=10)["layers"]["w_in"]
+        nz = np.count_nonzero(np.asarray(out))
+        assert abs(nz / w.size - 0.25) < 0.02
+        # before the schedule offset: untouched
+        pre = t.apply({"layers": {"w_in": w}}, step=0)["layers"]["w_in"]
+        np.testing.assert_array_equal(np.asarray(pre), np.asarray(w))
+
+    def test_row_pruning(self):
+        t = CompressionTransform(_comp_cfg(row_pruning={"dense_ratio": 0.5}))
+        w = jnp.asarray(np.random.default_rng(1).normal(size=(32, 16)),
+                        jnp.float32)
+        out = np.asarray(t.apply({"w": w}, step=5)["w"])
+        zero_rows = (out == 0).all(axis=1).sum()
+        assert zero_rows == 16
+
+    def test_head_pruning(self):
+        t = CompressionTransform(_comp_cfg(
+            head_pruning={"dense_ratio": 0.5, "num_heads": 4}))
+        w = jnp.asarray(np.random.default_rng(2).normal(size=(64, 32)),
+                        jnp.float32)
+        out = np.asarray(t.apply({"wo": w}, step=5)["wo"])
+        per_head = out.reshape(4, 16, 32)
+        dead = [(per_head[h] == 0).all() for h in range(4)]
+        assert sum(dead) == 2
+
+    def test_engine_qat_training(self, devices8):
+        """QAT: weight fake-quant active after schedule_offset; training
+        still converges and masters stay full precision."""
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla"))
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False},
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {"enabled": True,
+                                          "schedule_offset": 2},
+                    "different_groups": {
+                        "q8": {"params": {"target_bits": 8},
+                               "modules": ["*"]}}}},
+            "steps_per_print": 1000})
+        b = make_batch(8, 32, vocab=64)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(8)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        # master weights are NOT quantized (distinct values beyond 256 levels)
+        w = np.asarray(jax.device_get(
+            engine.state["params"]["layers"]["w_in"])).reshape(-1)
+        assert len(np.unique(np.round(w, 6))) > 300
+
+    def test_redundancy_clean_exports_pruned(self):
+        cfg = _comp_cfg(sparse_pruning={"dense_ratio": 0.5})
+        params = {"w": jnp.asarray(
+            np.random.default_rng(3).normal(size=(64, 64)), jnp.float32)}
+        out = redundancy_clean(params, cfg)
+        assert np.count_nonzero(np.asarray(out["w"])) <= 0.51 * 64 * 64
+
+    def test_activation_quant_rejected(self):
+        with pytest.raises(NotImplementedError):
+            CompressionTransform(_comp_cfg(
+                activation_quantization={"bits": 8}))

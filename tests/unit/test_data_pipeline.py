@@ -1,0 +1,167 @@
+"""Data efficiency tests (reference: runtime/data_pipeline/
+curriculum_scheduler.py, data_sampler.py, data_routing/basic_layer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DistributedSampler, RandomLTDScheduler,
+    apply_seqlen_curriculum, random_ltd_layer)
+from tests.conftest import make_batch
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 128,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {
+                                     "total_curriculum_step": 100,
+                                     "difficulty_step": 8}})
+        assert s.update_difficulty(0) == 8
+        mid = s.update_difficulty(50)
+        assert 60 <= mid <= 76 and mid % 8 == 0
+        assert s.update_difficulty(100) == 128
+        assert s.update_difficulty(10**6) == 128
+
+    def test_fixed_root_grows_faster_early(self):
+        lin = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 128,
+                                   "schedule_type": "fixed_linear",
+                                   "schedule_config": {
+                                       "total_curriculum_step": 100}})
+        root = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 128,
+                                    "schedule_type": "fixed_root",
+                                    "schedule_config": {
+                                        "total_curriculum_step": 100,
+                                        "root_degree": 2}})
+        assert root.update_difficulty(25) > lin.update_difficulty(25)
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({"schedule_type": "fixed_discrete",
+                                 "min_difficulty": 8, "max_difficulty": 64,
+                                 "schedule_config": {
+                                     "difficulty": [16, 32, 64],
+                                     "max_step": [10, 20, 30]}})
+        assert s.update_difficulty(5) == 16
+        assert s.update_difficulty(15) == 32
+        assert s.update_difficulty(99) == 64
+
+    def test_truncation(self):
+        b = {"input_ids": np.ones((4, 64), np.int32),
+             "labels": np.ones((4, 64), np.int32)}
+        out = apply_seqlen_curriculum(b, 16)
+        assert out["input_ids"].shape == (4, 16)
+
+    def test_engine_curriculum_seqlen(self, devices8):
+        """Engine truncates batches per schedule; short early steps train."""
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla"))
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 16, "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 16}},
+            "steps_per_print": 1000})
+        b = make_batch(8, 64, vocab=64)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(6)]
+        assert np.isfinite(losses).all()
+        assert engine._curriculum.get_current_difficulty() == 64
+
+
+class TestDistributedSampler:
+    def test_partition_and_coverage(self):
+        idx = []
+        for r in range(4):
+            s = DistributedSampler(103, num_replicas=4, rank=r, shuffle=True,
+                                   seed=7)
+            part = list(s)
+            assert len(part) == 103 // 4
+            idx.extend(part)
+        assert len(set(idx)) == len(idx)  # disjoint across ranks
+
+    def test_epoch_reshuffles(self):
+        s = DistributedSampler(64, num_replicas=2, rank=0, shuffle=True)
+        a = list(s)
+        s.set_epoch(1)
+        b = list(s)
+        assert a != b and sorted(a) != sorted(b) or set(a) != set(b)
+
+    def test_no_drop_last_pads(self):
+        total = []
+        for r in range(4):
+            s = DistributedSampler(10, num_replicas=4, rank=r, shuffle=False,
+                                   drop_last=False)
+            total.extend(list(s))
+        assert len(total) == 12 and set(total) == set(range(10))
+
+    def test_dataloader_integration(self):
+        from deepspeed_tpu.runtime.dataloader import DataLoader
+        data = [{"x": np.full((2,), i, np.int32)} for i in range(40)]
+        s = DistributedSampler(40, num_replicas=2, rank=1, shuffle=False)
+        dl = DataLoader(data, batch_size=5, sampler=s)
+        batches = list(dl)
+        assert len(batches) == 4
+        seen = {int(v[0]) for b in batches for v in b["x"]}
+        assert seen == set(range(20, 40))  # rank 1's contiguous shard
+
+
+class TestRandomLTD:
+    def test_layer_subset_passthrough(self):
+        """Un-selected tokens pass through unchanged; selected ones get the
+        layer applied with their true positions."""
+        B, S, H, keep = 2, 16, 8, 8
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(B, S, H)),
+                        jnp.float32)
+
+        def layer_fn(xs, positions=None, mask=None):
+            return xs + 1.0, jnp.float32(0.0)
+
+        y, aux = random_ltd_layer(x, layer_fn, keep, jax.random.PRNGKey(0),
+                                  positions=None, mask=None)
+        delta = np.asarray(y - x)
+        changed = (np.abs(delta) > 1e-6).any(axis=-1)
+        assert changed.sum() == B * keep  # exactly keep tokens per row
+
+    def test_keep_all_is_identity_path(self):
+        x = jnp.ones((1, 8, 4))
+        y = random_ltd_layer(x, lambda xs, **kw: xs * 2, 8,
+                             jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(y), 2 * np.asarray(x))
+
+    def test_scheduler_buckets(self):
+        s = RandomLTDScheduler({"random_ltd": {
+            "min_value": 64, "max_value": 512,
+            "total_steps": 100, "seq_step": 64}})
+        assert s.kept_tokens(0, 512) == 64
+        assert s.kept_tokens(100, 512) == 512
+        assert s.kept_tokens(50, 512) % 64 == 0
+        assert s.kept_tokens(50, 128) == 128  # capped at seq
+
+    def test_engine_random_ltd_trains(self, devices8):
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla"))
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False},
+            "data_efficiency": {
+                "enabled": True,
+                "data_routing": {"random_ltd": {
+                    "enabled": True, "min_value": 16, "max_value": 64,
+                    "total_steps": 4, "seq_step": 16}}},
+            "steps_per_print": 1000})
+        b = make_batch(8, 64, vocab=64)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(6)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        # schedule reached full seq -> model back to dense
+        assert engine._ltd_keep == 64

@@ -1,0 +1,72 @@
+"""Elasticity tests (reference: elasticity/elasticity.py + the reference's
+tests/unit/elasticity/test_elastic.py cases)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (
+    ElasticityError, compute_elastic_config, get_compatible_gpus)
+from deepspeed_tpu.models import TransformerConfig, make_model
+from tests.conftest import make_batch
+
+
+def test_compatible_gpus():
+    gpus = get_compatible_gpus(96, [2, 4], min_gpus=1, max_gpus=50)
+    assert 48 in gpus and 24 in gpus and 8 in gpus
+    assert 5 not in gpus  # 96 % (5*2) and % (5*4) both nonzero
+
+
+def test_compute_config_basic():
+    fb, valid, micro = compute_elastic_config(
+        {"enabled": True, "max_train_batch_size": 2000,
+         "micro_batch_sizes": [2, 4, 6], "min_gpus": 1, "max_gpus": 64},
+        world_size=8)
+    assert fb <= 2000 and fb % 8 == 0
+    assert 8 in valid
+    assert micro in (2, 4, 6) and (fb // 8) % micro == 0
+
+
+def test_incompatible_world_size_raises():
+    with pytest.raises(ElasticityError, match="not compatible"):
+        compute_elastic_config(
+            {"enabled": True, "max_train_batch_size": 8,
+             "micro_batch_sizes": [8], "min_gpus": 1, "max_gpus": 64},
+            world_size=3)
+
+
+def test_disabled_raises():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"enabled": False})
+
+
+def test_engine_elastic_batch(devices8):
+    """initialize() with elasticity picks batch/micro/gas for 8 devices."""
+    import jax.numpy as jnp
+    model = make_model(TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=32, dtype=jnp.float32, attention_impl="xla"))
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                       "max_gpus": 16},
+        "steps_per_print": 1000})
+    B = engine.config.train_batch_size
+    assert B <= 64 and B % 8 == 0
+    b = make_batch(B, 32, vocab=64)
+    loss = float(engine.train_batch(b)["loss"])
+    assert np.isfinite(loss)
+
+
+def test_engine_elastic_conflicting_batch_raises(devices8):
+    import jax.numpy as jnp
+    model = make_model(TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=32, dtype=jnp.float32, attention_impl="xla"))
+    with pytest.raises(ValueError, match="elasticity"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 16,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "elasticity": {"enabled": True}})

@@ -133,6 +133,12 @@ def test_gpt2_untied_lm_head():
     ours = np.asarray(forward(params, jnp.asarray(ids), tcfg))
     np.testing.assert_allclose(ours, _hf_logits(model, ids), rtol=2e-4,
                                atol=2e-4)
+    # export round-trips the untied head too
+    sd = export_hf_state_dict(params, tcfg, family="gpt2")
+    assert "lm_head.weight" in sd
+    params2 = load_hf_params(sd, tcfg)
+    np.testing.assert_array_equal(np.asarray(params["lm_head"]),
+                                  np.asarray(params2["lm_head"]))
 
 
 def test_export_roundtrip(tiny_llama):

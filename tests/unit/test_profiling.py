@@ -1,0 +1,123 @@
+"""Flops profiler + autotuner tests (reference:
+flops_profiler/profiler.py:20, autotuning/autotuner.py:39)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
+from tests.conftest import make_batch
+
+
+def _tiny(**kw):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=4, num_heads=4,
+                max_seq_len=64, dtype=jnp.float32, attention_impl="xla",
+                tie_embeddings=True, position_type="learned",
+                activation="gelu", norm_type="layernorm")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+class TestFlopsProfiler:
+    def test_analytic_matches_6nd(self):
+        """Forward flops of the LM must land near the 2*N*D estimate (dense
+        matmul-dominated model: 2 flops/param/token forward)."""
+        cfg = _tiny()
+        model = make_model(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        B, S = 4, 64
+        ids = jnp.zeros((B, S), jnp.int32)
+        prof = get_model_profile(lambda p, i: model.apply(p, i), params, ids,
+                                 backend_analysis=False)
+        n_matmul_params = (
+            cfg.num_layers * (4 * cfg.hidden_size ** 2
+                              + 2 * cfg.hidden_size * cfg.ffn_dim)
+            + cfg.hidden_size * cfg.vocab_size)  # lm head (tied)
+        expect = 2 * n_matmul_params * B * S
+        assert 0.8 * expect < prof["flops"] < 1.6 * expect, \
+            (prof["flops"], expect)
+        assert prof["params"] > 0
+        assert "dot_general" in prof["flops_by_primitive"]
+        # matmuls must dominate
+        assert (prof["flops_by_primitive"]["dot_general"]
+                > 0.6 * prof["flops"])
+
+    def test_scan_layers_counted(self):
+        """lax.scan over layers multiplies flops by depth: the 8-layer model
+        must profile ~2x the 4-layer model."""
+        def fwd(cfg):
+            model = make_model(cfg)
+            p = model.init(jax.random.PRNGKey(0))
+            ids = jnp.zeros((2, 64), jnp.int32)
+            return get_model_profile(lambda q, i: model.apply(q, i), p, ids,
+                                     backend_analysis=False)["flops"]
+        f4, f8 = fwd(_tiny(num_layers=4)), fwd(_tiny(num_layers=8))
+        assert 1.6 < f8 / f4 < 2.2, (f4, f8)
+
+    def test_engine_integration_prints_profile(self, devices8, caplog):
+        model = make_model(_tiny())
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": False},
+            "flops_profiler": {"enabled": True, "profile_step": 2},
+            "steps_per_print": 1000})
+        b = make_batch(8, 64, vocab=128)
+        for _ in range(3):
+            engine.train_batch(b)
+        prof = getattr(engine, "flops_profile", None)
+        assert prof is not None and prof["flops"] > 0
+        assert prof["mfu"] > 0 and prof["step_latency_s"] > 0
+        assert prof["flops_by_module"]
+
+
+class TestAutotuner:
+    def test_candidates_cover_mesh_space(self, devices8):
+        from deepspeed_tpu.autotuning import Autotuner
+        model = make_model(_tiny())
+        t = Autotuner(model, {"train_batch_size": 16,
+                              "autotuning": {"tuner_num_trials": 100}})
+        cands = t.candidates()
+        assert len(cands) > 4
+        meshes = {tuple(sorted(c["mesh"]["axes"].items())) for c in cands}
+        assert (("data", 8), ("tensor", 1)) in meshes
+        assert (("fsdp", 8), ("tensor", 1)) in meshes
+        assert any(dict(m).get("tensor") == 4 for m in meshes)
+
+    def test_autotune_picks_valid_config(self, devices8, tmp_path):
+        """End-to-end: autotuning enabled selects a runnable config at least
+        as fast as the measured candidates, engine trains with it."""
+        model = make_model(_tiny(num_layers=2))
+        cfg = {
+            "train_batch_size": 16,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": False},
+            "autotuning": {"enabled": True, "tuner_num_trials": 3,
+                           "tuner_early_stopping": 0,
+                           "results_dir": str(tmp_path / "at")},
+            "steps_per_print": 1000}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        assert not engine.config.autotuning.enabled
+        b = make_batch(16, 64, vocab=128)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(3)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        import json, os
+        results = json.load(open(tmp_path / "at" / "results.json"))
+        assert len(results) >= 1
+        assert any(r["error"] is None for r in results)
+
+    def test_failed_candidates_score_neg_inf(self, devices8):
+        from deepspeed_tpu.autotuning import Autotuner
+        model = make_model(_tiny(num_layers=2))
+        t = Autotuner(model, {"train_batch_size": 16,
+                              "optimizer": {"type": "adamw",
+                                            "params": {"lr": 1e-3}},
+                              "bf16": {"enabled": False}})
+        trial = t.measure({"mesh": {"axes": {"data": 3}},  # 3 does not divide 8
+                           "zero_optimization": {"stage": 0},
+                           "gradient_accumulation_steps": 1})
+        assert trial.error is not None
+        assert trial.samples_per_sec == float("-inf")
