@@ -248,6 +248,14 @@ class CurriculumConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class PLDConfig(ConfigModel):
+    """Reference: ``runtime/progressive_layer_drop.py`` (theta/gamma keys)."""
+    enabled: bool = False
+    theta: float = 0.5     # keep-probability floor
+    gamma: float = 0.001   # decay rate of theta(t) toward the floor
+
+
+@dataclasses.dataclass
 class DataEfficiencyConfig(ConfigModel):
     enabled: bool = False
     seed: int = 1234
@@ -349,6 +357,7 @@ class Config(ConfigModel):
     aio: AIOConfig = config_field(AIOConfig)
     checkpoint: CheckpointConfig = config_field(CheckpointConfig)
     curriculum_learning: CurriculumConfig = config_field(CurriculumConfig)
+    progressive_layer_drop: PLDConfig = config_field(PLDConfig)
     data_efficiency: DataEfficiencyConfig = config_field(DataEfficiencyConfig)
     compression_training: CompressionConfig = config_field(CompressionConfig)
     elasticity: ElasticityConfig = config_field(ElasticityConfig)

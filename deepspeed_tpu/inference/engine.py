@@ -41,6 +41,10 @@ class InferenceConfig:
     max_batch_size: int = 8
     replace_with_kernel_inject: bool = True   # = use Pallas attention path
     enable_cuda_graph: bool = False           # no-op: jit caches by design
+    # int8 weight-only quantization (reference: inference int8 kernel path,
+    # csrc/transformer/inference): layer weights stored int8 in HBM,
+    # dequantized one layer at a time inside the scan
+    quantize_bits: Optional[int] = None
 
 
 class InferenceEngine:
@@ -63,13 +67,43 @@ class InferenceEngine:
         set_parallel_context(mesh, self._plan)
         self.dtype = config.dtype or jnp.bfloat16
 
+        # int8 weight-only quantization: rebuild the model with the
+        # dequant-in-scan forward and the {"q","scale"} param structure
+        self._quantized = bool(config.quantize_bits)
+        if self._quantized:
+            import dataclasses as _dc
+            from deepspeed_tpu.models.transformer import (
+                TransformerConfig, quantized_logical_axes)
+            from deepspeed_tpu.models import make_model as _mk
+            if not isinstance(getattr(model, "config", None),
+                              TransformerConfig):
+                raise ValueError("quantize_bits requires a transformer "
+                                 "ModelSpec")
+            qcfg = _dc.replace(model.config, quantized_weights=True)
+            model = _dc.replace(_mk(qcfg, name=model.name),
+                                logical_axes=quantized_logical_axes(qcfg))
+            self.model = model
+
         # AutoTP equivalent: logical axes -> tensor-axis sharding
         rules = make_rules(zero_stage=0, tp=tp > 1)
         self.param_specs = spec_tree(model.logical_axes, rules)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.param_specs,
             is_leaf=lambda x: isinstance(x, P))
-        if params is None:
+        if self._quantized:
+            from deepspeed_tpu.models.transformer import quantize_layer_stack
+            if params is None:
+                rng = rng if rng is not None else jax.random.PRNGKey(0)
+                params = model.init(rng)
+            quant_fn = jax.jit(
+                lambda p: quantize_layer_stack(jax.tree.map(
+                    lambda x: x.astype(self.dtype)
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else x, p), bits=int(config.quantize_bits)),
+                out_shardings=self.param_shardings)
+            with mesh:
+                params = quant_fn(jax.tree.map(jnp.asarray, params))
+        elif params is None:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
             init_fn = jax.jit(
                 lambda k: jax.tree.map(lambda p: p.astype(self.dtype), model.init(k)),

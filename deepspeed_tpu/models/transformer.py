@@ -53,6 +53,12 @@ class TransformerConfig:
     # sparsity_config.py). e.g. {"mode": "bigbird", "block": 128,
     # "num_random_blocks": 1, ...}; None -> dense/flash attention.
     sparse_attention: Optional[Dict[str, Any]] = None
+    # int8 weight-only quantized inference (reference: the int8 weight path
+    # of csrc/transformer/inference + model_implementations quantization):
+    # layer-stack weights live in HBM as {"q": int8, "scale": f32} and the
+    # scan body dequantizes ONE layer's slice — peak bf16 weight residency is
+    # a single layer. Convert with models.quantize_layer_stack.
+    quantized_weights: bool = False
     # MoE (reference: deepspeed/moe/*; config keys from MoEConfig)
     num_experts: int = 1
     top_k: int = 2
@@ -73,6 +79,16 @@ class TransformerConfig:
     # last layers always run dense, matching the reference's reserved layers.
     random_ltd: bool = False
     random_ltd_keep: int = 0
+    # chunked cross-entropy: compute head matmul + CE per sequence chunk so
+    # the fp32 [B,S,V] logits never materialize (12*B*S*V bytes -> 12*B*c*V).
+    # The chunk body is rematerialized in backward. 0 = off.
+    loss_chunk: int = 0
+    # Progressive Layer Drop (reference: runtime/progressive_layer_drop.py +
+    # the PLD paper): during training, layer i survives with probability
+    # 1 - (i+1)/L * (1 - theta), theta following the engine's exp-decay
+    # schedule (passed per step as batch["_pld_theta"]). Dropped layers are
+    # identity — a real lax.cond, so the FLOPs are actually saved.
+    progressive_layer_drop: bool = False
     # ZeRO-Infinity param offload: stacked layer weights live in pinned host
     # DRAM; each scan step transfers ONE layer into HBM (and the remat replay
     # re-fetches it during backward), so peak HBM holds ~1 layer of params.
@@ -324,6 +340,14 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
     """q: [B,S,Nq,D], k/v: [B,S,Nkv,D] -> [B,S,Nq,D]."""
     B, S, Nq, D = q.shape
     Nkv = k.shape[2]
+    # the Pallas flash kernel is GQA-native (K/V never repeated in HBM);
+    # other paths get the repeated view
+    if _use_pallas(cfg, S) and mask is None and segment_ids is None \
+            and not cfg.sparse_attention:
+        from deepspeed_tpu.parallel.context import seq_parallel_degree
+        if seq_parallel_degree() <= 1:
+            from deepspeed_tpu.ops.flash_attention import flash_attention as fa
+            return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D))
     if Nkv != Nq:  # GQA: repeat kv heads
         rep = Nq // Nkv
         k = jnp.repeat(k, rep, axis=2)
@@ -341,9 +365,6 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         mode = sa.pop("mode", "fixed")
         return _sparse_attn(q, k, v, get_sparsity_config(mode, **sa),
                             causal=causal, sm_scale=1.0 / math.sqrt(D))
-    if _use_pallas(cfg, S) and mask is None and segment_ids is None:
-        from deepspeed_tpu.ops.flash_attention import flash_attention as fa
-        return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D))
     scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32)
     scores = scores / math.sqrt(D)
     if causal:
@@ -384,6 +405,57 @@ def _decode_attention(q, ck, cv, index):
     return out.reshape(B, 1, Nq, D)
 
 
+def _maybe_dequant(p, cfg: TransformerConfig):
+    """int8 weight-only inference: {"q", "scale"} leaves -> compute dtype.
+    Called on ONE layer's slice inside the scan, so the dequantized bf16
+    weights of only that layer are ever live."""
+    if not cfg.quantized_weights:
+        return p
+
+    def one(v):
+        if isinstance(v, dict) and "q" in v and "scale" in v:
+            return (v["q"].astype(cfg.dtype)
+                    * v["scale"].astype(cfg.dtype))
+        return v
+    return {k: one(v) for k, v in p.items()}
+
+
+def quantize_layer_stack(params: Params, bits: int = 8) -> Params:
+    """Convert the stacked layer weights to int8 + per-(layer, out-channel)
+    scales, for cfg.quantized_weights inference. Norm scales/biases stay
+    full precision."""
+    if bits != 8:
+        raise ValueError("weight-only inference quantization supports int8")
+
+    def one(w):
+        # matmul weights only: [L, In, Out] (+MoE [L, E, In, Out]); norm
+        # scales/biases ([L, H]) stay full precision
+        if not hasattr(w, "ndim") or w.ndim < 3 or w.dtype == jnp.int8:
+            return w
+        w32 = jnp.asarray(w, jnp.float32)
+        amax = jnp.max(jnp.abs(w32), axis=tuple(range(1, w.ndim - 1)),
+                       keepdims=True)  # per (layer, out-col)
+        scale = jnp.maximum(amax / 127.0, 1e-12)
+        q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+        return {"q": q, "scale": scale.astype(jnp.float32)}
+
+    out = dict(params)
+    out["layers"] = {k: one(v) for k, v in params["layers"].items()}
+    return out
+
+
+def quantized_logical_axes(cfg: TransformerConfig) -> Params:
+    """logical_axes variant matching the quantize_layer_stack structure."""
+    axes = logical_axes(cfg)
+
+    def one(a):
+        if a is None or len(a) < 3:
+            return a
+        return {"q": a, "scale": (a[0],) + (None,) * (len(a) - 2) + (a[-1],)}
+    axes["layers"] = {k: one(v) for k, v in axes["layers"].items()}
+    return axes
+
+
 def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                       positions=None, dropout_rng=None, deterministic=True,
                       cache=None, return_kv: bool = False):
@@ -393,7 +465,7 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     written at `index` and attention runs over the buffer. return_kv: also
     return the (post-rotary) K/V so a prefill pass can seed the cache.
     """
-    p = layer_params
+    p = _maybe_dequant(layer_params, cfg)
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
 
@@ -431,12 +503,22 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     aux = jnp.float32(0.0)
     if "wg" in p:  # MoE layer (reference: deepspeed/moe/layer.py MoE)
         from deepspeed_tpu.moe.sharded_moe import moe_ffn
+        from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+        from deepspeed_tpu.parallel.context import current_plan
         moe_params = {"wg": p["wg"], "w_in": p["moe_w_in"],
                       "w_out": p["moe_w_out"]}
         if "moe_w_gate" in p:
             moe_params["w_gate"] = p["moe_w_gate"]
+        plan = current_plan()
+        tp_moe = plan is not None and getattr(plan, "tensor", 1) > 1
+        if tp_moe:
+            # split tokens across the TP group for the gate/dispatch region
+            # (reference: moe/mappings.py drop/gather around the MoE block)
+            h = drop_tokens(h, dim=1)
         moe_out, aux = moe_ffn(moe_params, h, cfg, rng=dropout_rng,
                                train=not deterministic)
+        if tp_moe:
+            moe_out = gather_tokens(moe_out, dim=1)
         if "w_in" in p:  # PR-MoE residual (reference: layer.py use_residual)
             up = h @ p["w_in"].astype(h.dtype)
             if "b_in" in p:
@@ -509,7 +591,8 @@ def _fetch_layer(layer_p, cfg: TransformerConfig):
 def forward(params: Params, input_ids, cfg: TransformerConfig, *,
             attention_mask=None, positions=None, dropout_rng=None,
             deterministic: bool = True, layer_override=None,
-            return_aux: bool = False, return_kv: bool = False):
+            return_aux: bool = False, return_kv: bool = False,
+            return_hidden: bool = False, pld_theta=None):
     """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32).
 
     return_kv: also return the per-layer (post-rotary) K/V stacked on a
@@ -547,9 +630,32 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     use_ltd = (cfg.random_ltd and cfg.random_ltd_keep > 0
                and not deterministic and dropout_rng is not None
                and not return_kv)
+    use_pld = (cfg.progressive_layer_drop and pld_theta is not None
+               and not deterministic and dropout_rng is not None
+               and not return_kv and not use_ltd)
+    if use_pld and not cfg.scan_layers:
+        raise NotImplementedError("progressive_layer_drop requires "
+                                  "scan_layers=True")
     aux_total = jnp.float32(0.0)
     kv_stack = None
-    if cfg.scan_layers and not use_ltd:
+    if cfg.scan_layers and use_pld:
+        L = jax.tree.leaves(layers)[0].shape[0]
+        theta = jnp.asarray(pld_theta, jnp.float32)
+
+        def pld_body(carry, xs):
+            layer_p, li = xs
+            # deeper layers drop more: keep = 1 - (i+1)/L * (1 - theta)
+            keep_p = 1.0 - (li + 1).astype(jnp.float32) / L * (1.0 - theta)
+            coin = jax.random.bernoulli(
+                jax.random.fold_in(dropout_rng, 7919 + li), keep_p)
+            # real branch (collective-free): a dropped layer costs nothing
+            return lax.cond(coin, lambda c: body(c, layer_p),
+                            lambda c: (c, None), carry)
+
+        (x, _, aux_total), kv_stack = lax.scan(
+            pld_body, (x, dropout_rng, aux_total),
+            (layers, jnp.arange(L)))
+    elif cfg.scan_layers and not use_ltd:
         (x, _, aux_total), kv_stack = lax.scan(
             body, (x, dropout_rng, aux_total), layers)
     else:
@@ -591,6 +697,8 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
             kv_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *kvs)
 
     x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+    if return_hidden:
+        return x, aux_total
     head = params.get("lm_head")
     if head is None:
         head = params["tok_embed"].T
@@ -709,6 +817,35 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
     return logits[:, 0, :], {"k": new_k, "v": new_v, "index": index + 1}
 
 
+def chunked_cross_entropy(x, head, labels, chunk: int,
+                          ignore_index: int = -100):
+    """CE over sequence chunks: the fp32 logits exist only chunk-at-a-time
+    (the head matmul re-runs in backward via jax.checkpoint). x: [B,S,H]
+    final hidden (already normed); head: [H,V]."""
+    B, S, H = x.shape
+    c = min(chunk, S)
+    while S % c:
+        c -= 1
+    n = S // c
+
+    def body(carry, i):
+        tot, cnt = carry
+        xc = lax.dynamic_slice_in_dim(x, i * c, c, axis=1)
+        lc = lax.dynamic_slice_in_dim(labels, i * c, c, axis=1)
+        logits = (xc @ head.astype(xc.dtype)).astype(jnp.float32)
+        valid = lc != ignore_index
+        safe = jnp.where(valid, lc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * valid
+        return (tot + nll.sum(), cnt + valid.sum()), None
+
+    body = jax.checkpoint(body, prevent_cse=False)
+    (tot, cnt), _ = lax.scan(body, (jnp.float32(0.0), jnp.int32(0)),
+                             jnp.arange(n))
+    return tot / jnp.maximum(cnt, 1)
+
+
 def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
             deterministic: bool = True):
     """Standard causal-LM loss: predict token t+1 from prefix ≤ t."""
@@ -718,10 +855,22 @@ def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
         labels = jnp.concatenate(
             [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
     mask = batch.get("attention_mask")
-    logits, aux = forward(params, ids, cfg, attention_mask=mask,
-                          dropout_rng=dropout_rng, deterministic=deterministic,
-                          return_aux=True)
-    loss = cross_entropy_loss(logits, labels)
+    pld_theta = batch.get("_pld_theta")
+    if cfg.loss_chunk and cfg.loss_chunk > 0:
+        x, aux = forward(params, ids, cfg, attention_mask=mask,
+                         dropout_rng=dropout_rng,
+                         deterministic=deterministic, return_hidden=True,
+                         pld_theta=pld_theta)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["tok_embed"].T
+        loss = chunked_cross_entropy(x, head, labels, cfg.loss_chunk)
+    else:
+        logits, aux = forward(params, ids, cfg, attention_mask=mask,
+                              dropout_rng=dropout_rng,
+                              deterministic=deterministic, return_aux=True,
+                              pld_theta=pld_theta)
+        loss = cross_entropy_loss(logits, labels)
     if cfg.num_experts > 1:
         loss = loss + cfg.moe_aux_loss_weight * aux
     return loss

@@ -1,0 +1,42 @@
+"""Token drop/gather across the tensor-parallel group for MoE blocks.
+
+Reference: ``deepspeed/moe/mappings.py`` (_DropTokens/_GatherTokens autograd
+ops — scatter the token batch across TP ranks before an MoE block so the
+gate/dispatch work isn't duplicated per rank, all-gather afterwards; with
+`use_tutel`-style layouts this brackets every MoE layer under TP).
+
+TPU-native re-design: the scatter/gather pair is a SHARDING decision, not a
+collective to hand-write — `drop_tokens` constrains the sequence dim onto the
+tensor axis (GSPMD splits the tokens, so gating/dispatch math runs 1/tp-th
+per rank) and `gather_tokens` constrains it back to replicated (GSPMD inserts
+the all-gather, and autodiff transposes it to the reduce-scatter the
+reference implements by hand). The pair is what `moe_ffn` callers use when an
+MoE block sits inside a tensor-parallel region.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["drop_tokens", "gather_tokens"]
+
+
+def _constrain(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (RuntimeError, ValueError):
+        return x  # no mesh in context: single-device / direct call
+
+
+def drop_tokens(x, dim: int = 1, tp_axis: str = "tensor"):
+    """Split the `dim` (sequence) axis of x across the TP group.
+    Reference: mappings.py drop_tokens (scatter_tokens_to_model_parallel)."""
+    spec = [None] * x.ndim
+    spec[dim] = tp_axis
+    return _constrain(x, P(*spec))
+
+
+def gather_tokens(x, dim: int = 1, tp_axis: str = "tensor"):
+    """All-gather the `dim` axis back to replicated over the TP group.
+    Reference: mappings.py gather_tokens (_GatherTokens.apply)."""
+    spec = [None] * x.ndim
+    return _constrain(x, P(*spec))

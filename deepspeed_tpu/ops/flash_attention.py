@@ -1,4 +1,4 @@
-"""Flash attention for TPU in Pallas (fwd + bwd, custom_vjp).
+"""Flash attention for TPU in Pallas (fwd + bwd, custom_vjp, GQA-native).
 
 Capability-equivalent of the reference's fused attention kernels
 (``csrc/transformer/inference/csrc/softmax.cu`` + context kernels and the
@@ -10,6 +10,11 @@ Layout: inputs [B, S, N, D] (seq-major like the models), internally
 [B, N, S, D]. fp32 accumulation, bf16-friendly. Causal masking is computed
 with block-level early-out: fully-masked K blocks are skipped, so causal
 attention does ~half the FLOPs of full.
+
+GQA is native: when n_q_heads > n_kv_heads the grid runs over KV heads and
+each program processes the whole query-head GROUP against one K/V stream —
+K/V are never repeated in HBM and their VMEM loads amortize over the group
+(the naive path repeats K/V n_q/n_kv times).
 
 Backward uses the standard flash decomposition (dQ kernel + joint dK/dV
 kernel) with the forward's log-sum-exp residuals.
@@ -44,20 +49,31 @@ def _pick_blocks(s: int, block_q: int, block_k: int):
     return max(bq, 1), max(bk, 1)
 
 
+def _causal_mask(s, q_start, k_start, rows, block_k, block_q):
+    """rows = rep*block_q stacked row-major by head; row r is query position
+    q_start + (r % block_q)."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_k), 0) % block_q
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_q, block_k, seq_len):
+                rep, block_q, block_k, seq_len):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # [bq, d]
-    d = q.shape[-1]
+    d = q_ref.shape[-1]
+    rows = rep * block_q
+    q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d) * sm_scale
     num_kv = seq_len // block_k
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((rows, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows, 1), jnp.float32)
+    acc0 = jnp.zeros((rows, d), jnp.float32)
 
     q_start = qi * block_q
 
@@ -66,11 +82,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_start, j * block_k, rows, block_k, block_q)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -87,42 +101,49 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m, l, acc = jax.lax.fori_loop(0, num_visible, body, (m0, l0, acc0))
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)   # [bq, 1]
+    o_ref[0, 0] = (acc / l_safe).reshape(rep, block_q, d).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).reshape(rep, block_q, 1)
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
     B, N, S, D = q.shape
+    Nkv = k.shape[1]
+    rep = N // Nkv
     bq, bk = _pick_blocks(S, block_q, block_k)
-    grid = (B, N, S // bq)
+    grid = (B, Nkv, S // bq)
 
-    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, n, i: (b, n, 0, 0),
+    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, g, i: (b, g, 0, 0),
                            memory_space=pltpu.VMEM)
     out_shape = [
         jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
         jax.ShapeDtypeStruct((B, N, S, 1), jnp.float32),
     ]
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=bq, block_k=bk, seq_len=S)
+                               rep=rep, block_q=bq, block_k=bk, seq_len=S)
+    # q viewed as [B, Nkv, rep, S, D]: one program owns the whole head group
+    qg = q.reshape(B, Nkv, rep, S, D)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, n, i: (b, n, i, 0),
+            pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
             kv_spec, kv_spec,
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, n, i: (b, n, i, 0),
+            pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
             # trailing singleton keeps the (sublane, lane) tile legal
-            pl.BlockSpec((1, 1, bq, 1), lambda b, n, i: (b, n, i, 0),
+            pl.BlockSpec((1, 1, rep, bq, 1), lambda b, g, i: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=out_shape,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Nkv, rep, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Nkv, rep, S, 1), jnp.float32),
+        ],
         interpret=_interpret(),
-    )(q, k, v)
-    return o, lse
+    )(qg, k, v)
+    return o.reshape(B, N, S, D), lse.reshape(B, N, S, 1)
 
 
 # --------------------------------------------------------------------------
@@ -130,14 +151,15 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   sm_scale, causal, block_q, block_k, seq_len):
+                   sm_scale, causal, rep, block_q, block_k, seq_len):
     qi = pl.program_id(2)
     q_start = qi * block_q
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]       # [bq, 1]
-    delta = delta_ref[0, 0]   # [bq, 1]
-    d = q.shape[-1]
+    d = q_ref.shape[-1]
+    rows = rep * block_q
+    q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+    do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+    lse = lse_ref[0, 0].reshape(rows, 1)
+    delta = delta_ref[0, 0].reshape(rows, 1)
     num_kv = seq_len // block_k
 
     def body(j, dq):
@@ -146,9 +168,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_start, j * block_k, rows, block_k, block_q)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -161,33 +181,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     else:
         num_visible = num_kv
     dq = jax.lax.fori_loop(0, num_visible, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+                           jnp.zeros((rows, d), jnp.float32))
+    dq_ref[0, 0] = dq.reshape(rep, block_q, d).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
-                    seq_len):
+                    dk_ref, dv_ref, *, sm_scale, causal, rep, block_q,
+                    block_k, seq_len):
     ki = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
     v = v_ref[0, 0].astype(jnp.float32)
     d = k.shape[-1]
     num_q = seq_len // block_q
     k_start = ki * block_k
+    rows = rep * block_q
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]       # [bq,1]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        q = q_ref[0, 0, :, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32).reshape(rows, d)
+        do = do_ref[0, 0, :, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32).reshape(rows, d)
+        lse = lse_ref[0, 0, :, pl.ds(i * block_q, block_q), :].reshape(rows, 1)
+        delta = delta_ref[0, 0, :, pl.ds(i * block_q, block_q), :].reshape(
+            rows, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                        # [bq, bk]
+            s = _causal_mask(s, i * block_q, k_start, rows, block_k, block_q)
+        p = jnp.exp(s - lse)                        # [rows, bk]
         dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -213,63 +235,57 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
     q, k, v, o, lse = residuals
     do = g
     B, N, S, D = q.shape
+    Nkv = k.shape[1]
+    rep = N // Nkv
     bq, bk = _pick_blocks(S, block_q, block_k)
 
     # delta = rowsum(dO * O) — cheap, let XLA fuse it
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B,N,S,1]
 
-    full_spec = pl.BlockSpec((1, 1, S, D), lambda b, n, i: (b, n, 0, 0),
-                             memory_space=pltpu.VMEM)
-    full_vec = pl.BlockSpec((1, 1, S, 1), lambda b, n, i: (b, n, 0, 0),
+    qg = q.reshape(B, Nkv, rep, S, D)
+    dog = do.reshape(B, Nkv, rep, S, D)
+    lseg = lse.reshape(B, Nkv, rep, S, 1)
+    deltag = delta.reshape(B, Nkv, rep, S, 1)
+
+    kv_full = pl.BlockSpec((1, 1, S, D), lambda b, g, i: (b, g, 0, 0),
+                           memory_space=pltpu.VMEM)
+    grp_blk = pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
+                           memory_space=pltpu.VMEM)
+    grp_vec = pl.BlockSpec((1, 1, rep, bq, 1), lambda b, g, i: (b, g, 0, i, 0),
+                           memory_space=pltpu.VMEM)
+    grp_full = pl.BlockSpec((1, 1, rep, S, D), lambda b, g, i: (b, g, 0, 0, 0),
                             memory_space=pltpu.VMEM)
+    grp_full_vec = pl.BlockSpec((1, 1, rep, S, 1),
+                                lambda b, g, i: (b, g, 0, 0, 0),
+                                memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_len=S),
-        grid=(B, N, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            full_spec, full_spec,
-            pl.BlockSpec((1, 1, bq, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, n, i: (b, n, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+                          rep=rep, block_q=bq, block_k=bk, seq_len=S),
+        grid=(B, Nkv, S // bq),
+        in_specs=[grp_blk, kv_full, kv_full, grp_blk, grp_vec, grp_vec],
+        out_specs=grp_blk,
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, rep, S, D), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(qg, k, v, dog, lseg, deltag)
 
+    kv_blk = pl.BlockSpec((1, 1, bk, D), lambda b, g, i: (b, g, i, 0),
+                          memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_len=S),
-        grid=(B, N, S // bk),
-        in_specs=[
-            full_spec,
-            pl.BlockSpec((1, 1, bk, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            full_spec, full_vec, full_vec,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bk, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+                          rep=rep, block_q=bq, block_k=bk, seq_len=S),
+        grid=(B, Nkv, S // bk),
+        in_specs=[grp_full, kv_blk, kv_blk, grp_full, grp_full_vec,
+                  grp_full_vec],
+        out_specs=[kv_blk, kv_blk],
         out_shape=[
-            jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Nkv, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Nkv, S, D), q.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(qg, k, v, dog, lseg, deltag)
+    return dq.reshape(B, N, S, D), dk, dv
 
 
 # --------------------------------------------------------------------------
@@ -298,9 +314,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K):
-    """q, k, v: [B, S, N, D] -> [B, S, N, D]."""
+    """q: [B, S, Nq, D]; k, v: [B, S, Nkv, D] (Nkv may divide Nq: GQA runs
+    natively without repeating K/V) -> [B, S, Nq, D]."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"n_q_heads {q.shape[2]} not divisible by "
+                         f"n_kv_heads {k.shape[2]}")
     qt = jnp.swapaxes(q, 1, 2)  # [B, N, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -310,10 +330,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
 
 def reference_attention(q, k, v, *, causal: bool = True,
                         sm_scale: Optional[float] = None):
-    """XLA reference for parity tests."""
+    """XLA reference for parity tests (handles GQA by repeat)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     B, S, N, D = q.shape
+    if k.shape[2] != N:
+        rep = N // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bsnd,btnd->bnst", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     if causal:

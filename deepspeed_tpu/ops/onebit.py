@@ -56,7 +56,10 @@ def _compress_tree(m_tree, err_tree, axis):
     def one(m_, e_):
         corrected = m_ + e_
         scale = jnp.mean(jnp.abs(corrected))
-        local_comp = jnp.sign(corrected) * scale
+        # MUST match pack_signs' convention (bit=1 for x>=0): jnp.sign maps
+        # 0 -> 0, which would leave a permanent +scale bias on exactly-zero
+        # entries that the error feedback never sees
+        local_comp = jnp.where(corrected >= 0, scale, -scale)
         if axis is None:
             synced = local_comp
         else:

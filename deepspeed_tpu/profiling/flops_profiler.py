@@ -128,12 +128,22 @@ def profile_jaxpr(jaxpr, *, scale: int = 1,
                                     by_scope=by_scope)
             total += t  # trip count unknown; count one iteration
         elif prim == "cond":
-            branch_totals = []
+            # count only the most expensive branch — and only its entries in
+            # the breakdown tables, so they still sum to the total
+            best = None
             for bj in eqn.params["branches"]:
-                t, _, _ = profile_jaxpr(bj.jaxpr, scale=scale, by=by,
-                                        by_scope=by_scope)
-                branch_totals.append(t)
-            total += max(branch_totals) if branch_totals else 0
+                b2: Dict[str, int] = {}
+                bs2: Dict[str, int] = {}
+                t, _, _ = profile_jaxpr(bj.jaxpr, scale=scale, by=b2,
+                                        by_scope=bs2)
+                if best is None or t > best[0]:
+                    best = (t, b2, bs2)
+            if best is not None:
+                total += best[0]
+                for k, v in best[1].items():
+                    by[k] = by.get(k, 0) + v
+                for k, v in best[2].items():
+                    by_scope[k] = by_scope.get(k, 0) + v
         elif any(k in eqn.params for k in ("jaxpr", "call_jaxpr", "fun_jaxpr")):
             for inner in _inner_jaxprs(eqn):
                 t, _, _ = profile_jaxpr(inner, scale=scale, by=by,

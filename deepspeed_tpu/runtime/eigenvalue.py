@@ -1,0 +1,89 @@
+"""Hessian eigenvalue estimation (power iteration).
+
+Reference: ``deepspeed/runtime/eigenvalue.py`` (Eigenvalue — per-block power
+iteration over the loss Hessian using autograd double-backward; feeds MoQ's
+quantization-period scheduling).
+
+TPU-native: the Hessian-vector product is one `jax.jvp`-of-`jax.grad`
+composition (no retained graphs or manual zero_grad), jitted once and
+iterated; per-block estimates come from restricting the probe vector to one
+top-level subtree at a time.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Eigenvalue:
+    """Power-iteration max-|eigenvalue| of the loss Hessian.
+
+    verbose/tol/max_iterations mirror the reference's constructor surface.
+    """
+
+    def __init__(self, verbose: bool = False, max_iterations: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1):
+        self.verbose = verbose
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+
+    def _hvp_fn(self, loss_fn: Callable):
+        def hvp(params, v):
+            return jax.jvp(jax.grad(loss_fn), (params,), (v,))[1]
+        return jax.jit(hvp)
+
+    def compute_eigenvalue(self, loss_fn: Callable, params,
+                           rng: Optional[jax.Array] = None) -> float:
+        """Top |eigenvalue| of d2(loss)/dparams2 via power iteration."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        hvp = self._hvp_fn(loss_fn)
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v = jax.tree.unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.float32)
+            for k, l in zip(keys, leaves)])
+
+        def norm(t):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                                for x in jax.tree.leaves(t)))
+
+        ev = 0.0
+        for i in range(self.max_iterations):
+            n = norm(v)
+            v = jax.tree.map(lambda x: x / (n + self.stability), v)
+            hv = hvp(params, v)
+            new_ev = float(sum(
+                jnp.vdot(a.astype(jnp.float32), b.astype(jnp.float32))
+                for a, b in zip(jax.tree.leaves(v), jax.tree.leaves(hv))))
+            if self.verbose:
+                logger.info(f"eigenvalue iter {i}: {new_ev:.6f}")
+            if i > 0 and abs(new_ev - ev) <= self.tol * max(abs(new_ev), 1e-12):
+                ev = new_ev
+                break
+            ev = new_ev
+            v = hv
+        return abs(ev)
+
+    def compute_blockwise(self, loss_fn: Callable, params,
+                          rng: Optional[jax.Array] = None
+                          ) -> Dict[str, float]:
+        """Per-top-level-subtree eigenvalues (reference: per-layer blocks)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        out = {}
+        for i, key in enumerate(params):
+            sub_rng = jax.random.fold_in(rng, i)
+
+            def block_loss(block, key=key):
+                merged = dict(params)
+                merged[key] = block
+                return loss_fn(merged)
+
+            out[str(key)] = self.compute_eigenvalue(block_loss, params[key],
+                                                    sub_rng)
+        return out

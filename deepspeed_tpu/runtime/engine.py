@@ -22,6 +22,7 @@ API parity:
 
 import dataclasses
 import json
+import math
 import os
 import time
 from functools import partial
@@ -334,8 +335,8 @@ class Engine:
         # param transform inside the step; masters stay full precision
         self._compression = None
         comp_cfg = dataclasses.asdict(config.compression_training)
-        if any((comp_cfg.get(k) or {}).get("shared_parameters", {})
-               .get("enabled")
+        if any(((comp_cfg.get(k) or {}).get("shared_parameters", {})
+                .get("enabled") or (comp_cfg.get(k) or {}).get("enabled"))
                for k in ("weight_quantization", "sparse_pruning",
                          "row_pruning", "head_pruning",
                          "activation_quantization", "channel_pruning",
@@ -380,6 +381,32 @@ class Engine:
                         f"{self._curriculum.min_difficulty} -> "
                         f"{self._curriculum.max_difficulty} over "
                         f"{self._curriculum.total_step} steps")
+        # progressive layer drop (reference: runtime/progressive_layer_drop.py
+        # ProgressiveLayerDrop — theta(t) = (1-theta)*exp(-gamma*t) + theta)
+        self._pld = None
+        if config.progressive_layer_drop.enabled:
+            from deepspeed_tpu.models.transformer import TransformerConfig
+            if not isinstance(getattr(model, "config", None), TransformerConfig):
+                raise ValueError("progressive_layer_drop requires a "
+                                 "transformer ModelSpec")
+            if self._pp_mode:
+                raise ValueError("progressive_layer_drop with pipeline "
+                                 "parallelism is not supported")
+            if not model.config.scan_layers:
+                raise ValueError("progressive_layer_drop requires "
+                                 "scan_layers=True (the drop cond lives in "
+                                 "the layer scan)")
+            if not model.config.progressive_layer_drop:
+                import dataclasses as _dc
+                from deepspeed_tpu.models import make_model as _mk
+                model = _mk(_dc.replace(model.config,
+                                        progressive_layer_drop=True),
+                            name=model.name)
+                self.model = model
+            self._pld = (config.progressive_layer_drop.theta,
+                         config.progressive_layer_drop.gamma)
+            logger.info(f"progressive layer drop: theta_floor={self._pld[0]} "
+                        f"gamma={self._pld[1]}")
         self._ltd = None
         self._ltd_keep = None
         routing = config.data_efficiency.data_routing or {}
@@ -597,6 +624,8 @@ class Engine:
             return grads, loss
 
         def split(x):
+            if getattr(x, "ndim", 0) == 0:  # scalar side-channel (e.g.
+                return jnp.broadcast_to(x, (gas,))  # _pld_theta): replicate
             return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
         mbs = jax.tree.map(split, batch)
@@ -780,7 +809,7 @@ class Engine:
     # 1-bit compressed step (shard_map over data; grads never dense-reduced
     # in the compressed phase — reference: runtime/comm/nccl.py:53)
     # ------------------------------------------------------------------
-    def _get_onebit_step(self, phase: str):
+    def _get_onebit_step(self, phase: str, batch=None):
         if phase in self._onebit_steps:
             return self._onebit_steps[phase]
         cfg = self.config
@@ -834,9 +863,12 @@ class Engine:
                       "opt": spec_of(self.state["opt"], rv),
                       "step": P()}
         out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
+        # per-leaf batch specs: scalar side-channels replicate, rows shard
+        batch_spec = P("data") if batch is None else jax.tree.map(
+            lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(), batch)
         fn = jax.shard_map(
             per_device, mesh=mesh,
-            in_specs=(state_spec, P("data"), P()),
+            in_specs=(state_spec, batch_spec, P()),
             out_specs=(state_spec, out_metrics_spec),
             axis_names={"data"}, check_vma=False)
         step_fn = jax.jit(fn, in_shardings=(self.state_shardings, None, None),
@@ -862,6 +894,13 @@ class Engine:
             batch = apply_seqlen_curriculum(batch, d)
         if self._ltd is not None:
             self._maybe_rebuild_ltd(batch)
+        if self._pld is not None:
+            theta_min, gamma = self._pld
+            theta = ((1.0 - theta_min) * math.exp(-gamma * self.global_steps)
+                     + theta_min)
+            batch = dict(batch)
+            batch["_pld_theta"] = np.float32(theta)  # traced input: the
+            # continuously-decaying theta must not retrigger compilation
         batch = self._device_batch(batch)
         if self._nvme_opt:
             with self.mesh:
@@ -869,7 +908,7 @@ class Engine:
             metrics = self._nvme_apply(grads, mean_loss)
         elif self._onebit_comm:
             phase = self.optimizer.phase_for(self._onebit_applied)
-            step_fn = self._get_onebit_step(phase)
+            step_fn = self._get_onebit_step(phase, batch)
             with self.mesh:
                 self.state, metrics = step_fn(self.state, batch, sub)
             self._onebit_applied += 1

@@ -77,3 +77,39 @@ def test_model_integration_pallas_flag():
     np.testing.assert_allclose(np.asarray(m.apply(params, ids)),
                                np.asarray(mr.apply(params, ids)),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_native_parity(causal):
+    """Nkv < Nq: the kernel runs per KV head over the whole query group —
+    outputs and grads must match the repeat-KV reference."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    B, S, Nq, Nkv, D = 2, 128, 8, 2, 32
+    q = jax.random.normal(ks[0], (B, S, Nq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Nkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Nkv, D), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_fa(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    ga = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(ga, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_gqa_indivisible_heads_raises():
+    q = jnp.zeros((1, 64, 6, 16))
+    k = jnp.zeros((1, 64, 4, 16))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, q)

@@ -132,6 +132,29 @@ def test_generate_beyond_max_seq_len_raises(devices8):
     assert out.shape == (1, 28)
 
 
+def test_int8_weight_only_inference(devices8):
+    """quantize_bits=8: layer weights stored int8 in HBM; logits close to
+    full precision, generate works, payloads really are int8."""
+    cfg = _cfg(hidden_size=128, num_layers=3)
+    model = make_model(cfg)
+    eng_fp = InferenceEngine(model, InferenceConfig(dtype=jnp.float32))
+    eng_q = InferenceEngine(model, InferenceConfig(dtype=jnp.float32,
+                                                   quantize_bits=8),
+                            params=jax.device_get(eng_fp.params))
+    wq = eng_q.params["layers"]["wq"]
+    assert wq["q"].dtype == jnp.int8
+    ids = np.random.default_rng(7).integers(0, cfg.vocab_size,
+                                            size=(2, 12)).astype(np.int32)
+    lf = np.asarray(eng_fp.forward(ids))
+    lq = np.asarray(eng_q.forward(ids))
+    # int8 per-channel: small logit error, same top-1 almost everywhere
+    assert np.abs(lf - lq).max() < 0.2 * np.abs(lf).max()
+    agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree > 0.9, agree
+    out = np.asarray(eng_q.generate(ids, max_new_tokens=6))
+    assert out.shape == (2, 18)
+
+
 def test_generate_temperature_sampling(devices8):
     cfg = _cfg()
     model = make_model(cfg)

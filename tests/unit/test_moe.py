@@ -126,3 +126,19 @@ class TestMoETraining:
         assert params["layers"]["moe_w_in"].shape[1] == 4  # experts
         loss = model.loss_fn(params, make_batch(2, 32, vocab=32000), None, True)
         assert np.isfinite(float(loss))
+
+    def test_moe_with_tensor_parallel(self):
+        """MoE inside a TP region: tokens drop/gather across the tensor
+        group (reference: moe/mappings.py) — same curve as the pure-EP run."""
+        model = make_model(moe_cfg())
+        base, *_ = deepspeed_tpu.initialize(model=model, config=ds_cfg(
+            moe={"enabled": True, "expert_parallel_size": 2}))
+        batch = make_batch(16, 32, vocab=64)
+        ref = [float(base.train_batch(batch)["loss"]) for _ in range(4)]
+
+        model2 = make_model(moe_cfg())
+        tp, *_ = deepspeed_tpu.initialize(model=model2, config=ds_cfg(
+            moe={"enabled": True, "expert_parallel_size": 2},
+            tensor_parallel={"size": 2}))
+        got = [float(tp.train_batch(batch)["loss"]) for _ in range(4)]
+        np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)

@@ -76,7 +76,8 @@ class TestOnebitAdamEngine:
         b = make_batch(16, 32, vocab=64, seed=1)
         comms_logger.configure(enabled=True)
         comms_logger.reset()
-        e = _engine("onebitadam", freeze_kw={"freeze_step": 3})
+        # sign updates oscillate at high lr on this toy loss; 2e-3 converges
+        e = _engine("onebitadam", freeze_kw={"lr": 2e-3, "freeze_step": 3})
         losses = [float(e.train_batch(b)["loss"]) for _ in range(10)]
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]

@@ -1,0 +1,116 @@
+"""Progressive layer drop + eigenvalue tests (reference:
+runtime/progressive_layer_drop.py, runtime/eigenvalue.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from tests.conftest import make_batch
+
+
+class TestPLD:
+    def test_engine_pld_trains_and_theta_decays(self, devices8):
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla"))
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.6,
+                                       "gamma": 0.1},
+            "steps_per_print": 1000})
+        assert engine.model.config.progressive_layer_drop
+        b = make_batch(8, 64, vocab=64)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(8)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_pld_with_grad_accumulation(self, devices8):
+        """The 0-d _pld_theta side-channel must survive the microbatch
+        split (regression: IndexError on scalar leaves)."""
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla"))
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 64, "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.6,
+                                       "gamma": 0.1},
+            "steps_per_print": 1000})
+        b = make_batch(64, 64, vocab=64)
+        losses = [float(engine.train_batch(b)["loss"]) for _ in range(4)]
+        assert np.isfinite(losses).all()
+
+    def test_pld_eval_is_deterministic(self, devices8):
+        """Eval runs all layers (no drop): identical losses across calls."""
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla",
+            progressive_layer_drop=True))
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False}, "steps_per_print": 1000})
+        b = make_batch(8, 64, vocab=64)
+        l1 = float(engine.eval_batch(b))
+        l2 = float(engine.eval_batch(b))
+        assert l1 == l2
+
+    def test_theta_one_matches_dense(self):
+        """theta=1 -> keep prob 1 everywhere -> identical loss to dense."""
+        from deepspeed_tpu.models.transformer import init_params, lm_loss
+        cfg = TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=3, num_heads=2,
+            max_seq_len=32, dtype=jnp.float32, attention_impl="xla",
+            progressive_layer_drop=True)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 32)),
+                          jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        with_pld = lm_loss(params, {"input_ids": ids,
+                                    "_pld_theta": jnp.float32(1.0)},
+                           cfg, dropout_rng=rng, deterministic=False)
+        dense = lm_loss(params, {"input_ids": ids}, cfg,
+                        dropout_rng=rng, deterministic=False)
+        np.testing.assert_allclose(float(with_pld), float(dense), rtol=1e-6)
+
+
+class TestEigenvalue:
+    def test_quadratic_exact(self):
+        """Loss = 0.5 x^T A x has Hessian A; power iteration must find its
+        top eigenvalue."""
+        A = np.diag([5.0, 2.0, 1.0]).astype(np.float32)
+
+        def loss(p):
+            x = p["x"]
+            return 0.5 * x @ jnp.asarray(A) @ x
+
+        ev = Eigenvalue(max_iterations=50, tol=1e-4).compute_eigenvalue(
+            loss, {"x": jnp.ones((3,), jnp.float32)})
+        assert abs(ev - 5.0) < 0.05
+
+    def test_blockwise(self):
+        def loss(p):
+            return 0.5 * (3.0 * jnp.sum(p["a"] ** 2)
+                          + 7.0 * jnp.sum(p["b"] ** 2))
+
+        evs = Eigenvalue(max_iterations=30).compute_blockwise(
+            loss, {"a": jnp.ones((4,)), "b": jnp.ones((2,))})
+        assert abs(evs["a"] - 3.0) < 0.1 and abs(evs["b"] - 7.0) < 0.1
+
+    def test_model_hessian_finite(self):
+        from deepspeed_tpu.models.transformer import init_params, lm_loss
+        cfg = TransformerConfig(
+            vocab_size=32, hidden_size=16, num_layers=1, num_heads=2,
+            max_seq_len=16, dtype=jnp.float32, attention_impl="xla")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 32, (2, 16)),
+                          jnp.int32)
+        ev = Eigenvalue(max_iterations=8).compute_eigenvalue(
+            lambda p: lm_loss(p, {"input_ids": ids}, cfg), params)
+        assert np.isfinite(ev) and ev > 0
