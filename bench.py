@@ -170,7 +170,7 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
         achieved = tok_per_sec * flops_per_token
         peak = accel.peak_flops_per_device("bf16") * max(1, jax.device_count())
         mfu = achieved / peak
-        return {
+        result = {
             "metric": f"llama-{size} bf16 zero1 train MFU (seq={S}, bs={B}, "
                       f"{n_params/1e6:.0f}M params, {accel.device_kind()})",
             "value": round(mfu, 4),
@@ -179,7 +179,38 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
             "tokens_per_sec_per_chip": round(tok_per_sec / max(1, jax.device_count()), 1),
             "step_ms": round(dt / nsteps * 1000, 2),
         }
+        if on_tpu and not (quick or model_size):
+            # the training engine (~90% of HBM with ZeRO state) must go
+            # before a second model of the same size can be built
+            del engine
+            gc.collect()
+            try:
+                result["decode_tok_per_sec"] = _decode_bench(size)
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: decode bench failed: {e}", file=sys.stderr)
+        return result
     raise RuntimeError(f"every bench rung OOM'd; last error: {last_err}")
+
+
+def _decode_bench(size: str, prompt: int = 128, new: int = 128,
+                  batch: int = 8) -> float:
+    """KV-cache decode throughput (generated tokens/sec across the batch) on
+    the same model family — O(n)/token via the jitted scan decode loop."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama_config, make_model
+
+    cfg = llama_config(size, max_seq_len=2048)
+    model = make_model(cfg, name=f"llama-{size}")
+    eng = deepspeed_tpu.init_inference(model, config={"train_batch_size": 1})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch, prompt), dtype=np.int32)
+    np.asarray(jax.device_get(eng.generate(ids, max_new_tokens=new)))  # compile
+    t0 = time.perf_counter()
+    out = eng.generate(ids, max_new_tokens=new)
+    np.asarray(jax.device_get(out))
+    dt = time.perf_counter() - t0
+    return round(batch * new / dt, 1)
 
 
 if __name__ == "__main__":

@@ -340,14 +340,15 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
     """q: [B,S,Nq,D], k/v: [B,S,Nkv,D] -> [B,S,Nq,D]."""
     B, S, Nq, D = q.shape
     Nkv = k.shape[2]
-    # the Pallas flash kernel is GQA-native (K/V never repeated in HBM);
-    # other paths get the repeated view
-    if _use_pallas(cfg, S) and mask is None and segment_ids is None \
+    # the Pallas flash kernel is GQA-native (K/V never repeated in HBM) and
+    # handles key-padding masks in-kernel; other paths get the repeated view
+    if _use_pallas(cfg, S) and segment_ids is None \
             and not cfg.sparse_attention:
         from deepspeed_tpu.parallel.context import seq_parallel_degree
         if seq_parallel_degree() <= 1:
             from deepspeed_tpu.ops.flash_attention import flash_attention as fa
-            return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D))
+            return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D),
+                      kv_mask=mask)
     if Nkv != Nq:  # GQA: repeat kv heads
         rep = Nq // Nkv
         k = jnp.repeat(k, rep, axis=2)

@@ -28,15 +28,19 @@ def _constrain(x, spec):
 
 
 def drop_tokens(x, dim: int = 1, tp_axis: str = "tensor"):
-    """Split the `dim` (sequence) axis of x across the TP group.
+    """Split the `dim` (sequence) axis of x across the TP group. Other dims
+    stay UNCONSTRAINED so an existing data-parallel batch sharding is
+    preserved (None would force an all-gather of the batch over `data`).
     Reference: mappings.py drop_tokens (scatter_tokens_to_model_parallel)."""
-    spec = [None] * x.ndim
+    spec = [P.UNCONSTRAINED] * x.ndim
     spec[dim] = tp_axis
     return _constrain(x, P(*spec))
 
 
 def gather_tokens(x, dim: int = 1, tp_axis: str = "tensor"):
-    """All-gather the `dim` axis back to replicated over the TP group.
-    Reference: mappings.py gather_tokens (_GatherTokens.apply)."""
-    spec = [None] * x.ndim
+    """All-gather the `dim` axis back (un-split over the TP group); other
+    dims stay unconstrained. Reference: mappings.py gather_tokens
+    (_GatherTokens.apply)."""
+    spec = [P.UNCONSTRAINED] * x.ndim
+    spec[dim] = None
     return _constrain(x, P(*spec))

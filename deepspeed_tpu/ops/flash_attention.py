@@ -63,8 +63,8 @@ def _causal_mask(s, q_start, k_start, rows, block_k, block_q):
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                rep, block_q, block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *, sm_scale,
+                causal, rep, block_q, block_k, seq_len):
     qi = pl.program_id(2)
     d = q_ref.shape[-1]
     rows = rep * block_q
@@ -85,6 +85,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, q_start, j * block_k, rows, block_k, block_q)
+        if m_ref is not None:
+            kv_ok = m_ref[0, 0:1, pl.ds(j * block_k, block_k)] > 0
+            s = jnp.where(kv_ok, s, NEG_INF)   # [1,bk] broadcasts over rows
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -105,7 +108,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     lse_ref[0, 0] = (m + jnp.log(l_safe)).reshape(rep, block_q, 1)
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, **kw)
+
+
+def _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
     B, N, S, D = q.shape
     Nkv = k.shape[1]
     rep = N // Nkv
@@ -114,14 +121,14 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
 
     kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, g, i: (b, g, 0, 0),
                            memory_space=pltpu.VMEM)
-    out_shape = [
-        jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
-        jax.ShapeDtypeStruct((B, N, S, 1), jnp.float32),
-    ]
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+    kern = _fwd_kernel if kv_mask is not None else _fwd_kernel_nomask
+    kernel = functools.partial(kern, sm_scale=sm_scale, causal=causal,
                                rep=rep, block_q=bq, block_k=bk, seq_len=S)
     # q viewed as [B, Nkv, rep, S, D]: one program owns the whole head group
     qg = q.reshape(B, Nkv, rep, S, D)
+    mask_spec = pl.BlockSpec((1, 8, S), lambda b, g, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM)
+    extra = () if kv_mask is None else (kv_mask,)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -129,7 +136,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
             pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
             kv_spec, kv_spec,
-        ],
+        ] + ([mask_spec] if kv_mask is not None else []),
         out_specs=[
             pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
@@ -142,7 +149,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((B, Nkv, rep, S, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qg, k, v)
+    )(qg, k, v, *extra)
     return o.reshape(B, N, S, D), lse.reshape(B, N, S, 1)
 
 
@@ -150,8 +157,9 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
 # backward
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   sm_scale, causal, rep, block_q, block_k, seq_len):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
+                   dq_ref, *, sm_scale, causal, rep, block_q, block_k,
+                   seq_len):
     qi = pl.program_id(2)
     q_start = qi * block_q
     d = q_ref.shape[-1]
@@ -169,6 +177,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, q_start, j * block_k, rows, block_k, block_q)
+        if m_ref is not None:
+            kv_ok = m_ref[0, 0:1, pl.ds(j * block_k, block_k)] > 0
+            s = jnp.where(kv_ok, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -185,10 +196,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, 0] = dq.reshape(rep, block_q, d).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
                     dk_ref, dv_ref, *, sm_scale, causal, rep, block_q,
                     block_k, seq_len):
     ki = pl.program_id(2)
+    bi = pl.program_id(0)
     k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
     v = v_ref[0, 0].astype(jnp.float32)
     d = k.shape[-1]
@@ -209,6 +221,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, i * block_q, k_start, rows, block_k, block_q)
+        if m_ref is not None:
+            kv_ok = m_ref[0, 0:1, pl.ds(k_start, block_k)] > 0
+            s = jnp.where(kv_ok, s, NEG_INF)
         p = jnp.exp(s - lse)                        # [rows, bk]
         dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
@@ -231,8 +246,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, **kw):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+                   dq_ref, **kw)
+
+
+def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, **kw):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+                    dk_ref, dv_ref, **kw)
+
+
 def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
-    q, k, v, o, lse = residuals
+    q, k, v, kv_mask, o, lse = residuals
     do = g
     B, N, S, D = q.shape
     Nkv = k.shape[1]
@@ -260,31 +287,39 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
                                 lambda b, g, i: (b, g, 0, 0, 0),
                                 memory_space=pltpu.VMEM)
 
+    mask_spec = pl.BlockSpec((1, 8, S), lambda b, g, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM)
+    extra = () if kv_mask is None else (kv_mask,)
+    dq_kern = _bwd_dq_kernel if kv_mask is not None else _bwd_dq_kernel_nomask
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(dq_kern, sm_scale=sm_scale, causal=causal,
                           rep=rep, block_q=bq, block_k=bk, seq_len=S),
         grid=(B, Nkv, S // bq),
-        in_specs=[grp_blk, kv_full, kv_full, grp_blk, grp_vec, grp_vec],
+        in_specs=[grp_blk, kv_full, kv_full, grp_blk, grp_vec, grp_vec]
+        + ([mask_spec] if kv_mask is not None else []),
         out_specs=grp_blk,
         out_shape=jax.ShapeDtypeStruct((B, Nkv, rep, S, D), q.dtype),
         interpret=_interpret(),
-    )(qg, k, v, dog, lseg, deltag)
+    )(qg, k, v, dog, lseg, deltag, *extra)
 
     kv_blk = pl.BlockSpec((1, 1, bk, D), lambda b, g, i: (b, g, i, 0),
                           memory_space=pltpu.VMEM)
+    dkv_kern = (_bwd_dkv_kernel if kv_mask is not None
+                else _bwd_dkv_kernel_nomask)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(dkv_kern, sm_scale=sm_scale, causal=causal,
                           rep=rep, block_q=bq, block_k=bk, seq_len=S),
         grid=(B, Nkv, S // bk),
         in_specs=[grp_full, kv_blk, kv_blk, grp_full, grp_full_vec,
-                  grp_full_vec],
+                  grp_full_vec]
+        + ([mask_spec] if kv_mask is not None else []),
         out_specs=[kv_blk, kv_blk],
         out_shape=[
             jax.ShapeDtypeStruct((B, Nkv, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, Nkv, S, D), q.dtype),
         ],
         interpret=_interpret(),
-    )(qg, k, v, dog, lseg, deltag)
+    )(qg, k, v, dog, lseg, deltag, *extra)
     return dq.reshape(B, N, S, D), dk, dv
 
 
@@ -292,19 +327,24 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
 # public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k)
     return o
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k)
+    return o, (q, k, v, kv_mask, o, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, g):
-    return _bwd(sm_scale, causal, block_q, block_k, residuals, g)
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, residuals, g)
+    kv_mask = residuals[3]
+    import numpy as _np
+    dmask = (None if kv_mask is None
+             else _np.zeros(kv_mask.shape, jax.dtypes.float0))
+    return dq, dk, dv, dmask
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -312,10 +352,14 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
+                    kv_mask=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K):
     """q: [B, S, Nq, D]; k, v: [B, S, Nkv, D] (Nkv may divide Nq: GQA runs
-    natively without repeating K/V) -> [B, S, Nq, D]."""
+    natively without repeating K/V) -> [B, S, Nq, D].
+
+    kv_mask: optional [B, S] bool/int padding mask over keys — masked
+    positions are excluded inside the kernel (no O(S^2) fallback)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if q.shape[2] % k.shape[2]:
@@ -324,7 +368,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qt = jnp.swapaxes(q, 1, 2)  # [B, N, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash(qt, kt, vt, float(sm_scale), bool(causal), block_q, block_k)
+    if kv_mask is not None:
+        kv_mask = jnp.asarray(kv_mask).astype(jnp.float32)
+        # (B, 8, S): the sublane-broadcast copy satisfies Mosaic's dynamic
+        # sublane-index alignment rule (int8 [B,S] rows can't be dynamically
+        # indexed); 8x a [B,S] int8 is negligible
+        kv_mask = jnp.broadcast_to(kv_mask[:, None, :],
+                                   (kv_mask.shape[0], 8, kv_mask.shape[1]))
+    o = _flash(qt, kt, vt, kv_mask, float(sm_scale), bool(causal), block_q,
+               block_k)
     return jnp.swapaxes(o, 1, 2)
 
 

@@ -45,13 +45,16 @@ class Eigenvalue:
         hvp = self._hvp_fn(loss_fn)
         leaves, treedef = jax.tree.flatten(params)
         keys = jax.random.split(rng, len(leaves))
+        # probe must match the param dtypes (jvp rejects mismatched tangents
+        # — bf16 params are the norm here); norms/vdots still accumulate f32
         v = jax.tree.unflatten(treedef, [
-            jax.random.normal(k, l.shape, jnp.float32)
+            jax.random.normal(k, l.shape, l.dtype)
             for k, l in zip(keys, leaves)])
 
         def norm(t):
             return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
-                                for x in jax.tree.leaves(t)))
+                                for x in jax.tree.leaves(t))).astype(
+                jax.tree.leaves(t)[0].dtype)
 
         ev = 0.0
         for i in range(self.max_iterations):

@@ -103,6 +103,15 @@ class TestEigenvalue:
             loss, {"a": jnp.ones((4,)), "b": jnp.ones((2,))})
         assert abs(evs["a"] - 3.0) < 0.1 and abs(evs["b"] - 7.0) < 0.1
 
+    def test_bf16_params(self):
+        """Probe vector must match param dtype (bf16 is the training norm)."""
+        def loss(p):
+            return 0.5 * 4.0 * jnp.sum(p["x"].astype(jnp.float32) ** 2)
+
+        ev = Eigenvalue(max_iterations=30).compute_eigenvalue(
+            loss, {"x": jnp.ones((8,), jnp.bfloat16)})
+        assert abs(ev - 4.0) < 0.2
+
     def test_model_hessian_finite(self):
         from deepspeed_tpu.models.transformer import init_params, lm_loss
         cfg = TransformerConfig(
