@@ -15,8 +15,9 @@ Embedding runs on stage 0, the loss head on the last stage (both under
 TiedLayerSpec allreduce machinery: the embed and head cotangents meet in the
 same psum over the pipe axis. Dropout and attention masks are supported
 (dropout RNG is derived deterministically from (microbatch, layer) so the
-1F1B backward's recompute sees the same mask). MoE layers inside the
-pipelined stack are still rejected — use pp=1 with expert parallelism.
+1F1B backward's recompute sees the same mask). MoE layers run inside the
+pipelined stack too (reference PP+MoE): each stage accumulates its layers'
+aux losses, which ride the 1F1B vjp seeds with weight moe_aux_loss_weight/M.
 """
 
 import dataclasses
@@ -41,10 +42,6 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pipeline stages={n_stages}")
-    if cfg.num_experts > 1:
-        raise NotImplementedError("MoE layers inside the pipelined stack are "
-                                  "not supported yet (use pp=1 with EP)")
-
     remat_policy = T._remat_policy(cfg)
     use_remat = cfg.remat or cfg.remat_policy not in ("none", None)
 
@@ -58,16 +55,21 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
         return x
 
     def make_stage_fn(deterministic: bool):
-        has_dropout = (not deterministic) and cfg.dropout_rate > 0
+        # rng is threaded for ANY stochastic layer behavior — dropout AND
+        # MoE noisy gating (Jitter/RSample); gating on dropout alone would
+        # silently de-noise the gates at pp>1
+        has_dropout = (not deterministic) and (
+            cfg.dropout_rate > 0
+            or (cfg.num_experts > 1 and cfg.noisy_gate_policy))
 
         def layer_body(carry, xs):
-            x, mask, rng = carry
+            x, mask, rng, aux_acc = carry
             layer_p, salt = xs
             sub = jax.random.fold_in(rng, salt) if has_dropout else None
-            y, _aux = T.transformer_layer(
+            y, aux = T.transformer_layer(
                 x, layer_p, cfg, mask=mask, dropout_rng=sub,
                 deterministic=deterministic)
-            return (y, mask, rng), None
+            return (y, mask, rng, aux_acc + aux), None
 
         def stage_fn(stage_layers, x, mb_idx, mask, rng):
             n_local = jax.tree.leaves(stage_layers)[0].shape[0]
@@ -85,9 +87,10 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
                 body = jax.checkpoint(body, policy=remat_policy,
                                       prevent_cse=False)
             rng_mb = rng if has_dropout else jnp.zeros((2,), jnp.uint32)
-            (y, _, _), _ = jax.lax.scan(body, (x, mask, rng_mb),
-                                        (stage_layers, salts))
-            return y, jnp.float32(0.0)
+            (y, _, _, aux), _ = jax.lax.scan(
+                body, (x, mask, rng_mb, jnp.float32(0.0)),
+                (stage_layers, salts))
+            return y, aux
 
         return stage_fn
 
@@ -100,12 +103,13 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
         logits = (y @ head.astype(y.dtype)).astype(jnp.float32)
         return T.cross_entropy_loss(logits, labels)
 
+    aux_w = cfg.moe_aux_loss_weight if cfg.num_experts > 1 else 0.0
     pipe_train = as_loss_fn(make_pipeline_1f1b(
         embed_fn, make_stage_fn(deterministic=False), head_loss_fn, mesh,
-        num_microbatches=M, pipe_axis=pipe_axis))
+        num_microbatches=M, aux_weight=aux_w, pipe_axis=pipe_axis))
     pipe_eval = as_loss_fn(make_pipeline_1f1b(
         embed_fn, make_stage_fn(deterministic=True), head_loss_fn, mesh,
-        num_microbatches=M, pipe_axis=pipe_axis))
+        num_microbatches=M, aux_weight=aux_w, pipe_axis=pipe_axis))
 
     # ---------------- forward-only (inference/apply) ----------------
     fwd_stage = make_stage_fn(deterministic=True)
