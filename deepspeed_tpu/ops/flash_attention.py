@@ -7,9 +7,21 @@ single online-softmax kernel (the CUDA code materializes the S×S score matrix;
 on TPU we never leave VMEM).
 
 Layout: inputs [B, S, N, D] (seq-major like the models), internally
-[B, N, S, D]. fp32 accumulation, bf16-friendly. Causal masking is computed
-with block-level early-out: fully-masked K blocks are skipped, so causal
-attention does ~half the FLOPs of full.
+[B, N, S, D]. fp32 accumulation, bf16-friendly.
+
+Blocked-KV grid: the grid has a KV-block dimension (innermost), so only one
+[block_k, D] tile of K and V is VMEM-resident at a time and Pallas
+double-buffers the next tile's DMA behind the current tile's compute. The
+online-softmax state (m, l, acc) is carried across KV steps in VMEM scratch.
+Sequence length is therefore bounded by HBM, not VMEM (the previous design
+kept the whole [S, D] K/V — and in the backward a [rep, S, D] fp32 block —
+resident, capping S at ~8-16k).
+
+Causal masking skips invisible blocks two ways: `pl.when` predication skips
+the compute, and the K/V index maps clamp invisible steps to the last visible
+block so the pipeline emitter elides their DMAs (same-index fetches are
+skipped). Causal attention therefore does ~half the FLOPs and ~half the HBM
+traffic of full attention.
 
 GQA is native: when n_q_heads > n_kv_heads the grid runs over KV heads and
 each program processes the whole query-head GROUP against one K/V stream —
@@ -17,7 +29,9 @@ K/V are never repeated in HBM and their VMEM loads amortize over the group
 (the naive path repeats K/V n_q/n_kv times).
 
 Backward uses the standard flash decomposition (dQ kernel + joint dK/dV
-kernel) with the forward's log-sum-exp residuals.
+kernel) with the forward's log-sum-exp residuals; both are blocked the same
+way (dQ: KV innermost with dQ in scratch; dK/dV: Q innermost with dK/dV in
+scratch).
 """
 
 import functools
@@ -29,9 +43,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+# rep * block_q rows of fp32 state live in VMEM scratch; past ~1024 rows the
+# m/l/acc scratch plus the double-buffered Q/KV tiles exceed scoped VMEM
+# (measured: rows=2048 fails to compile on v5e at D=64).
+MAX_ROWS = 1024
 NEG_INF = -1e30
+# Floor for the running row-max: keeps exp(s - m) == 0 for fully-masked rows
+# (otherwise m == s == NEG_INF makes exp(0) == 1 and a dead row attends
+# uniformly to its masked keys). Real scores never get near -1e20.
+M_FLOOR = -1e20
 
 
 def _interpret() -> bool:
@@ -39,13 +61,31 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _pick_blocks(s: int, block_q: int, block_k: int):
-    bq = min(block_q, s)
-    bk = min(block_k, s)
+def _compiler_params(n_parallel: int):
+    """Grid semantics: all dims parallel except the innermost (carries
+    scratch state / revisits the output block)."""
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel + ("arbitrary",))
+
+
+def _pow2_floor(x: int) -> int:
+    return 1 << (max(x, 1).bit_length() - 1)
+
+
+def _pick_blocks(s: int, block_q: int, block_k: int, rep: int = 1):
+    # power-of-two blocks: halving then always terminates at a divisor of
+    # any s with a pow2 factor (e.g. s % 128 == 0 keeps bk >= 128), instead
+    # of degenerating to 1 for non-pow2 requests
+    bq = _pow2_floor(min(block_q, s))
+    bk = _pow2_floor(min(block_k, s))
     while s % bq:
         bq //= 2
     while s % bk:
         bk //= 2
+    while rep * bq > MAX_ROWS and bq > 8:
+        bq //= 2
     return max(bq, 1), max(bk, 1)
 
 
@@ -59,95 +99,138 @@ def _causal_mask(s, q_start, k_start, rows, block_k, block_q):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
+def _block_visible(qi, kj, block_q, block_k):
+    """True iff KV block kj intersects the causal triangle of Q block qi
+    (i.e. last query row >= first key col)."""
+    return (qi + 1) * block_q > kj * block_k
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *, sm_scale,
-                causal, rep, block_q, block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref,
+                m_s, l_s, acc_s, *, sm_scale, causal, rep, block_q, block_k):
     qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    num_kv = pl.num_programs(3)
     d = q_ref.shape[-1]
     rows = rep * block_q
-    q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d) * sm_scale
-    num_kv = seq_len // block_k
 
-    m0 = jnp.full((rows, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((rows, 1), jnp.float32)
-    acc0 = jnp.zeros((rows, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
 
-    q_start = qi * block_q
+    visible = _block_visible(qi, kj, block_q, block_k) if causal else True
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(visible)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d) * sm_scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, q_start, j * block_k, rows, block_k, block_q)
+            s = _causal_mask(s, qi * block_q, kj * block_k, rows, block_k,
+                             block_q)
         if m_ref is not None:
-            kv_ok = m_ref[0, 0:1, pl.ds(j * block_k, block_k)] > 0
+            kv_ok = m_ref[0, 0:1, :] > 0
             s = jnp.where(kv_ok, s, NEG_INF)   # [1,bk] broadcasts over rows
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m = m_s[:, 0:1]
+        l = l_s[:, 0:1]
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True)),
+                            M_FLOOR)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
 
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        l = l_s[:, 0:1]
+        m = m_s[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe).reshape(rep, block_q, d).astype(
+            o_ref.dtype)
+        lse_ref[0, 0] = (m + jnp.log(l_safe)).reshape(rep, block_q, 1)
+
+
+def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, *scratch, **kw)
+
+
+def _clamp_kv(i, j, causal, bq, bk):
+    """Clamp invisible KV steps to the last visible block: the pipeline
+    emitter skips DMAs whose block index equals the previous step's."""
     if causal:
-        # only K blocks with k_start <= q_end participate (block early-out)
-        num_visible = jnp.minimum((q_start + block_q + block_k - 1) // block_k, num_kv)
-    else:
-        num_visible = num_kv
-    m, l, acc = jax.lax.fori_loop(0, num_visible, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe).reshape(rep, block_q, d).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l_safe)).reshape(rep, block_q, 1)
+        last_visible = jax.lax.div((i + 1) * bq - 1, bk)
+        j = jnp.minimum(j, last_visible)
+    return j
 
 
-def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, **kw)
+def _kv_index_map(causal, bq, bk):
+    return lambda b, g, i, j: (b, g, _clamp_kv(i, j, causal, bq, bk), 0)
+
+
+# The [B, 8, S] key-padding mask is blocked like K/V (Mosaic's lane rule
+# requires bk % 128 == 0 for this spec — guaranteed by the wrapper's masked-
+# path guard: S % 128 == 0 and block_k >= 128 make _pick_blocks land on a
+# multiple of 128).
+def _mask_kv_index_map(causal, bq, bk):
+    return lambda b, g, i, j: (b, 0, _clamp_kv(i, j, causal, bq, bk))
 
 
 def _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
     B, N, S, D = q.shape
     Nkv = k.shape[1]
     rep = N // Nkv
-    bq, bk = _pick_blocks(S, block_q, block_k)
-    grid = (B, Nkv, S // bq)
+    bq, bk = _pick_blocks(S, block_q, block_k, rep)
+    grid = (B, Nkv, S // bq, S // bk)
+    rows = rep * bq
 
-    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, g, i: (b, g, 0, 0),
+    kv_spec = pl.BlockSpec((1, 1, bk, D), _kv_index_map(causal, bq, bk),
                            memory_space=pltpu.VMEM)
     kern = _fwd_kernel if kv_mask is not None else _fwd_kernel_nomask
     kernel = functools.partial(kern, sm_scale=sm_scale, causal=causal,
-                               rep=rep, block_q=bq, block_k=bk, seq_len=S)
+                               rep=rep, block_q=bq, block_k=bk)
     # q viewed as [B, Nkv, rep, S, D]: one program owns the whole head group
     qg = q.reshape(B, Nkv, rep, S, D)
-    mask_spec = pl.BlockSpec((1, 8, S), lambda b, g, i: (b, 0, 0),
+    mask_spec = pl.BlockSpec((1, 8, bk), _mask_kv_index_map(causal, bq, bk),
                              memory_space=pltpu.VMEM)
     extra = () if kv_mask is None else (kv_mask,)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
+            pl.BlockSpec((1, 1, rep, bq, D),
+                         lambda b, g, i, j: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
             kv_spec, kv_spec,
         ] + ([mask_spec] if kv_mask is not None else []),
         out_specs=[
-            pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
+            pl.BlockSpec((1, 1, rep, bq, D),
+                         lambda b, g, i, j: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
             # trailing singleton keeps the (sublane, lane) tile legal
-            pl.BlockSpec((1, 1, rep, bq, 1), lambda b, g, i: (b, g, 0, i, 0),
+            pl.BlockSpec((1, 1, rep, bq, 1),
+                         lambda b, g, i, j: (b, g, 0, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Nkv, rep, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, Nkv, rep, S, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, 128), jnp.float32),   # m (lane-padded)
+            pltpu.VMEM((rows, 128), jnp.float32),   # l
+            pltpu.VMEM((rows, D), jnp.float32),     # acc
+        ],
+        compiler_params=_compiler_params(3),
         interpret=_interpret(),
     )(qg, k, v, *extra)
     return o.reshape(B, N, S, D), lse.reshape(B, N, S, 1)
@@ -158,104 +241,118 @@ def _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
-                   dq_ref, *, sm_scale, causal, rep, block_q, block_k,
-                   seq_len):
+                   dq_ref, dq_s, *, sm_scale, causal, rep, block_q, block_k):
     qi = pl.program_id(2)
-    q_start = qi * block_q
+    kj = pl.program_id(3)
+    num_kv = pl.num_programs(3)
     d = q_ref.shape[-1]
     rows = rep * block_q
-    q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d)
-    do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
-    lse = lse_ref[0, 0].reshape(rows, 1)
-    delta = delta_ref[0, 0].reshape(rows, 1)
-    num_kv = seq_len // block_k
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    visible = _block_visible(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(visible)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+        do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+        lse = lse_ref[0, 0].reshape(rows, 1)
+        delta = delta_ref[0, 0].reshape(rows, 1)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _causal_mask(s, q_start, j * block_k, rows, block_k, block_q)
+            s = _causal_mask(s, qi * block_q, kj * block_k, rows, block_k,
+                             block_q)
         if m_ref is not None:
-            kv_ok = m_ref[0, 0:1, pl.ds(j * block_k, block_k)] > 0
+            kv_ok = m_ref[0, 0:1, :] > 0
             s = jnp.where(kv_ok, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        num_visible = jnp.minimum((q_start + block_q + block_k - 1) // block_k, num_kv)
-    else:
-        num_visible = num_kv
-    dq = jax.lax.fori_loop(0, num_visible, body,
-                           jnp.zeros((rows, d), jnp.float32))
-    dq_ref[0, 0] = dq.reshape(rep, block_q, d).astype(dq_ref.dtype)
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_s[:].reshape(rep, block_q, d).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, rep, block_q,
-                    block_k, seq_len):
-    ki = pl.program_id(2)
-    bi = pl.program_id(0)
-    k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
-    v = v_ref[0, 0].astype(jnp.float32)
-    d = k.shape[-1]
-    num_q = seq_len // block_q
-    k_start = ki * block_k
+                    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal, rep,
+                    block_q, block_k):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_q = pl.num_programs(3)
+    d = k_ref.shape[-1]
     rows = rep * block_q
+    k_start = kj * block_k
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, :, pl.ds(i * block_q, block_q), :].astype(
-            jnp.float32).reshape(rows, d)
-        do = do_ref[0, 0, :, pl.ds(i * block_q, block_q), :].astype(
-            jnp.float32).reshape(rows, d)
-        lse = lse_ref[0, 0, :, pl.ds(i * block_q, block_q), :].reshape(rows, 1)
-        delta = delta_ref[0, 0, :, pl.ds(i * block_q, block_q), :].reshape(
-            rows, 1)
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    visible = _block_visible(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(visible)
+    def _step():
+        k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+        do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+        lse = lse_ref[0, 0].reshape(rows, 1)
+        delta = delta_ref[0, 0].reshape(rows, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _causal_mask(s, i * block_q, k_start, rows, block_k, block_q)
+            s = _causal_mask(s, qi * block_q, k_start, rows, block_k, block_q)
         if m_ref is not None:
-            kv_ok = m_ref[0, 0:1, pl.ds(k_start, block_k)] > 0
+            kv_ok = m_ref[0, 0:1, :] > 0
             s = jnp.where(kv_ok, s, NEG_INF)
         p = jnp.exp(s - lse)                        # [rows, bk]
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        # q blocks at positions >= k_start participate
-        first_q = k_start // block_q
-    else:
-        first_q = 0
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dq_ref, **kw):
+                          dq_ref, *scratch, **kw):
     _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
-                   dq_ref, **kw)
+                   dq_ref, *scratch, **kw)
 
 
 def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, **kw):
+                           dk_ref, dv_ref, *scratch, **kw):
     _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
-                    dk_ref, dv_ref, **kw)
+                    dk_ref, dv_ref, *scratch, **kw)
+
+
+def _q_index_map(causal, bq, bk):
+    """dK/dV kernel (Q innermost): clamp pre-diagonal Q steps up to the first
+    visible block so their DMAs are elided."""
+    def index(b, g, j, i):
+        if causal:
+            first_visible = jax.lax.div(j * bk, bq)
+            i = jnp.maximum(i, first_visible)
+        return (b, g, 0, i, 0)
+    return index
 
 
 def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
@@ -264,7 +361,8 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
     B, N, S, D = q.shape
     Nkv = k.shape[1]
     rep = N // Nkv
-    bq, bk = _pick_blocks(S, block_q, block_k)
+    bq, bk = _pick_blocks(S, block_q, block_k, rep)
+    rows = rep * bq
 
     # delta = rowsum(dO * O) — cheap, let XLA fuse it
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -275,49 +373,59 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
     lseg = lse.reshape(B, Nkv, rep, S, 1)
     deltag = delta.reshape(B, Nkv, rep, S, 1)
 
-    kv_full = pl.BlockSpec((1, 1, S, D), lambda b, g, i: (b, g, 0, 0),
+    # ---- dQ: grid (B, Nkv, num_q, num_kv), KV innermost ----
+    kv_blk = pl.BlockSpec((1, 1, bk, D), _kv_index_map(causal, bq, bk),
+                          memory_space=pltpu.VMEM)
+    grp_blk = pl.BlockSpec((1, 1, rep, bq, D),
+                           lambda b, g, i, j: (b, g, 0, i, 0),
                            memory_space=pltpu.VMEM)
-    grp_blk = pl.BlockSpec((1, 1, rep, bq, D), lambda b, g, i: (b, g, 0, i, 0),
+    grp_vec = pl.BlockSpec((1, 1, rep, bq, 1),
+                           lambda b, g, i, j: (b, g, 0, i, 0),
                            memory_space=pltpu.VMEM)
-    grp_vec = pl.BlockSpec((1, 1, rep, bq, 1), lambda b, g, i: (b, g, 0, i, 0),
+    mask_kv = pl.BlockSpec((1, 8, bk), _mask_kv_index_map(causal, bq, bk),
                            memory_space=pltpu.VMEM)
-    grp_full = pl.BlockSpec((1, 1, rep, S, D), lambda b, g, i: (b, g, 0, 0, 0),
-                            memory_space=pltpu.VMEM)
-    grp_full_vec = pl.BlockSpec((1, 1, rep, S, 1),
-                                lambda b, g, i: (b, g, 0, 0, 0),
-                                memory_space=pltpu.VMEM)
-
-    mask_spec = pl.BlockSpec((1, 8, S), lambda b, g, i: (b, 0, 0),
-                             memory_space=pltpu.VMEM)
     extra = () if kv_mask is None else (kv_mask,)
     dq_kern = _bwd_dq_kernel if kv_mask is not None else _bwd_dq_kernel_nomask
     dq = pl.pallas_call(
         functools.partial(dq_kern, sm_scale=sm_scale, causal=causal,
-                          rep=rep, block_q=bq, block_k=bk, seq_len=S),
-        grid=(B, Nkv, S // bq),
-        in_specs=[grp_blk, kv_full, kv_full, grp_blk, grp_vec, grp_vec]
-        + ([mask_spec] if kv_mask is not None else []),
+                          rep=rep, block_q=bq, block_k=bk),
+        grid=(B, Nkv, S // bq, S // bk),
+        in_specs=[grp_blk, kv_blk, kv_blk, grp_blk, grp_vec, grp_vec]
+        + ([mask_kv] if kv_mask is not None else []),
         out_specs=grp_blk,
         out_shape=jax.ShapeDtypeStruct((B, Nkv, rep, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((rows, D), jnp.float32)],
+        compiler_params=_compiler_params(3),
         interpret=_interpret(),
     )(qg, k, v, dog, lseg, deltag, *extra)
 
-    kv_blk = pl.BlockSpec((1, 1, bk, D), lambda b, g, i: (b, g, i, 0),
+    # ---- dK/dV: grid (B, Nkv, num_kv, num_q), Q innermost ----
+    qmap = _q_index_map(causal, bq, bk)
+    grp_q = pl.BlockSpec((1, 1, rep, bq, D), qmap, memory_space=pltpu.VMEM)
+    grp_q_vec = pl.BlockSpec((1, 1, rep, bq, 1), qmap,
+                             memory_space=pltpu.VMEM)
+    kv_out = pl.BlockSpec((1, 1, bk, D), lambda b, g, j, i: (b, g, j, 0),
                           memory_space=pltpu.VMEM)
+    mask_out = pl.BlockSpec((1, 8, bk), lambda b, g, j, i: (b, 0, j),
+                            memory_space=pltpu.VMEM)
     dkv_kern = (_bwd_dkv_kernel if kv_mask is not None
                 else _bwd_dkv_kernel_nomask)
     dk, dv = pl.pallas_call(
         functools.partial(dkv_kern, sm_scale=sm_scale, causal=causal,
-                          rep=rep, block_q=bq, block_k=bk, seq_len=S),
-        grid=(B, Nkv, S // bk),
-        in_specs=[grp_full, kv_blk, kv_blk, grp_full, grp_full_vec,
-                  grp_full_vec]
-        + ([mask_spec] if kv_mask is not None else []),
-        out_specs=[kv_blk, kv_blk],
+                          rep=rep, block_q=bq, block_k=bk),
+        grid=(B, Nkv, S // bk, S // bq),
+        in_specs=[grp_q, kv_out, kv_out, grp_q, grp_q_vec, grp_q_vec]
+        + ([mask_out] if kv_mask is not None else []),
+        out_specs=[kv_out, kv_out],
         out_shape=[
             jax.ShapeDtypeStruct((B, Nkv, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, Nkv, S, D), q.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(3),
         interpret=_interpret(),
     )(qg, k, v, dog, lseg, deltag, *extra)
     return dq.reshape(B, N, S, D), dk, dv
@@ -365,6 +473,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"n_q_heads {q.shape[2]} not divisible by "
                          f"n_kv_heads {k.shape[2]}")
+    if kv_mask is not None and not _interpret():
+        # the blocked mask spec needs block_k % 128 == 0 on TPU; _pick_blocks
+        # halves from a power-of-two >= 128, so any S % 128 == 0 lands there
+        if q.shape[1] % 128:
+            raise ValueError("kv_mask on TPU requires seq_len % 128 == 0 "
+                             f"(got {q.shape[1]})")
+        block_k = max(block_k, 128)
     qt = jnp.swapaxes(q, 1, 2)  # [B, N, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)

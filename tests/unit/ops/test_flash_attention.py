@@ -155,3 +155,41 @@ def test_kv_padding_mask_parity(causal):
         (ref(q, k, v) * jnp.asarray(valid)[..., None, None]) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=5e-4, atol=5e-4)
+
+
+def test_all_masked_row_outputs_zero():
+    """A batch row whose kv_mask is entirely zero must produce zero outputs
+    (not the mean of masked V: with m == s == NEG_INF, exp(0) == 1 — the
+    M_FLOOR clamp keeps p at 0 so the l == 0 guard actually fires) and must
+    not leak gradient into its K/V."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    B, S, N, D = 2, 128, 2, 32
+    q = jax.random.normal(ks[0], (B, S, N, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, N, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, N, D), jnp.float32)
+    mask = np.ones((B, S), np.int32)
+    mask[1, :] = 0  # batch row 1 is all padding
+    out = flash_attention(q, k, v, causal=False, kv_mask=jnp.asarray(mask),
+                          block_q=32, block_k=32)
+    np.testing.assert_array_equal(np.asarray(out)[1], 0.0)
+
+    gk, gv = jax.grad(
+        lambda k, v: jnp.sum(flash_attention(
+            q, k, v, causal=False, kv_mask=jnp.asarray(mask),
+            block_q=32, block_k=32) ** 2), argnums=(0, 1))(k, v)
+    np.testing.assert_array_equal(np.asarray(gk)[1], 0.0)
+    np.testing.assert_array_equal(np.asarray(gv)[1], 0.0)
+    assert np.isfinite(np.asarray(gk)).all() and np.isfinite(np.asarray(gv)).all()
+
+
+def test_nonpow2_block_request():
+    """A non-power-of-two block_k must not degenerate to bk=1 — _pick_blocks
+    rounds to a power of two first."""
+    from deepspeed_tpu.ops.flash_attention import _pick_blocks
+    bq, bk = _pick_blocks(1024, 384, 384)
+    assert bk == 256 and bq == 256
+    q, k, v = _qkv(S=256)
+    out = flash_attention(q, k, v, causal=True, block_q=96, block_k=96)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
