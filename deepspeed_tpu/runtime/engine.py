@@ -132,7 +132,11 @@ class Engine:
         self.mesh: Mesh = mesh if mesh is not None else build_mesh(self.plan, devices)
         from deepspeed_tpu.parallel.context import set_parallel_context
         set_parallel_context(self.mesh, self.plan)
-        config.resolve_batch_size(self.plan.dp_world_size)
+        # ZeRO-Infinity layer streaming is a single-device executor: its
+        # batch triad resolves against dp=1 regardless of the test harness's
+        # virtual device count
+        config.resolve_batch_size(
+            1 if _infinity_mode(config) else self.plan.dp_world_size)
         logger.info(zero_mod.describe(config.zero_optimization, self.plan))
         logger.info(f"batch: train={config.train_batch_size} "
                     f"micro={config.train_micro_batch_size_per_gpu} "
@@ -221,11 +225,59 @@ class Engine:
         # time into HBM (models/transformer.py body device_put).
         off_p_cfg = config.zero_optimization.offload_param
         self._offload_param = off_p_cfg.enabled
-        if self._offload_param:
+        # ZeRO-Infinity layer-streamed executor: owns BOTH the param chunks
+        # and the optimizer chunks (reference: partitioned_param_swapper.py:35
+        # + stage3.py:1735 sub-group loop). Two tiers:
+        #   device=nvme          -> AIO chunk files (local-NVMe deployments)
+        #   device=cpu (+opt cpu)-> TPU-host pinned DRAM (ZeRO-Offload tier)
+        self._infinity = _infinity_mode(config)
+        self._infinity_exec = None
+        self._infinity_backend = None
+        if self._infinity:
+            self._offload_param = False
             if off_p_cfg.device == "nvme":
-                raise ValueError(
-                    "offload_param.device=nvme is not implemented; use "
-                    "device=cpu (pinned host DRAM, layer-streamed)")
+                self._infinity_backend = "nvme"
+            elif get_accelerator().platform == "cpu":
+                self._infinity_backend = "host"  # CPU tests: plain buffers
+            else:
+                self._infinity_backend = "pinned"
+            from deepspeed_tpu.models.transformer import TransformerConfig
+            if not isinstance(getattr(model, "config", None), TransformerConfig):
+                raise ValueError("offload_param requires a transformer "
+                                 "ModelSpec (layer streaming)")
+            if self._infinity_backend == "nvme":
+                if not (off_p_cfg.nvme_path or off_opt_cfg.nvme_path):
+                    raise ValueError("offload_param.device=nvme requires "
+                                     "nvme_path")
+                if off_opt_cfg.enabled and off_opt_cfg.device != "nvme":
+                    raise ValueError(
+                        "offload_param.device=nvme pairs with "
+                        "offload_optimizer.device=nvme (the executor streams "
+                        "param AND optimizer chunks per layer)")
+            if self.plan.world_size > 1:
+                if get_accelerator().platform == "cpu":
+                    # CPU test harness (8 virtual devices): the executor's
+                    # unsharded jits run on the default device
+                    logger.warning("the layer-streamed executor is single-"
+                                   "device; running on device 0")
+                else:
+                    raise ValueError("the layer-streamed executor requires a "
+                                     "single-device mesh in this version")
+            if self._pp_mode:
+                raise ValueError("layer-streamed offload with pipeline "
+                                 "parallelism is not supported")
+            if config.fp16.enabled:
+                raise ValueError("layer-streamed offload supports bf16 "
+                                 "only (no fp16 loss scaling in the layer-"
+                                 "streamed step)")
+            opt_name = (config.optimizer.name if config.optimizer
+                        else "adamw").lower()
+            if opt_name not in ("adam", "adamw"):
+                raise ValueError("layer-streamed offload supports the "
+                                 f"Adam family only (got '{opt_name}')")
+            # the executor replaces the swapper AND the jitted train step
+            self._nvme_opt = False
+        if self._offload_param:
             if not self._nvme_opt:
                 # in-graph host writeback of updated params is broken in this
                 # XLA/runtime (TPU backend Internal); the working path updates
@@ -349,10 +401,13 @@ class Engine:
 
         # --- state init (sharded at creation; reference: zero.Init equivalent)
         self.state_shardings = None
-        self.state = self._init_state()
-
-        # --- jitted step functions
-        self._compile_steps()
+        if self._infinity:
+            self.state = None  # streamed: the full tree never materializes
+            self._infinity_exec = self._build_infinity()
+        else:
+            self.state = self._init_state()
+            # --- jitted step functions
+            self._compile_steps()
 
         # --- bookkeeping (reference: engine timers/monitor wiring)
         self.global_steps = 0
@@ -548,6 +603,28 @@ class Engine:
             compute_dtype=self.compute_dtype,
             pipeline=off.pipeline_read or off.pipeline_write or True,
             host_inputs=self._offload_param)
+
+    def _build_infinity(self):
+        from deepspeed_tpu.runtime.infinity import InfinityExecutor
+        cfg = self.config
+        off_p = cfg.zero_optimization.offload_param
+        off_o = cfg.zero_optimization.offload_optimizer
+        p = dict(cfg.optimizer.params) if cfg.optimizer else {}
+        name = (cfg.optimizer.name if cfg.optimizer else "adamw").lower()
+        lr = self._schedule if self._schedule is not None else p.get("lr", 1e-3)
+        return InfinityExecutor(
+            self.model.config, rng=self._rng,
+            backend=self._infinity_backend,
+            nvme_path=off_p.nvme_path or off_o.nvme_path,
+            lr=lr, betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay",
+                               0.01 if name == "adamw" else 0.0),
+            adam_w_mode=(name == "adamw" or p.get("adam_w_mode", False)),
+            bias_correction=p.get("bias_correction", True),
+            grad_clip=cfg.gradient_clipping or 0.0,
+            param_cache_bytes=off_p.max_in_cpu,
+            gas=cfg.gradient_accumulation_steps)
 
     def _state_shardings_from(self, state_shapes):
         """Build shardings for the full train-state pytree: params use
@@ -901,6 +978,14 @@ class Engine:
             batch = dict(batch)
             batch["_pld_theta"] = np.float32(theta)  # traced input: the
             # continuously-decaying theta must not retrigger compilation
+        if self._infinity:
+            # unsharded single-device executor: no mesh batch placement
+            metrics = self._infinity_exec.train_batch(batch)
+            self.global_steps += 1
+            self.micro_steps += self.config.gradient_accumulation_steps
+            self.tput_timer.stop()
+            self._log_step(dict(metrics))
+            return metrics
         batch = self._device_batch(batch)
         if self._nvme_opt:
             with self.mesh:
@@ -1028,6 +1113,8 @@ class Engine:
 
     def eval_batch(self, batch):
         self._activate_context()
+        if self._infinity:
+            return self._infinity_exec.eval_batch(batch)
         batch = self._device_batch(batch)
         with self.mesh:
             return self._eval_step(self.state, batch)
@@ -1182,6 +1269,9 @@ class Engine:
             "skipped_steps": self.skipped_steps,
             "micro_steps": self.micro_steps,
         })
+        if self._infinity:
+            return self._save_infinity_checkpoint(save_dir, tag, client_state,
+                                                  save_latest)
         engine = None
         if self.config.checkpoint.async_save:
             if self._ckpt_engine is None:
@@ -1210,6 +1300,8 @@ class Engine:
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
         self.wait_checkpoint()
+        if self._infinity:
+            return self._load_infinity_checkpoint(load_dir, tag)
         state, client_state = ckpt_mod.load_checkpoint(
             load_dir, tag, template=self.state, shardings=self.state_shardings)
         if not load_optimizer_states:
@@ -1234,6 +1326,60 @@ class Engine:
             # doesn't — re-sync the host mirror from device state
             self._onebit_applied = int(np.asarray(jax.device_get(
                 self.state["opt"]["step"]))[0])
+        return load_dir, client_state
+
+    def _save_infinity_checkpoint(self, save_dir, tag, client_state,
+                                  save_latest):
+        """Infinity mode: chunk files are copied verbatim; the small
+        HBM-resident (non-layer) state goes into an npz with a dtype
+        manifest (the same bf16-as-uint16 scheme as save_16bit_model)."""
+        path = os.path.join(save_dir, str(tag))
+        os.makedirs(path, exist_ok=True)
+        small = self._infinity_exec.save_checkpoint(path)
+        client_state["applied_steps"] = small.pop("applied_steps")
+        flat = _flatten_dict({"nl_params": small["nl_params"],
+                              "nl_opt": small["nl_opt"]})
+        dtypes, arrays = {}, {}
+        for key, arr in flat.items():
+            arr = np.asarray(arr)
+            dtypes[key] = str(arr.dtype)
+            if "bfloat16" in str(arr.dtype):
+                arr = arr.view(np.uint16)
+            arrays[key.replace("/", "__")] = arr
+        np.savez(os.path.join(path, "infinity_small.npz"), **arrays)
+        with open(os.path.join(path, "infinity_meta.json"), "w") as f:
+            json.dump({"dtypes": dtypes, "client_state": client_state}, f)
+        if save_latest:
+            with open(os.path.join(save_dir, ckpt_mod.LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        logger.info(f"saved infinity checkpoint {path}")
+        return path
+
+    def _load_infinity_checkpoint(self, load_dir, tag):
+        import ml_dtypes
+        if tag is None:
+            with open(os.path.join(load_dir, ckpt_mod.LATEST_FILE)) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, str(tag))
+        with open(os.path.join(path, "infinity_meta.json")) as f:
+            meta = json.load(f)
+        flat = {}
+        with np.load(os.path.join(path, "infinity_small.npz")) as z:
+            for k in z.files:
+                key = k.replace("__", "/")
+                arr = z[k]
+                if "bfloat16" in meta["dtypes"][key]:
+                    arr = arr.view(ml_dtypes.bfloat16)
+                flat[key] = arr
+        tree = _unflatten_dict(flat)
+        client_state = meta["client_state"]
+        self._infinity_exec.load_checkpoint(
+            path, {"nl_params": tree["nl_params"], "nl_opt": tree["nl_opt"],
+                   "applied_steps": client_state.get("applied_steps", 0)})
+        self.global_steps = int(client_state.get("global_steps", 0))
+        self.skipped_steps = int(client_state.get("skipped_steps", 0))
+        self.micro_steps = int(client_state.get("micro_steps", 0))
+        logger.info(f"loaded infinity checkpoint {path}")
         return load_dir, client_state
 
     def save_16bit_model(self, save_dir: str, name: str = "model_fp16.ckpt"):
@@ -1287,6 +1433,27 @@ def _flatten_dict(tree, prefix=""):
             out.update(_flatten_dict(v, key))
         elif v is not None:
             out[key] = v
+    return out
+
+
+def _infinity_mode(config) -> bool:
+    """Whether the config selects the ZeRO-Infinity layer-streamed executor:
+    param-on-NVMe, or the param+optimizer host-DRAM (device=cpu) pairing."""
+    zo = config.zero_optimization
+    return (zo.offload_param.enabled
+            and (zo.offload_param.device == "nvme"
+                 or (zo.offload_param.device == "cpu"
+                     and zo.offload_optimizer.device == "cpu")))
+
+
+def _unflatten_dict(flat):
+    out = {}
+    for key, v in flat.items():
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
     return out
 
 

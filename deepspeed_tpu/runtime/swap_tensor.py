@@ -20,6 +20,7 @@ move off-chip, which is what makes "max trainable params per chip"
 (BASELINE.md metric #2) scale with NVMe capacity instead of HBM.
 """
 
+import functools
 import math
 import os
 import shutil
@@ -125,35 +126,78 @@ class NVMeOptimizerSwapper:
 
         host_inputs = self.host_inputs
 
-        def to_chunks(tree):
-            leaves = jax.tree.leaves(tree)
-            if host_inputs:
-                from jax.memory import Space
-                leaves = [jax.device_put(l, Space.Device) for l in leaves]
-            flat = jnp.concatenate(
-                [l.astype(jnp.float32).reshape(-1) for l in leaves])
-            flat = jnp.pad(flat, (0, padded - flat.shape[0]))
-            return [jax.lax.with_sharding_constraint(x, flat_sh)
-                    for x in jnp.split(flat, n_chunks)]
+        # ---- streamed chunk gather / leaf reassembly (round-2 verdict
+        # weakness: the old whole-tree flatten transiently doubled grad HBM
+        # and the one-shot unflatten held params + all chunks at once).
+        # Segment maps over the fixed leaf order:
+        #   chunk ci <- [(leaf li, leaf_offset, len)]
+        #   leaf  li <- [(chunk ci, chunk_offset, len)]  (in leaf order)
+        self._chunk_segs: List[List] = [[] for _ in range(n_chunks)]
+        self._leaf_segs: List[List] = [[] for _ in range(len(sizes))]
+        off = 0
+        for li, size in enumerate(sizes):
+            remaining, lo = size, 0
+            while remaining:
+                ci = off // c
+                take = min(remaining, (ci + 1) * c - off)
+                self._chunk_segs[ci].append((li, lo, take))
+                self._leaf_segs[li].append((ci, off - ci * c, take))
+                off += take
+                lo += take
+                remaining -= take
 
-        in_sh = (self._grad_shardings,) if self._grad_shardings is not None else None
-        self._to_chunks = jax.jit(
-            to_chunks, out_shardings=[flat_sh] * n_chunks)
+        def gather_chunk(ci, *leaves):
+            """Assemble grad chunk ci from the relevant leaf slices only
+            (HBM transient: one chunk, not the whole flattened tree)."""
+            parts = []
+            for li, lo, ln in self._chunk_segs[ci]:
+                leaf = leaves[li]
+                if host_inputs:
+                    from jax.memory import Space
+                    leaf = jax.device_put(leaf, Space.Device)
+                parts.append(jax.lax.dynamic_slice_in_dim(
+                    leaf.astype(jnp.float32).reshape(-1), lo, ln))
+            flat = (jnp.concatenate(parts) if len(parts) != 1 else parts[0])
+            if flat.shape[0] < c:
+                flat = jnp.pad(flat, (0, c - flat.shape[0]))
+            return jax.lax.with_sharding_constraint(flat, flat_sh)
+
+        # one program per chunk (static slice offsets)
+        self._gather_chunk = [
+            jax.jit(functools.partial(gather_chunk, ci),
+                    out_shardings=flat_sh)
+            for ci in range(n_chunks)]
 
         dtypes = self._dtypes
+        out_sh_tree = self._param_shardings
+        out_sh_leaves = (jax.tree.leaves(
+            out_sh_tree, is_leaf=lambda x: hasattr(x, "spec"))
+            if out_sh_tree is not None else [None] * len(sizes))
 
-        def from_chunks(chunks):
-            flat = jnp.concatenate(chunks)[:sum(sizes)]
-            out, off = [], 0
-            for size, shape, dt in zip(sizes, shapes, dtypes):
-                out.append(flat[off:off + size].reshape(shape).astype(dt))
-                off += size
-            return jax.tree.unflatten(treedef, out)
+        def assemble_leaf(li, *chunks):
+            """Rebuild param leaf li from the chunk(s) covering it; called
+            as soon as the last covering chunk is updated."""
+            parts = [jax.lax.dynamic_slice_in_dim(chunks[k], coff, ln)
+                     for k, (ci, coff, ln) in enumerate(self._leaf_segs[li])]
+            flat = jnp.concatenate(parts) if len(parts) != 1 else parts[0]
+            return flat.reshape(shapes[li]).astype(dtypes[li])
 
-        out_sh = self._param_shardings
-        self._from_chunks = jax.jit(
-            from_chunks,
-            out_shardings=out_sh if out_sh is not None else None)
+        self._assemble_leaf = [
+            jax.jit(functools.partial(assemble_leaf, li),
+                    out_shardings=out_sh_leaves[li])
+            for li in range(len(sizes))]
+        # chunk ci -> leaves whose LAST covering chunk is ci (assembled there)
+        self._leaves_ending: List[List[int]] = [[] for _ in range(n_chunks)]
+        for li in range(len(sizes)):
+            self._leaves_ending[self._leaf_segs[li][-1][0]].append(li)
+
+        def tree_sq(*ls):
+            if host_inputs:  # pinned_host grads: move before reducing
+                from jax.memory import Space
+                ls = [jax.device_put(l, Space.Device) for l in ls]
+            return sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in ls)
+
+        self._tree_sq = jax.jit(tree_sq, out_shardings=repl)
 
         def update_chunk(buf, grad, lr_t, step, clip_coef):
             """buf: (3, C) [master, m, v]; grad: (C,) f32 (pre-averaged).
@@ -184,9 +228,6 @@ class NVMeOptimizerSwapper:
             donate_argnums=(0,))
         self._buf_sharding = buf_sh
 
-        self._sq_norm = jax.jit(
-            lambda x: jnp.sum(x.astype(jnp.float32) ** 2),
-            in_shardings=(flat_sh,), out_shardings=repl)
 
     # ------------------------------------------------------------------
     # file IO
@@ -211,14 +252,14 @@ class NVMeOptimizerSwapper:
     def initialize(self, params):
         """Write the initial state: master = params (fp32 upcast), m = v = 0.
         Streams chunk by chunk — full fp32 state never materializes in HBM."""
-        with self.mesh:
-            chunks = self._to_chunks(params)
         buf = np.zeros((_PLANES, self.chunk), np.float32)
-        for i, ch in enumerate(chunks):
+        leaves = jax.tree.leaves(params)
+        for i in range(self.n_chunks):
+            with self.mesh:
+                ch = self._gather_chunk[i](*leaves)
             buf[0] = np.asarray(jax.device_get(ch))
             buf[1:] = 0.0
             self._write_file(i, buf)
-        del chunks
 
     # ------------------------------------------------------------------
     def step(self, grads, *, lr: float, step_num: int,
@@ -228,12 +269,11 @@ class NVMeOptimizerSwapper:
         nothing is written — the NVMe state is untouched and the caller
         skips the step."""
         with self.mesh:
-            gchunks = self._to_chunks(grads)
+            gleaves = jax.tree.leaves(grads)
 
-            # global norm (+ overflow detection) over all chunks
-            total = 0.0
-            for gc in gchunks:
-                total += float(np.asarray(jax.device_get(self._sq_norm(gc))))
+            # global norm (+ overflow detection) straight off the leaves
+            total = float(np.asarray(jax.device_get(
+                self._tree_sq(*gleaves))))
             if not np.isfinite(total):
                 return None, float("nan"), True
             gnorm = math.sqrt(total) / grad_scale
@@ -245,7 +285,11 @@ class NVMeOptimizerSwapper:
             stepc = jnp.float32(step_num)
             coef_t = jnp.float32(coef)
 
-            pchunks: List = [None] * self.n_chunks
+            # streamed: grad chunks are gathered per chunk, updated param
+            # chunks stay alive only until the leaves they cover are
+            # reassembled (HBM transient = params + O(leaf), not 2x state)
+            out_leaves: List = [None] * len(self._sizes)
+            alive: Dict[int, object] = {}
             read_f = None
             write_f = None
             if self.pipeline and self._pool is not None:
@@ -263,8 +307,19 @@ class NVMeOptimizerSwapper:
                     read_f = None
                 dev_buf = jax.device_put(host, self._buf_sharding)
                 new_buf, pchunk = self._update_chunk(
-                    dev_buf, gchunks[i], lr_t, stepc, coef_t)
-                pchunks[i] = pchunk
+                    dev_buf, self._gather_chunk[i](*gleaves), lr_t, stepc,
+                    coef_t)
+                alive[i] = pchunk
+                for li in self._leaves_ending[i]:
+                    cover = [ci for ci, _, _ in self._leaf_segs[li]]
+                    out_leaves[li] = self._assemble_leaf[li](
+                        *[alive[ci] for ci in cover])
+                # retire chunks no unassembled leaf still needs
+                needed = {ci for li, segs in enumerate(self._leaf_segs)
+                          if out_leaves[li] is None
+                          for ci, _, _ in segs if ci <= i}
+                for ci in [k for k in alive if k not in needed and k != i]:
+                    del alive[ci]
                 if write_f is not None:
                     write_f.result()  # bound in-flight writes to 1
                 if self.pipeline and self._pool is not None:
@@ -273,7 +328,7 @@ class NVMeOptimizerSwapper:
                     self._writeback(i, new_buf)
             if write_f is not None:
                 write_f.result()
-            new_params = self._from_chunks(pchunks)
+            new_params = jax.tree.unflatten(self._treedef, out_leaves)
         return new_params, gnorm, False
 
     def _writeback(self, i: int, dev_buf):
