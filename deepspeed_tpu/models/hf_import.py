@@ -137,6 +137,106 @@ def _llama_table(cfg):
     return L
 
 
+def _mixtral_table(cfg):
+    """Llama backbone + block-sparse MoE: per-expert w1 (gate), w2 (down),
+    w3 (up) stack onto the leading expert dim of moe_w_gate/out/in; the
+    router Linear becomes wg. Reference coverage: the MoE containers in
+    ``module_inject/containers`` + ``deepspeed/moe/layer.py`` weight layout."""
+    L = [r for r in _llama_table(cfg)
+         if "mlp" not in r[0]]  # dense MLP rows replaced by experts
+    L += [
+        (r"^(?:model\.)?layers\.(\d+)\.block_sparse_moe\.gate\.weight$",
+         ("layers", "wg"), _t),
+        (r"^(?:model\.)?layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w1\.weight$",
+         ("layers", "moe_w_gate"), _t),
+        (r"^(?:model\.)?layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w2\.weight$",
+         ("layers", "moe_w_out"), _t),
+        (r"^(?:model\.)?layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w3\.weight$",
+         ("layers", "moe_w_in"), _t),
+    ]
+    return L
+
+
+def _opt_table(cfg):
+    S = cfg.max_seq_len
+
+    def pos_slice(w):
+        # OPTLearnedPositionalEmbedding carries a +2 offset: rows 0/1 are
+        # padding artifacts; row i+2 is position i
+        return w[2:2 + S]
+
+    pre = r"^(?:model\.)?decoder\."
+    lyr = pre + r"layers\.(\d+)\."
+    L = [
+        (pre + r"embed_tokens\.weight$", ("tok_embed",), None),
+        (pre + r"embed_positions\.weight$", ("pos_embed",), pos_slice),
+        (pre + r"final_layer_norm\.weight$", ("final_norm_scale",), None),
+        (pre + r"final_layer_norm\.bias$", ("final_norm_bias",), None),
+        (r"^lm_head\.weight$", ("lm_head",), _t),
+        (lyr + r"self_attn_layer_norm\.weight$", ("layers", "ln1_scale"), None),
+        (lyr + r"self_attn_layer_norm\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"self_attn\.q_proj\.weight$", ("layers", "wq"), _t),
+        (lyr + r"self_attn\.q_proj\.bias$", ("layers", "bq"), None),
+        (lyr + r"self_attn\.k_proj\.weight$", ("layers", "wk"), _t),
+        (lyr + r"self_attn\.k_proj\.bias$", ("layers", "bk"), None),
+        (lyr + r"self_attn\.v_proj\.weight$", ("layers", "wv"), _t),
+        (lyr + r"self_attn\.v_proj\.bias$", ("layers", "bv"), None),
+        (lyr + r"self_attn\.out_proj\.weight$", ("layers", "wo"), _t),
+        (lyr + r"self_attn\.out_proj\.bias$", ("layers", "bo"), None),
+        (lyr + r"final_layer_norm\.weight$", ("layers", "ln2_scale"), None),
+        (lyr + r"final_layer_norm\.bias$", ("layers", "ln2_bias"), None),
+        (lyr + r"fc1\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"fc1\.bias$", ("layers", "b_in"), None),
+        (lyr + r"fc2\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"fc2\.bias$", ("layers", "b_out"), None),
+    ]
+    return L
+
+
+def _bloom_table(cfg):
+    """BLOOM: alibi positions, embedding layernorm, per-head-INTERLEAVED
+    fused qkv ([nh, 3, hd, H] row blocks, unlike GPT-2's [q|k|v] concat)."""
+    nh, hd = cfg.num_heads, cfg.dim_per_head
+
+    def split_qkv(w):  # [3H, H] -> three [H, H] (ours: x @ W)
+        w = w.reshape(nh, 3, hd, w.shape[-1])
+        return [np.ascontiguousarray(w[:, i].reshape(nh * hd, -1).T)
+                for i in range(3)]
+
+    def split_qkv_bias(b):
+        b = b.reshape(nh, 3, hd)
+        return [np.ascontiguousarray(b[:, i].reshape(-1)) for i in range(3)]
+
+    pre = r"^(?:transformer\.)?"
+    lyr = pre + r"h\.(\d+)\."
+    return [
+        (pre + r"word_embeddings\.weight$", ("tok_embed",), None),
+        (pre + r"word_embeddings_layernorm\.weight$",
+         ("embed_norm_scale",), None),
+        (pre + r"word_embeddings_layernorm\.bias$",
+         ("embed_norm_bias",), None),
+        (pre + r"ln_f\.weight$", ("final_norm_scale",), None),
+        (pre + r"ln_f\.bias$", ("final_norm_bias",), None),
+        (r"^lm_head\.weight$", ("lm_head",), _t),
+        (lyr + r"input_layernorm\.weight$", ("layers", "ln1_scale"), None),
+        (lyr + r"input_layernorm\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"post_attention_layernorm\.weight$",
+         ("layers", "ln2_scale"), None),
+        (lyr + r"post_attention_layernorm\.bias$",
+         ("layers", "ln2_bias"), None),
+        (lyr + r"self_attention\.query_key_value\.weight$",
+         ("layers", ("wq", "wk", "wv")), split_qkv),
+        (lyr + r"self_attention\.query_key_value\.bias$",
+         ("layers", ("bq", "bk", "bv")), split_qkv_bias),
+        (lyr + r"self_attention\.dense\.weight$", ("layers", "wo"), _t),
+        (lyr + r"self_attention\.dense\.bias$", ("layers", "bo"), None),
+        (lyr + r"mlp\.dense_h_to_4h\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"mlp\.dense_h_to_4h\.bias$", ("layers", "b_in"), None),
+        (lyr + r"mlp\.dense_4h_to_h\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"mlp\.dense_4h_to_h\.bias$", ("layers", "b_out"), None),
+    ]
+
+
 def _gpt2_table(cfg):
     H = cfg.hidden_size
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
@@ -181,16 +281,38 @@ def _gpt2_table(cfg):
 _SKIP = re.compile(r"(rotary_emb\.inv_freq|\.attn\.(bias|masked_bias)$)")
 
 
+_TABLES = {"llama": _llama_table, "gpt2": _gpt2_table,
+           "mixtral": _mixtral_table, "opt": _opt_table,
+           "bloom": _bloom_table}
+
+
 def _detect_family(keys) -> str:
+    # order matters: OPT has self_attn.q_proj too (under decoder.), and
+    # Mixtral is llama + block_sparse_moe — test the distinctive keys first
     for k in keys:
+        if "block_sparse_moe" in k:
+            return "mixtral"
+        if "decoder.embed_positions" in k or "decoder.layers." in k:
+            return "opt"
+        if ("word_embeddings" in k or "self_attention." in k
+                or "dense_h_to_4h" in k or "dense_4h_to_h" in k):
+            return "bloom"
+    for k in keys:
+        if "decoder." in k:
+            continue  # OPT-shaped: wait for a distinctive decoder key
         if ("self_attn.q_proj" in k or "embed_tokens" in k
                 or k.startswith(("model.layers.", "layers."))):
             return "llama"
+        # gpt2 needs a DISTINCTIVE marker, not just the h.* prefix (BLOOM
+        # also uses h.N. — its input_layernorm keys must stay pending until
+        # a family-distinctive key streams by)
         if (".attn.c_attn." in k or "wte." in k or "wpe." in k
-                or k.startswith(("transformer.h.", "h."))):
+                or ".ln_1." in k or ".ln_2." in k
+                or ".mlp.c_fc." in k or ".mlp.c_proj." in k
+                or ".attn.c_proj." in k):
             return "gpt2"
-    raise ValueError("unrecognized checkpoint family; expected Llama-style "
-                     "(self_attn.q_proj) or GPT-2-style (attn.c_attn) keys")
+    raise ValueError("unrecognized checkpoint family; expected Llama/Mixtral/"
+                     "OPT/GPT-2-style keys")
 
 
 # --------------------------------------------------------------------------
@@ -229,20 +351,35 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
             sh = sh[k]
         return jax.device_put(arr, sh)
 
-    def place(dest, layer_idx, arr):
+    E = cfg.num_experts
+
+    def place(dest, layer_idx, arr, expert_idx=None):
         if dest[0] == "lm_head" and cfg.tie_embeddings:
             return  # tied checkpoints carry a redundant copy of the embedding
         arr = arr.astype(dtype, copy=False)
         if dest[0] == "layers":
             name = dest[1]
             buf = out["layers"].get(name)
-            if buf is None:
-                buf = np.empty((Lcount,) + arr.shape, dtype)
-                out["layers"][name] = buf
-            buf[layer_idx] = arr
+            if expert_idx is None:
+                if buf is None:
+                    buf = np.empty((Lcount,) + arr.shape, dtype)
+                    out["layers"][name] = buf
+                buf[layer_idx] = arr
+                key = layer_idx
+                full = Lcount
+            else:  # per-expert stacked weights: [L, E, ...]
+                if expert_idx >= E:
+                    raise ValueError(f"checkpoint expert {expert_idx} >= "
+                                     f"cfg.num_experts {E}")
+                if buf is None:
+                    buf = np.empty((Lcount, E) + arr.shape, dtype)
+                    out["layers"][name] = buf
+                buf[layer_idx, expert_idx] = arr
+                key = (layer_idx, expert_idx)
+                full = Lcount * E
             seen = seen_layers.setdefault(name, set())
-            seen.add(layer_idx)
-            if len(seen) == Lcount:
+            seen.add(key)
+            if len(seen) == full:
                 out["layers"][name] = _commit(("layers", name), buf)
         else:
             # tied-lm_head special case is resolved after the loop; keep the
@@ -262,7 +399,9 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
             if not m:
                 continue
             matched = True
-            layer_idx = int(m.group(1)) if m.groups() else None
+            groups = m.groups()
+            layer_idx = int(groups[0]) if groups else None
+            expert_idx = int(groups[1]) if len(groups) > 1 else None
             if layer_idx is not None and layer_idx >= Lcount:
                 raise ValueError(
                     f"checkpoint layer {layer_idx} >= cfg.num_layers {Lcount}")
@@ -271,7 +410,7 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
                 for sub, v in zip(dest[1], val):
                     place(("layers", sub), layer_idx, v)
             else:
-                place(dest, layer_idx, val)
+                place(dest, layer_idx, val, expert_idx)
             n_loaded += 1
             break
         if not matched and not _SKIP.search(key):
@@ -300,7 +439,9 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
                 fam = fam or _detect_family([k for k, _ in pending])
             except ValueError:
                 continue
-            table = _llama_table(cfg) if fam == "llama" else _gpt2_table(cfg)
+            if fam == "llama" and cfg.num_experts > 1:
+                fam = "mixtral"  # llama backbone + experts in the config
+            table = _TABLES[fam](cfg)
             logger.info(f"hf import: detected {fam}-family checkpoint")
             for k, a in pending:
                 process(k, a)
@@ -321,10 +462,17 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
     if n_loaded == 0:
         raise ValueError("no weights matched the mapping table")
     for name, idxs in seen_layers.items():
-        if len(idxs) != Lcount:
-            missing_l = sorted(set(range(Lcount)) - idxs)
-            raise ValueError(f"hf import: layers.{name} missing layer indices "
-                             f"{missing_l} (cfg.num_layers={Lcount})")
+        per_expert = bool(idxs) and isinstance(next(iter(idxs)), tuple)
+        expected = Lcount * E if per_expert else Lcount
+        if len(idxs) != expected:
+            if per_expert:
+                missing_l = sorted(
+                    {(l, e) for l in range(Lcount) for e in range(E)} - idxs)
+            else:
+                missing_l = sorted(set(range(Lcount)) - idxs)
+            raise ValueError(f"hf import: layers.{name} missing indices "
+                             f"{missing_l[:8]} (num_layers={Lcount}, "
+                             f"num_experts={E})")
 
     # validate against a reference tree structure
     from deepspeed_tpu.models.transformer import init_params
@@ -365,6 +513,13 @@ def export_hf_state_dict(params, cfg, *, family: Optional[str] = None
     Completes the interop contract (load_hf_params round-trips through it)."""
     import jax
     params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), params)
+    if (family in ("opt", "bloom", "mixtral") or cfg.num_experts > 1
+            or cfg.activation == "relu" or cfg.position_type == "alibi"):
+        raise NotImplementedError(
+            "export_hf_state_dict covers the Llama and GPT-2 layouts; "
+            "Mixtral/OPT/BLOOM export is import-only for now (a gelu-OPT "
+            "tree is structurally gpt2-shaped — pass family='opt' to get "
+            "this error instead of a gpt2-layout dict)")
     fam = family or ("gpt2" if cfg.position_type == "learned" else "llama")
     sd: Dict[str, np.ndarray] = {}
     lp = params["layers"]
@@ -432,7 +587,7 @@ def hf_config_to_transformer(hf_cfg, **overrides):
         # param tree does not carry — importing would silently drop them.
         raise ValueError("qwen2 attention biases are not supported yet; "
                          "convert without biases explicitly if acceptable")
-    if mt in ("llama", "mistral"):
+    if mt in ("llama", "mistral", "mixtral"):
         kw = dict(
             vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
             num_layers=get("num_hidden_layers"),
@@ -445,6 +600,44 @@ def hf_config_to_transformer(hf_cfg, **overrides):
             position_type="rotary", activation="silu_glu",
             norm_type="rmsnorm",
             tie_embeddings=bool(get("tie_word_embeddings", False)))
+        if mt == "mixtral":
+            kw.update(
+                num_experts=get("num_local_experts", 8),
+                top_k=get("num_experts_per_tok", 2),
+                moe_aux_loss_weight=float(get("router_aux_loss_coef", 0.02)),
+                use_residual=False)
+    elif mt == "opt":
+        if get("word_embed_proj_dim", get("hidden_size")) != get("hidden_size"):
+            raise ValueError(
+                "OPT word_embed_proj_dim != hidden_size (the 350m-style "
+                "embedding projection) is not supported")
+        if not get("do_layer_norm_before", True):
+            raise ValueError("OPT do_layer_norm_before=False (the 350m "
+                             "post-norm variant) is not supported")
+        act = get("activation_function", "relu")
+        if act not in ("relu", "gelu"):
+            raise ValueError(f"unsupported OPT activation {act!r}")
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            intermediate_size=get("ffn_dim"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            position_type="learned", activation=act,
+            norm_type="layernorm",
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
+    elif mt == "bloom":
+        H = get("hidden_size") or get("n_embed")
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=H,
+            num_layers=get("n_layer") or get("num_hidden_layers"),
+            num_heads=get("n_head") or get("num_attention_heads"),
+            intermediate_size=4 * H,
+            max_seq_len=get("seq_length", 2048),
+            norm_eps=get("layer_norm_epsilon", 1e-5),
+            position_type="alibi", activation="gelu",
+            norm_type="layernorm", embed_norm=True,
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
     elif mt in ("gpt2", ""):
         kw = dict(
             vocab_size=get("vocab_size"), hidden_size=get("n_embd"),

@@ -39,10 +39,13 @@ class TransformerConfig:
     head_dim: Optional[int] = None              # None -> hidden // heads
     intermediate_size: Optional[int] = None     # None -> 4*hidden (gelu) / 8/3 (glu)
     max_seq_len: int = 1024
-    position_type: str = "learned"              # learned | rotary | none
+    position_type: str = "learned"              # learned | rotary | alibi | none
     activation: str = "gelu"                    # gelu | silu_glu | gelu_glu
     norm_type: str = "layernorm"                # layernorm | rmsnorm
     norm_eps: float = 1e-5
+    # layernorm right after the token embedding (BLOOM's
+    # word_embeddings_layernorm)
+    embed_norm: bool = False
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
     dropout_rate: float = 0.0
@@ -236,6 +239,10 @@ def init_params(key, cfg: TransformerConfig) -> Params:
     }
     if cfg.position_type == "learned":
         params["pos_embed"] = normal(next(k), (cfg.max_seq_len, H), scale=0.01)
+    if cfg.embed_norm:
+        params["embed_norm_scale"] = jnp.ones((H,), dt)
+        if cfg.norm_type == "layernorm":
+            params["embed_norm_bias"] = jnp.zeros((H,), dt)
     if cfg.norm_type == "layernorm":
         params["final_norm_bias"] = jnp.zeros((H,), dt)
     if not cfg.tie_embeddings:
@@ -283,6 +290,10 @@ def logical_axes(cfg: TransformerConfig) -> Params:
     }
     if cfg.position_type == "learned":
         axes["pos_embed"] = (None, "embed")
+    if cfg.embed_norm:
+        axes["embed_norm_scale"] = ("unmodeled",)
+        if cfg.norm_type == "layernorm":
+            axes["embed_norm_bias"] = ("unmodeled",)
     if cfg.norm_type == "layernorm":
         axes["final_norm_bias"] = ("unmodeled",)
     if not cfg.tie_embeddings:
@@ -309,6 +320,19 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
     return y.astype(x.dtype)
 
 
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (BLOOM convention: geometric series from the
+    closest power of two, odd-index fill for non-power-of-two head counts)."""
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+    if math.log2(n_heads).is_integer():
+        return jnp.asarray(pow2(n_heads), jnp.float32)
+    cp2 = 2 ** int(math.floor(math.log2(n_heads)))
+    extra = pow2(2 * cp2)[0::2][: n_heads - cp2]
+    return jnp.asarray(pow2(cp2) + extra, jnp.float32)
+
+
 def rotary_embed(x, positions, theta: float):
     """x: [B, S, N, D]; rotate pairs (d, d + D/2) — llama convention."""
     B, S, N, D = x.shape
@@ -325,6 +349,8 @@ def rotary_embed(x, positions, theta: float):
 def _use_pallas(cfg: TransformerConfig, seq_len: int) -> bool:
     if cfg.attention_impl == "xla":
         return False
+    if cfg.position_type == "alibi":
+        return False  # additive score bias not in the flash kernel yet
     try:
         from deepspeed_tpu.ops.flash_attention import flash_attention  # noqa: F401
     except Exception:
@@ -368,6 +394,10 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
                             causal=causal, sm_scale=1.0 / math.sqrt(D))
     scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32)
     scores = scores / math.sqrt(D)
+    if cfg.position_type == "alibi":
+        pos = jnp.arange(S)
+        rel = (pos[None, :] - pos[:, None]).astype(jnp.float32)  # k - q
+        scores = scores + alibi_slopes(Nq)[None, :, None, None] * rel[None, None]
     if causal:
         cm = jnp.tril(jnp.ones((S, S), jnp.bool_))
         scores = jnp.where(cm[None, None], scores, -1e30)
@@ -382,10 +412,12 @@ def _activation(x, gate, cfg: TransformerConfig):
         return jax.nn.silu(gate) * x
     if cfg.activation == "gelu_glu":
         return jax.nn.gelu(gate) * x
+    if cfg.activation == "relu":   # OPT family
+        return jax.nn.relu(x)
     return jax.nn.gelu(x)
 
 
-def _decode_attention(q, ck, cv, index):
+def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None):
     """Single-token GQA attention against a KV ring buffer, with NO repeat of
     the kv heads in memory (reference's decode kernels repeat in registers:
     ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``; here the
@@ -399,6 +431,10 @@ def _decode_attention(q, ck, cv, index):
     qg = q.reshape(B, Nkv, rep, D)
     scores = jnp.einsum("bgrd,btgd->bgrt", qg, ck).astype(jnp.float32)
     scores = scores / math.sqrt(D)
+    if cfg is not None and cfg.position_type == "alibi":
+        rel = (jnp.arange(T) - index).astype(jnp.float32)        # k - q
+        slopes = alibi_slopes(Nq).reshape(Nkv, rep)
+        scores = scores + slopes[None, :, :, None] * rel[None, None, None, :]
     valid = (jnp.arange(T) <= index)[None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -489,7 +525,7 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         ck, cv, index = cache
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
-        attn_out = _decode_attention(q, ck, cv, index)
+        attn_out = _decode_attention(q, ck, cv, index, cfg)
         new_kv = (ck, cv)
     else:
         if return_kv:
@@ -603,6 +639,9 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     if cfg.position_type == "learned":
         pos = positions if positions is not None else jnp.arange(S)[None]
         x = x + params["pos_embed"][pos].astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"), cfg)
 
     layers = layer_override if layer_override is not None else params["layers"]
 
@@ -797,6 +836,9 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
     x = params["tok_embed"][token].astype(cfg.dtype)
     if cfg.position_type == "learned":
         x = x + params["pos_embed"][index[None, None]].astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"), cfg)
     positions = jnp.broadcast_to(index[None, None], (B, 1))
 
     def body(x_c, xs):

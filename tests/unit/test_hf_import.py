@@ -158,3 +158,105 @@ def test_wrong_shape_raises(tiny_llama):
                                    num_layers=3)
     with pytest.raises(ValueError):
         load_hf_params(model, cfg)
+
+
+@pytest.fixture(scope="module")
+def tiny_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return transformers.MixtralForCausalLM(cfg).eval(), cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_opt():
+    cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, word_embed_proj_dim=64,
+        activation_function="relu", tie_word_embeddings=True)
+    torch.manual_seed(0)
+    return transformers.OPTForCausalLM(cfg).eval(), cfg
+
+
+def test_mixtral_import_logit_parity(tiny_mixtral):
+    """BASELINE config #4 family: MoE import with per-expert stacking and
+    router weights; top-2 renormalized gating matches HF exactly when no
+    tokens drop (drop_tokens=False)."""
+    model, hf_cfg = tiny_mixtral
+    cfg = hf_config_to_transformer(hf_cfg, dtype=jnp.float32,
+                                   attention_impl="xla", drop_tokens=False)
+    assert cfg.num_experts == 4 and cfg.top_k == 2
+    params = load_hf_params(model, cfg)
+    assert params["layers"]["moe_w_in"].shape == (2, 4, 64, 96)
+    ids = np.random.default_rng(2).integers(0, 128, size=(2, 16)).astype(np.int32)
+    ours = np.asarray(forward(params, jnp.asarray(ids), cfg))
+    theirs = _hf_logits(model, ids)
+    np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-4)
+
+
+def test_opt_import_logit_parity(tiny_opt):
+    """BASELINE config #5 family: OPT — learned positions with the +2 offset,
+    relu MLP, per-projection biases, decoder-level final layernorm."""
+    model, hf_cfg = tiny_opt
+    cfg = hf_config_to_transformer(hf_cfg, dtype=jnp.float32,
+                                   attention_impl="xla")
+    assert cfg.activation == "relu" and cfg.position_type == "learned"
+    params = load_hf_params(model, cfg)
+    ids = np.random.default_rng(3).integers(0, 128, size=(2, 16)).astype(np.int32)
+    ours = np.asarray(forward(params, jnp.asarray(ids), cfg))
+    theirs = _hf_logits(model, ids)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_opt_unsupported_variants_raise():
+    cfg350 = transformers.OPTConfig(
+        vocab_size=64, hidden_size=32, ffn_dim=64, num_hidden_layers=1,
+        num_attention_heads=2, word_embed_proj_dim=16)
+    with pytest.raises(ValueError, match="word_embed_proj_dim"):
+        hf_config_to_transformer(cfg350)
+
+
+@pytest.fixture(scope="module")
+def tiny_bloom():
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    return transformers.BloomForCausalLM(cfg).eval(), cfg
+
+
+def test_bloom_import_logit_parity(tiny_bloom):
+    """BLOOM: alibi attention, embedding layernorm, interleaved fused qkv."""
+    model, hf_cfg = tiny_bloom
+    cfg = hf_config_to_transformer(hf_cfg, dtype=jnp.float32,
+                                   attention_impl="xla", max_seq_len=64)
+    assert cfg.position_type == "alibi" and cfg.embed_norm
+    params = load_hf_params(model, cfg)
+    ids = np.random.default_rng(4).integers(0, 128, size=(2, 16)).astype(np.int32)
+    ours = np.asarray(forward(params, jnp.asarray(ids), cfg))
+    theirs = _hf_logits(model, ids)
+    np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-4)
+
+
+def test_bloom_decode_matches_forward(tiny_bloom):
+    """Alibi must also be exact in the KV-cache decode path."""
+    from deepspeed_tpu.models.transformer import (decode_step, init_cache,
+                                                  prefill)
+    model, hf_cfg = tiny_bloom
+    cfg = hf_config_to_transformer(hf_cfg, dtype=jnp.float32,
+                                   attention_impl="xla", max_seq_len=64)
+    params = load_hf_params(model, cfg)
+    ids = np.random.default_rng(5).integers(0, 128, size=(1, 8)).astype(np.int32)
+    cache = init_cache(cfg, 1, 32, dtype=jnp.float32)
+    last, cache = prefill(params, jnp.asarray(ids), cfg, cache)
+    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    dec_logits, cache = decode_step(params, tok, cfg, cache)
+    full = forward(params, jnp.concatenate(
+        [jnp.asarray(ids), tok[:, None]], axis=1), cfg)
+    np.testing.assert_allclose(np.asarray(dec_logits),
+                               np.asarray(full[:, -1]), rtol=2e-3, atol=2e-3)
