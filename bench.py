@@ -210,7 +210,10 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 print(f"bench: seq-8k bench failed: {e}", file=sys.stderr)
             gc.collect()
             try:
-                result["decode_tok_per_sec"] = _decode_bench(size)
+                sweep = _decode_bench(size)
+                result.update(sweep)
+                if "decode_bs8_ctx256_bf16" in sweep:
+                    result["decode_tok_per_sec"] = sweep["decode_bs8_ctx256_bf16"]
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: decode bench failed: {e}", file=sys.stderr)
             gc.collect()
@@ -309,29 +312,49 @@ def _capacity_bench(size: str = "3b", S: int = 1024, nsteps: int = 2) -> dict:
                               "the timed in-bench rung")}
 
 
-def _decode_bench(size: str, prompt: int = 128, new: int = 128,
-                  batch: int = 8) -> float:
-    """KV-cache decode throughput (generated tokens/sec across the batch) on
-    the same model family — O(n)/token via the jitted scan decode loop."""
+def _decode_bench(size: str) -> dict:
+    """KV-cache decode throughput sweep (generated tokens/sec across the
+    batch): batch x context x weight-dtype rungs via the jitted windowed
+    scan decode loop. Decode at short context is weight/op-latency bound
+    (int8 and batch scaling are the levers); long context adds the
+    length-aware cache-read term."""
+    import gc as _gc
     import jax
     import deepspeed_tpu
     from deepspeed_tpu.models import llama_config, make_model
 
-    cfg = llama_config(size, max_seq_len=2048)
-    model = make_model(cfg, name=f"llama-{size}")
-    eng = deepspeed_tpu.init_inference(model, config={"train_batch_size": 1})
+    cfg = llama_config(size, max_seq_len=4096)
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, size=(batch, prompt), dtype=np.int32)
-    np.asarray(jax.device_get(eng.generate(ids, max_new_tokens=new)))  # compile
-    t0 = time.perf_counter()
-    out = eng.generate(ids, max_new_tokens=new)
-    np.asarray(jax.device_get(out))
-    dt = time.perf_counter() - t0
-    return round(batch * new / dt, 1)
+    out = {}
+    # (key, batch, prompt, new, int8)
+    rungs = [("decode_bs8_ctx256_bf16", 8, 128, 128, False),
+             ("decode_bs8_ctx2048_bf16", 8, 1920, 128, False),
+             ("decode_bs32_ctx256_int8", 32, 128, 128, True)]
+    for key, B, prompt, new, int8 in rungs:
+        try:
+            model = make_model(cfg, name=f"llama-{size}")
+            eng = deepspeed_tpu.init_inference(model, config={
+                "train_batch_size": 1,
+                **({"quantize_bits": 8} if int8 else {})})
+            ids = rng.integers(0, cfg.vocab_size, size=(B, prompt),
+                               dtype=np.int32)
+            np.asarray(jax.device_get(eng.generate(ids, max_new_tokens=new)))
+            t0 = time.perf_counter()
+            o = eng.generate(ids, max_new_tokens=new)
+            np.asarray(jax.device_get(o))
+            out[key] = round(B * new / (time.perf_counter() - t0), 1)
+            del eng
+        except Exception as e:  # noqa: BLE001 — keep completed rungs
+            print(f"bench: decode rung {key} failed: {e}", file=sys.stderr)
+        _gc.collect()
+    return out
 
 
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
+    p.add_argument("--comm", action="store_true",
+                   help="collective latency/BW sweep instead of training "
+                        "(reference: benchmarks/communication/run_all.py)")
     p.add_argument("--quick", action="store_true")
     p.add_argument("--size", default=None)
     p.add_argument("--seq", type=int, default=None)
@@ -340,6 +363,11 @@ if __name__ == "__main__":
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--remat", default="dots_saveable")
     a = p.parse_args()
+    if a.comm:
+        from deepspeed_tpu.benchmarks.communication import run_comm_bench
+        for row in run_comm_bench():
+            print(json.dumps(row))
+        sys.exit(0)
     result = run_bench(quick=a.quick, model_size=a.size, seq=a.seq,
                        batch=a.batch, steps=a.steps, chunk=a.chunk,
                        remat=a.remat)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.benchmarks.communication import run_comm_bench  # noqa: F401

@@ -1,2 +1,3 @@
 from deepspeed_tpu.compression.compress import (
-    CompressionTransform, init_compression, redundancy_clean)
+    CompressionTransform, init_compression, make_distillation_loss,
+    redundancy_clean, student_params_from_teacher)

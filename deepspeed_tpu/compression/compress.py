@@ -73,15 +73,41 @@ class CompressionTransform:
         self.rules += _section_rules("sparse", config.get("sparse_pruning", {}))
         self.rules += _section_rules("row", config.get("row_pruning", {}))
         self.rules += _section_rules("head", config.get("head_pruning", {}))
-        for unsupported in ("activation_quantization", "channel_pruning",
-                            "layer_reduction"):
-            sec = config.get(unsupported, {})
-            if sec.get("shared_parameters", {}).get("enabled") or \
-                    sec.get("enabled"):
-                raise NotImplementedError(
-                    f"{unsupported} is not implemented (weight quantization "
-                    "and sparse/row/head pruning are)")
-        if not self.rules:
+        self.rules += _section_rules("channel",
+                                     config.get("channel_pruning", {}))
+
+        # activation quantization (reference: basic_layer.py QuantAct): not a
+        # param transform — the ENGINE rebuilds the transformer with
+        # activation_quant_bits once the schedule offset is reached
+        self.activation_quant: Optional[Tuple[int, int]] = None  # (bits, offset)
+        aq = config.get("activation_quantization", {})
+        if aq.get("shared_parameters", {}).get("enabled"):
+            shared = aq["shared_parameters"]
+            groups = list((aq.get("different_groups") or {}).values())
+            bits = int(groups[0].get("params", {}).get("bits", 8)) \
+                if groups else 8
+            if len(groups) > 1 or any(
+                    g.get("modules", ["*"]) not in (["*"], "*")
+                    for g in groups):
+                logger.warning(
+                    "activation_quantization applies model-wide on TPU "
+                    "(post-norm activations); per-group module scoping is "
+                    f"ignored — using bits={bits} from the first group")
+            self.activation_quant = (bits,
+                                     int(shared.get("schedule_offset", 0)))
+
+        # layer reduction (reference: compress.py student_initialization +
+        # config keep_number/teacher_layer): consumed at engine/model build
+        lr_cfg = config.get("layer_reduction", {})
+        self.layer_reduction: Optional[Dict[str, Any]] = None
+        if lr_cfg.get("enabled"):
+            self.layer_reduction = {
+                "keep_number": int(lr_cfg["keep_number"]),
+                "teacher_layer": list(lr_cfg.get("teacher_layer", [])),
+            }
+
+        if not self.rules and self.activation_quant is None \
+                and self.layer_reduction is None:
             raise ValueError("compression config has no enabled section")
 
     # ------------------------------------------------------------------
@@ -110,6 +136,9 @@ class CompressionTransform:
                     out = jnp.where(active, out * mask, out)
                 elif r.kind == "head":
                     mask = _head_mask(out, r.dense_ratio, r.num_heads)
+                    out = jnp.where(active, out * mask, out)
+                elif r.kind == "channel":
+                    mask = _channel_mask(out, r.dense_ratio)
                     out = jnp.where(active, out * mask, out)
             return out
 
@@ -152,6 +181,70 @@ def _head_mask(w, dense_ratio: float, num_heads: Optional[int]):
     mask = (norms >= thresh).astype(w.dtype)             # [..., nh]
     mask = jnp.repeat(mask[..., None], hd, axis=-1).reshape(*lead, In, 1)
     return jax.lax.stop_gradient(mask)
+
+
+def _channel_mask(w, dense_ratio: float):
+    """Keep the highest-L2 OUTPUT channels (reference: channel_pruning on
+    conv/linear output filters). Our matmul weights are [in, out] (x @ W),
+    so output channels are the LAST axis — the complement of _row_mask's
+    leading-axis (input-channel) pruning."""
+    w2 = w.reshape(-1, w.shape[-1]).astype(jnp.float32)
+    norms = jnp.linalg.norm(w2, axis=0)                  # [out]
+    thresh = jnp.quantile(norms, 1.0 - dense_ratio)
+    mask = (norms >= thresh).astype(w.dtype)             # [out]
+    return jax.lax.stop_gradient(mask)                   # broadcasts on last
+
+
+def student_params_from_teacher(teacher_params, keep_layers: List[int]):
+    """Layer reduction (reference: compress.py student_initialization +
+    utils recursive getattr copy): slice the teacher's stacked layer dim to
+    `keep_layers`; non-layer params copy through. Works on any tree with a
+    "layers" subtree whose leaves stack layers on axis 0."""
+    idx = jnp.asarray(keep_layers, jnp.int32)
+    out = dict(teacher_params)
+    out["layers"] = jax.tree.map(lambda a: jnp.take(a, idx, axis=0),
+                                 teacher_params["layers"])
+    return out
+
+
+def make_distillation_loss(student_cfg, teacher_params, teacher_cfg=None,
+                           alpha: float = 0.5, temperature: float = 2.0
+                           ) -> Callable:
+    """Knowledge-distillation loss for layer-reduced students (reference:
+    the kd_loss wiring DeepSpeed-Compression pairs with layer_reduction).
+
+    loss = alpha * CE(student, labels)
+         + (1 - alpha) * T^2 * KL(teacher_T || student_T)
+    Teacher runs frozen (stop_gradient) inside the same jitted step.
+    """
+    from deepspeed_tpu.models.transformer import forward, lm_loss
+
+    tcfg = teacher_cfg or student_cfg
+
+    def loss_fn(params, batch, rng=None, deterministic=True):
+        from deepspeed_tpu.models.transformer import cross_entropy_loss
+        ids = batch["input_ids"]
+        # ONE student forward serves both terms (a second forward would
+        # double student FLOPs and re-materialize the [B,S,V] logits that
+        # loss_chunk exists to avoid — here the KL term needs them anyway)
+        s_logits = forward(params, ids, student_cfg, dropout_rng=rng,
+                           deterministic=deterministic)
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)],
+                axis=1)
+        ce = cross_entropy_loss(s_logits, labels)
+        t_logits = jax.lax.stop_gradient(
+            forward(teacher_params, ids, tcfg, deterministic=True))
+        T = temperature
+        t_prob = jax.nn.softmax(t_logits.astype(jnp.float32) / T, axis=-1)
+        s_logp = jax.nn.log_softmax(s_logits.astype(jnp.float32) / T, axis=-1)
+        kl = jnp.mean(jnp.sum(t_prob * (jnp.log(t_prob + 1e-9) - s_logp),
+                              axis=-1))
+        return alpha * ce + (1.0 - alpha) * (T * T) * kl
+
+    return loss_fn
 
 
 def init_compression(config: Dict[str, Any]) -> CompressionTransform:

@@ -120,7 +120,7 @@ class InferenceEngine:
         self._rules = rules
         self._prefill_cache = {}   # (B, pad_prompt, max_len); prompt_len
         # is a traced argument, NOT part of the compile key
-        self._decode_loop_cache = {}  # (B, max_len, n_steps, temperature)
+        self._decode_loop_cache = {}  # (B, pad_prompt, max_len, n_steps, temp)
         self._init_cache_cache = {}   # (B, max_len)
 
     def _batch_spec(self, batch_size: int) -> P:
@@ -159,10 +159,11 @@ class InferenceEngine:
                            temperature):
         """Two jitted programs, memoized per shape bucket (the reference gets
         the same effect from CUDA-graph capture; here it is jit caching by
-        construction). The expensive decode scan is keyed only on
-        (B, max_len, n_steps, temperature); prefill on (B, pad_prompt,
+        construction). The decode scan is keyed on (B, pad_prompt, max_len,
+        n_steps, temperature) — pad_prompt is part of the key because the
+        windowed read lengths are derived from it; prefill on (B, pad_prompt,
         max_len) with the true prompt length as a traced argument — a new
-        prompt length inside the same bucket compiles nothing."""
+        prompt length inside the same buckets compiles nothing."""
         pkey = (B, pad_prompt, max_len)
         prefill_raw = self._prefill_cache.get(pkey)
         if prefill_raw is None:
@@ -177,11 +178,12 @@ class InferenceEngine:
             self._prefill_cache[pkey] = prefill_raw
         prefill_fn = lambda p, ids, cache: prefill_raw(  # noqa: E731
             p, ids, cache, jnp.int32(prompt_len))
-        dkey = (B, max_len, n_steps, temperature)
+        dkey = (B, pad_prompt, max_len, n_steps, temperature)
         decode_fn = self._decode_loop_cache.get(dkey)
         if decode_fn is None:
             from deepspeed_tpu.inference.generation import make_decode_loop
-            loop = make_decode_loop(self.model, n_steps, temperature)
+            loop = make_decode_loop(self.model, n_steps, temperature,
+                                    start_len=pad_prompt, max_len=max_len)
             decode_fn = jax.jit(loop, donate_argnums=(2,))
             self._decode_loop_cache[dkey] = decode_fn
         return prefill_fn, decode_fn

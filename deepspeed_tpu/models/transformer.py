@@ -82,6 +82,11 @@ class TransformerConfig:
     # last layers always run dense, matching the reference's reserved layers.
     random_ltd: bool = False
     random_ltd_keep: int = 0
+    # QAT activation quantization (reference: compression/basic_layer.py
+    # QuantAct): fake-quant (STE) the post-norm activations feeding the
+    # attention and MLP matmuls. Set by the engine's compression wiring
+    # when activation_quantization's schedule_offset is reached. 0 = off.
+    activation_quant_bits: int = 0
     # chunked cross-entropy: compute head matmul + CE per sequence chunk so
     # the fp32 [B,S,V] logits never materialize (12*B*S*V bytes -> 12*B*c*V).
     # The chunk body is rematerialized in backward. 0 = off.
@@ -420,16 +425,29 @@ def _activation(x, gate, cfg: TransformerConfig):
 def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None):
     """Single-token GQA attention against a KV ring buffer, with NO repeat of
     the kv heads in memory (reference's decode kernels repeat in registers:
-    ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``; here the
-    grouped einsum keeps HBM traffic at the true kv size).
+    ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``).
 
-    q: [B, 1, Nq, D]; ck/cv: [B, T, Nkv, D]; index: current position (scalar).
+    q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]; index: current position (scalar).
+
+    On TPU this dispatches to the length-aware Pallas kernel
+    (ops/decode_attention.py) — HBM traffic per step is the VALID cache
+    prefix, not max_len. The XLA fallback (CPU, alibi) masks after reading.
     """
     B, _, Nq, D = q.shape
-    T, Nkv = ck.shape[1], ck.shape[2]
+    Nkv, T = ck.shape[1], ck.shape[2]
     rep = Nq // Nkv
+    # the Pallas decode kernel is opt-in (attention_impl="pallas"): measured
+    # end-to-end on v5e it loses to the windowed-XLA path (24 pallas_calls
+    # per token cost more than the length-aware reads save; the XLA path
+    # gets its length-awareness from the decode loop's static read windows)
+    use_pallas = (cfg is not None and cfg.attention_impl == "pallas"
+                  and cfg.position_type != "alibi"
+                  and jax.default_backend() in ("tpu", "axon") and D >= 64)
+    if use_pallas:
+        from deepspeed_tpu.ops.decode_attention import decode_attention
+        return decode_attention(q, ck, cv, index)
     qg = q.reshape(B, Nkv, rep, D)
-    scores = jnp.einsum("bgrd,btgd->bgrt", qg, ck).astype(jnp.float32)
+    scores = jnp.einsum("bgrd,bgtd->bgrt", qg, ck).astype(jnp.float32)
     scores = scores / math.sqrt(D)
     if cfg is not None and cfg.position_type == "alibi":
         rel = (jnp.arange(T) - index).astype(jnp.float32)        # k - q
@@ -438,7 +456,7 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None):
     valid = (jnp.arange(T) <= index)[None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bgrt,btgd->bgrd", probs, cv)
+    out = jnp.einsum("bgrt,bgtd->bgrd", probs, cv)
     return out.reshape(B, 1, Nq, D)
 
 
@@ -507,6 +525,9 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
 
     h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg)
+    if cfg.activation_quant_bits:
+        from deepspeed_tpu.ops.quantizer import fake_quant
+        h = fake_quant(h, bits=cfg.activation_quant_bits)
     q = h @ p["wq"].astype(h.dtype)
     k = h @ p["wk"].astype(h.dtype)
     v = h @ p["wv"].astype(h.dtype)
@@ -522,10 +543,20 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         k = rotary_embed(k, positions, cfg.rope_theta)
     new_kv = None
     if cache is not None:
-        ck, cv, index = cache
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
-        attn_out = _decode_attention(q, ck, cv, index, cfg)
+        ck, cv, index = cache[:3]           # [B, nkv, T, hd]
+        read_len = cache[3] if len(cache) > 3 else None
+        k_row = jnp.swapaxes(k, 1, 2).astype(ck.dtype)   # [B, nkv, 1, hd]
+        v_row = jnp.swapaxes(v, 1, 2).astype(cv.dtype)
+        ck = lax.dynamic_update_slice(ck, k_row, (0, 0, index, 0))
+        cv = lax.dynamic_update_slice(cv, v_row, (0, 0, index, 0))
+        # windowed decode: attention reads a STATIC prefix of the ring
+        # buffer (the decode loop guarantees index < read_len), so XLA only
+        # touches O(read_len) bytes instead of max_len
+        if read_len is not None and read_len < ck.shape[2]:
+            attn_out = _decode_attention(q, ck[:, :, :read_len],
+                                         cv[:, :, :read_len], index, cfg)
+        else:
+            attn_out = _decode_attention(q, ck, cv, index, cfg)
         new_kv = (ck, cv)
     else:
         if return_kv:
@@ -537,6 +568,9 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     x = x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
 
     h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
+    if cfg.activation_quant_bits:
+        from deepspeed_tpu.ops.quantizer import fake_quant
+        h = fake_quant(h, bits=cfg.activation_quant_bits)
     aux = jnp.float32(0.0)
     if "wg" in p:  # MoE layer (reference: deepspeed/moe/layer.py MoE)
         from deepspeed_tpu.moe.sharded_moe import moe_ffn
@@ -771,23 +805,25 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100):
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
                dtype=None) -> Params:
-    """Preallocated KV buffers [L, B, max_len, n_kv, head_dim] + cursor.
+    """Preallocated KV buffers [L, B, n_kv, max_len, head_dim] + cursor.
 
     Fixed shapes so prefill/decode each compile exactly once; the kv-head dim
     carries the "heads" logical axis so TP shards the cache like the weights.
+    Sequence-major last two dims ([T, hd]) give the decode kernel legal
+    (sublane, lane) tiles without a transpose.
     """
     dtype = dtype or cfg.dtype
     L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
     return {
-        "k": jnp.zeros((L, batch_size, max_len, nkv, hd), dtype),
-        "v": jnp.zeros((L, batch_size, max_len, nkv, hd), dtype),
+        "k": jnp.zeros((L, batch_size, nkv, max_len, hd), dtype),
+        "v": jnp.zeros((L, batch_size, nkv, max_len, hd), dtype),
         "index": jnp.zeros((), jnp.int32),
     }
 
 
 def cache_logical_axes() -> Params:
-    return {"k": ("layers", "batch", None, "heads", None),
-            "v": ("layers", "batch", None, "heads", None),
+    return {"k": ("layers", "batch", "heads", None, None),
+            "v": ("layers", "batch", "heads", None, None),
             "index": None}
 
 
@@ -809,12 +845,14 @@ def prefill(params: Params, input_ids, cfg: TransformerConfig, cache: Params,
     # traced length is fine: the index ops below are dynamic, so one program
     # serves every prompt length in the same padded-shape bucket
     true_len = jnp.asarray(S if length is None else length, jnp.int32)
-    k, v = kv  # [L, B, S, nkv, hd]
+    k, v = kv  # [L, B, S, nkv, hd] -> cache layout [L, B, nkv, S, hd]
     new_cache = {
         "k": lax.dynamic_update_slice(
-            cache["k"], k.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
+            cache["k"], jnp.swapaxes(k, 2, 3).astype(cache["k"].dtype),
+            (0, 0, 0, 0, 0)),
         "v": lax.dynamic_update_slice(
-            cache["v"], v.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
+            cache["v"], jnp.swapaxes(v, 2, 3).astype(cache["v"].dtype),
+            (0, 0, 0, 0, 0)),
         "index": true_len,
     }
     last = lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
@@ -823,11 +861,14 @@ def prefill(params: Params, input_ids, cfg: TransformerConfig, cache: Params,
 
 
 def decode_step(params: Params, token, cfg: TransformerConfig,
-                cache: Params) -> Tuple[jnp.ndarray, Params]:
+                cache: Params, read_len: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, Params]:
     """One incremental decode step. token: [B] or [B,1] int32 -> logits [B, V].
 
     O(cache_len) per token (vs O(n^2) full recompute); the layer scan carries
     each layer's cache slice through `xs` and re-stacks the updated buffers.
+    read_len: static upper bound on the valid prefix (index < read_len) —
+    attention reads only that window of the ring buffer.
     """
     if token.ndim == 1:
         token = token[:, None]
@@ -847,7 +888,7 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
             layer_p = _fetch_layer(layer_p, cfg)
         y, _, (nck, ncv) = transformer_layer(
             x_c, layer_p, cfg, positions=positions, deterministic=True,
-            cache=(ck, cv, index), return_kv=False)
+            cache=(ck, cv, index, read_len), return_kv=False)
         return y, (nck, ncv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"],
@@ -969,7 +1010,7 @@ def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
             init_cache(cfg, batch_size, max_len, dtype=dtype),
         prefill=lambda params, input_ids, cache, **kw:
             prefill(params, input_ids, cfg, cache, **kw),
-        decode_step=lambda params, token, cache:
-            decode_step(params, token, cfg, cache),
+        decode_step=lambda params, token, cache, **kw:
+            decode_step(params, token, cfg, cache, **kw),
         cache_axes=cache_logical_axes,
     )

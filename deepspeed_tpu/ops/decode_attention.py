@@ -1,0 +1,140 @@
+"""Pallas decode attention: single-token attention against the KV cache,
+reading ONLY the valid prefix.
+
+Capability-equivalent of the reference's fused softmax_context decode kernels
+(``csrc/transformer/inference/csrc/softmax.cu``, bound at
+``pt_binding.cpp:1716-1780``): those fuse the softmax over the accumulated
+context; here the whole (QK^T -> online softmax -> PV) runs in one kernel.
+
+Why a kernel at all: decode is HBM-bandwidth-bound on the KV cache, and the
+XLA fallback masks AFTER reading — every step touches all ``max_len`` rows.
+This kernel makes the cache read length-aware: the current position arrives
+as a scalar-prefetch argument, the KV block index map clamps invalid steps
+to the last valid block (the pipeline emitter elides same-index DMAs), and
+``pl.when`` skips their compute — so a step at position t reads O(t) bytes,
+not O(max_len).
+
+GQA-native like the training kernel: grid over KV heads, each program holds
+the whole [rep, D] query group; K/V are read once per group.
+
+Layout: q [B, 1, Nq, D]; cache k/v [B, Nkv, T, D]; index = position the new
+token was just written at (valid rows are <= index).
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_K = 1024
+NEG_INF = -1e30
+M_FLOOR = -1e20
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+            sm_scale, rep, block_k):
+    """Grid (B, num_kv_blocks); one program holds ALL kv heads for one
+    batch row (a batched dot over the head dim keeps per-step work large
+    enough to amortize grid overhead)."""
+    j = pl.program_id(1)
+    nt = pl.num_programs(1)
+    idx = idx_ref[0]
+    nkv, d = q_ref.shape[1], q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    @pl.when(j * block_k <= idx)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale     # [nkv, rep, d]
+        k = k_ref[0].astype(jnp.float32)                # [nkv, bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        # batched over kv heads: [nkv, rep, bk]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32)
+        t_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (nkv, rep, block_k), 2)
+        s = jnp.where(t_pos <= idx, s, NEG_INF)
+        m = m_s[:, 0:rep, 0:1]
+        l = l_s[:, 0:rep, 0:1]
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, -1, keepdims=True)),
+                            M_FLOOR)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(p, v, (((2,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        acc_s[:, 0:rep] = acc_s[:, 0:rep] * alpha + pv
+        m_s[:, 0:rep] = jnp.broadcast_to(m_new, (nkv, rep, m_s.shape[2]))
+        l_s[:, 0:rep] = jnp.broadcast_to(l_new, (nkv, rep, l_s.shape[2]))
+
+    @pl.when(j == nt - 1)
+    def _finalize():
+        l = l_s[:, 0:rep, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_s[:, 0:rep] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q, ck, cv, index, *, sm_scale: Optional[float] = None,
+                     block_k: int = DEFAULT_BLOCK_K):
+    """q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]; index: scalar position of the
+    newest row. Returns [B, 1, Nq, D]. Reads only cache blocks covering
+    positions <= index."""
+    B, _, Nq, D = q.shape
+    Nkv, T = ck.shape[1], ck.shape[2]
+    rep = Nq // Nkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    bk = min(block_k, T)
+    while T % bk:
+        bk //= 2
+    nt = T // bk
+    qg = q.reshape(B, Nkv, rep, D)
+    idx = jnp.asarray(index, jnp.int32).reshape(1)
+
+    def kv_index(b, j, idx_ref):
+        # index maps receive (*grid_indices, *scalar_prefetch_refs); clamp
+        # invalid steps to the last valid block so their DMAs are elided
+        last_valid = jax.lax.div(idx_ref[0], bk)
+        return (b, 0, jnp.minimum(j, last_valid), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, nt),
+        in_specs=[
+            pl.BlockSpec((1, Nkv, rep, D), lambda b, j, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Nkv, bk, D), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Nkv, bk, D), kv_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, Nkv, rep, D),
+                               lambda b, j, i: (b, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((Nkv, max(rep, 8), 128), jnp.float32),   # m
+            pltpu.VMEM((Nkv, max(rep, 8), 128), jnp.float32),   # l
+            pltpu.VMEM((Nkv, max(rep, 8), D), jnp.float32),     # acc
+        ],
+    )
+    compiler_params = None if _interpret() else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"))
+    o = pl.pallas_call(
+        functools.partial(_kernel, sm_scale=float(sm_scale), rep=rep,
+                          block_k=bk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, rep, D), q.dtype),
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(idx, qg, ck, cv)
+    return o.reshape(B, 1, Nq, D)

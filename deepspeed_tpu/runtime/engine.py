@@ -398,10 +398,48 @@ class Engine:
             if self._onebit_comm:
                 raise ValueError("compression_training with the 1-bit "
                                  "compressed-comm path is not supported")
+            # activation quantization / layer reduction reshape the MODEL,
+            # not the params (reference: QuantAct wraps forward;
+            # student_initialization builds a shallower net)
+            self._act_quant = self._compression.activation_quant
+            self._act_quant_on = False
+            lr = self._compression.layer_reduction
+            if self._act_quant or lr:
+                from deepspeed_tpu.models.transformer import TransformerConfig
+                if not isinstance(getattr(model, "config", None),
+                                  TransformerConfig):
+                    raise ValueError("activation_quantization/layer_reduction "
+                                     "require a transformer ModelSpec")
+            if lr is not None:
+                import dataclasses as _dc
+                from deepspeed_tpu.models import make_model as _mk
+                keep = lr["keep_number"]
+                model = _mk(_dc.replace(model.config, num_layers=keep),
+                            name=f"{model.name}-student{keep}")
+                self.model = model
+                logger.info(f"layer reduction: student keeps {keep} layers")
+                if lr["teacher_layer"]:
+                    # the engine has no teacher weights to copy from —
+                    # teacher init is an explicit user step, as in the
+                    # reference's student_initialization utility
+                    logger.warning(
+                        "layer_reduction.teacher_layer is informational "
+                        "here: initialize the student from a trained "
+                        "teacher with compression.student_params_from_"
+                        "teacher(...) and assign engine.state['params']")
+            if self._act_quant and self._act_quant[1] <= 0:
+                # no schedule offset: bake quantized activations in now
+                model = self._rebuild_act_quant(model)
+        else:
+            self._act_quant = None
+            self._act_quant_on = False
 
         # --- state init (sharded at creation; reference: zero.Init equivalent)
         self.state_shardings = None
         if self._infinity:
+            if self._compression is not None:
+                raise ValueError("compression_training with the layer-"
+                                 "streamed offload executor is not supported")
             self.state = None  # streamed: the full tree never materializes
             self._infinity_exec = self._build_infinity()
         else:
@@ -964,6 +1002,10 @@ class Engine:
         self._activate_context()
         self.tput_timer.start()
         self._rng, sub = jax.random.split(self._rng)
+        if self._act_quant and not self._act_quant_on and \
+                self.global_steps + 1 >= self._act_quant[1]:
+            self._rebuild_act_quant(self.model)
+            self._compile_steps()
         if self._curriculum is not None:
             from deepspeed_tpu.runtime.data_pipeline import (
                 apply_seqlen_curriculum)
@@ -1021,6 +1063,20 @@ class Engine:
             finally:
                 self._profiling = False
         return metrics
+
+    def _rebuild_act_quant(self, model):
+        """Swap in the activation-quantized model config (one recompile —
+        the traced alternative would carry a dead branch every step)."""
+        import dataclasses as _dc
+        from deepspeed_tpu.models import make_model as _mk
+        bits = self._act_quant[0]
+        model = _mk(_dc.replace(model.config, activation_quant_bits=bits),
+                    name=model.name)
+        self.model = model
+        self._act_quant_on = True
+        logger.info(f"activation quantization active: {bits}-bit STE on "
+                    "post-norm activations")
+        return model
 
     def _maybe_rebuild_ltd(self, batch):
         """Random-LTD: the kept-token count is a SHAPE, so when the schedule

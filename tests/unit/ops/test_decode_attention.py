@@ -1,0 +1,69 @@
+"""Length-aware Pallas decode attention parity (reference test model:
+tests/unit/ops kernel-vs-torch parity, SURVEY §4)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.decode_attention import decode_attention
+
+
+def _ref(q, ck, cv, index):
+    B, _, Nq, D = q.shape
+    Nkv, T = ck.shape[1], ck.shape[2]
+    rep = Nq // Nkv
+    qg = q.reshape(B, Nkv, rep, D)
+    s = jnp.einsum("bgrd,bgtd->bgrt", qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / math.sqrt(D)
+    s = jnp.where((jnp.arange(T) <= index)[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bgrt,bgtd->bgrd", p, cv.astype(jnp.float32))
+    return out.reshape(B, 1, Nq, D).astype(q.dtype)
+
+
+@pytest.mark.parametrize("index", [0, 5, 63, 130, 255])
+@pytest.mark.parametrize("rep", [1, 4])
+def test_decode_parity(index, rep):
+    B, Nkv, T, D = 2, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(index + rep), 3)
+    q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
+    out = decode_attention(q, ck, cv, index, block_k=64)
+    ref = _ref(q, ck, cv, index)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_bf16():
+    B, Nkv, rep, T, D = 1, 4, 2, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.bfloat16)
+    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.bfloat16)
+    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.bfloat16)
+    out = decode_attention(q, ck, cv, 100, block_k=128)
+    ref = _ref(q.astype(jnp.float32), ck.astype(jnp.float32),
+               cv.astype(jnp.float32), 100)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_garbage_beyond_index_ignored():
+    """Rows past the cursor must not leak into the output even when they
+    hold huge values (the uninitialized-ring-buffer case)."""
+    B, Nkv, T, D = 1, 2, 128, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, 1, 2, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
+    ck = ck.at[:, :, 40:].set(1e4)
+    cv = cv.at[:, :, 40:].set(1e4)
+    out = decode_attention(q, ck, cv, 39, block_k=32)
+    ref = _ref(q, ck, cv, 39)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    assert float(jnp.max(jnp.abs(out))) < 100.0

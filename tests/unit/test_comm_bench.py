@@ -1,0 +1,54 @@
+"""Comms microbenchmark harness (reference: benchmarks/communication/
+run_all.py + utils.py get_bw conventions)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from deepspeed_tpu.benchmarks.communication import (OPS, _bus_factor,
+                                                    run_comm_bench)
+
+
+@pytest.fixture
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def test_all_ops_run(mesh8):
+    rows = run_comm_bench(mesh8, sizes=[1 << 12], iters=3)
+    by_op = {r["op"]: r for r in rows}
+    assert set(by_op) == set(OPS)
+    for op, r in by_op.items():
+        assert "error" not in r, (op, r)
+        assert r["world"] == 8
+        assert r["latency_us"] > 0
+        assert r["alg_bw_gbps"] > 0
+        # both fields are independently rounded to 4 decimals
+        assert r["bus_bw_gbps"] == pytest.approx(
+            r["alg_bw_gbps"] * _bus_factor(op, 8), rel=5e-2)
+
+
+def test_bus_factor_convention():
+    # reference get_bw: allreduce 2(n-1)/n, allgather/reducescatter (n-1)/n
+    assert _bus_factor("psum", 4) == pytest.approx(1.5)
+    assert _bus_factor("all_gather", 4) == pytest.approx(0.75)
+    assert _bus_factor("ppermute", 4) == 1.0
+    assert _bus_factor("psum", 1) == 1.0
+
+
+def test_size_sweep_rows(mesh8):
+    rows = run_comm_bench(mesh8, sizes=[1 << 12, 1 << 14], ops=("psum",),
+                          iters=2)
+    assert len(rows) == 2
+    assert rows[0]["elements"] < rows[1]["elements"]
+
+
+def test_single_device_mesh_runs():
+    """On the real chip the mesh may be a single device — the harness must
+    still produce rows (latency of the degenerate collective)."""
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("data",))
+    rows = run_comm_bench(mesh1, sizes=[1 << 10], ops=("psum", "all_gather"),
+                          iters=2)
+    assert all("error" not in r for r in rows), rows
