@@ -51,15 +51,19 @@ class NVMeOptimizerSwapper:
     bf16 params, loss scale, and the step counter.
     """
 
-    def __init__(self, param_template, *, mesh, nvme_path: str,
+    def __init__(self, param_template, *, mesh, nvme_path: str = None,
                  lr=1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
                  bias_correction: bool = True,
                  chunk_elems: int = 1 << 24, aio_handle=None,
                  param_shardings=None, grad_shardings=None,
                  compute_dtype=jnp.bfloat16, pipeline: bool = True,
-                 host_inputs: bool = False):
+                 host_inputs: bool = False, storage: str = "nvme"):
+        """storage: "nvme" (AIO chunk files), "pinned" (TPU-host pinned
+        DRAM buffers — the ZeRO-Offload device=cpu tier, same chunked
+        double-buffered step), or "host" (numpy buffers; CPU tests)."""
         self.mesh = mesh
+        self.storage = storage
         self.b1, self.b2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
@@ -87,29 +91,39 @@ class NVMeOptimizerSwapper:
         self.n_chunks = max(1, math.ceil(self.num_params / c))
         self._padded = self.n_chunks * c
 
-        self._dir = os.path.join(nvme_path, f"dstpu-optswap-{os.getpid()}")
-        os.makedirs(self._dir, exist_ok=True)
-        # Two handles: reads (prefetch thread) and writes (writeback thread)
-        # overlap, and a handle serializes its operations (one ring each).
-        self._aio = aio_handle
-        self._aio_w = aio_handle
-        if aio_handle is None:
-            from deepspeed_tpu.ops.aio import AIOHandle, aio_available
-            if aio_available():
-                self._aio = AIOHandle()
-                self._aio_w = AIOHandle()
-            else:  # pragma: no cover - exercised only without a toolchain
-                logger.warning("native aio unavailable; swapper falls back "
-                               "to numpy file IO")
+        self._dir = None
+        self._aio = self._aio_w = None
+        self._buffers = {}  # pinned/host storage: chunk idx -> array
+        if storage == "nvme":
+            if not nvme_path:
+                raise ValueError("storage='nvme' requires nvme_path")
+            self._dir = os.path.join(nvme_path,
+                                     f"dstpu-optswap-{os.getpid()}")
+            os.makedirs(self._dir, exist_ok=True)
+            # Two handles: reads (prefetch thread) and writes (writeback
+            # thread) overlap; a handle serializes its ops (one ring each).
+            self._aio = aio_handle
+            self._aio_w = aio_handle
+            if aio_handle is None:
+                from deepspeed_tpu.ops.aio import AIOHandle, aio_available
+                if aio_available():
+                    self._aio = AIOHandle()
+                    self._aio_w = AIOHandle()
+                else:  # pragma: no cover - only without a toolchain
+                    logger.warning("native aio unavailable; swapper falls "
+                                   "back to numpy file IO")
         self._pool = ThreadPoolExecutor(max_workers=2) if pipeline else None
-        # two host staging buffers per direction for double buffering
-        self._read_bufs = [np.empty((_PLANES, c), np.float32) for _ in range(2)]
+        # two host staging buffers for double-buffered file reads — only the
+        # nvme tier stages through numpy (pinned/host return stored arrays)
+        self._read_bufs = ([np.empty((_PLANES, c), np.float32)
+                            for _ in range(2)]
+                           if storage == "nvme" else [None, None])
 
         self._build_jits()
+        where = self._dir if storage == "nvme" else f"{storage} buffers"
         logger.info(
-            f"nvme optimizer swap: {self.num_params/1e6:.1f}M params -> "
-            f"{self.n_chunks} chunks x {c} elems at {self._dir} "
-            f"({'io_uring' if getattr(aio_handle, 'uses_io_uring', False) else 'thread-pool'} aio)")
+            f"optimizer swap ({storage}): {self.num_params/1e6:.1f}M params "
+            f"-> {self.n_chunks} chunks x {c} elems at {where}")
 
     # ------------------------------------------------------------------
     def _build_jits(self):
@@ -227,6 +241,12 @@ class NVMeOptimizerSwapper:
             out_shardings=(buf_sh, flat_sh),
             donate_argnums=(0,))
         self._buf_sharding = buf_sh
+        self._pinned_sharding = NamedSharding(
+            mesh, P(None, *_flat_spec(mesh)), memory_kind="pinned_host")
+        self._init_buf = jax.jit(
+            lambda ch: jnp.concatenate(
+                [ch[None], jnp.zeros((2, ch.shape[0]), jnp.float32)]),
+            out_shardings=buf_sh)
 
 
     # ------------------------------------------------------------------
@@ -235,13 +255,23 @@ class NVMeOptimizerSwapper:
     def _path(self, i: int) -> str:
         return os.path.join(self._dir, f"opt_chunk_{i}.bin")
 
-    def _write_file(self, i: int, host_buf: np.ndarray):
-        if self._aio_w is not None:
+    def _write_file(self, i: int, host_buf):
+        if self.storage == "pinned":
+            # device->pinned_host DMA dispatches async; the handle is the
+            # storage (nothing crosses the client wire)
+            self._buffers[i] = jax.device_put(host_buf, self._pinned_sharding)
+        elif self.storage == "host":
+            self._buffers[i] = np.ascontiguousarray(
+                np.asarray(jax.device_get(host_buf))
+                if not isinstance(host_buf, np.ndarray) else host_buf).copy()
+        elif self._aio_w is not None:
             self._aio_w.pwrite(self._path(i), host_buf)
         else:
             host_buf.tofile(self._path(i))
 
-    def _read_file(self, i: int, out: np.ndarray) -> np.ndarray:
+    def _read_file(self, i: int, out: np.ndarray = None):
+        if self.storage in ("pinned", "host"):
+            return self._buffers[i]
         if self._aio is not None:
             return self._aio.pread(self._path(i), out.shape, out.dtype, out=out)
         data = np.fromfile(self._path(i), np.float32).reshape(out.shape)
@@ -257,6 +287,10 @@ class NVMeOptimizerSwapper:
         for i in range(self.n_chunks):
             with self.mesh:
                 ch = self._gather_chunk[i](*leaves)
+            if self.storage == "pinned":
+                with self.mesh:
+                    self._write_file(i, self._init_buf(ch))
+                continue
             buf[0] = np.asarray(jax.device_get(ch))
             buf[1:] = 0.0
             self._write_file(i, buf)
@@ -332,7 +366,10 @@ class NVMeOptimizerSwapper:
         return new_params, gnorm, False
 
     def _writeback(self, i: int, dev_buf):
-        self._write_file(i, np.asarray(jax.device_get(dev_buf)))
+        if self.storage in ("pinned", "host"):
+            self._write_file(i, dev_buf)  # pinned: direct device->host DMA
+        else:
+            self._write_file(i, np.asarray(jax.device_get(dev_buf)))
 
     # ------------------------------------------------------------------
     # checkpoint integration: the NVMe state is part of the training state
@@ -342,7 +379,10 @@ class NVMeOptimizerSwapper:
         out = {}
         for i in range(self.n_chunks):
             buf = np.empty((_PLANES, self.chunk), np.float32)
-            out[f"chunk_{i}"] = self._read_file(i, buf).copy()
+            got = self._read_file(i, buf)
+            if not isinstance(got, np.ndarray):
+                got = np.asarray(jax.device_get(got))
+            out[f"chunk_{i}"] = got.copy()
         return out
 
     def import_state(self, chunks: Dict[str, np.ndarray]):
@@ -353,7 +393,9 @@ class NVMeOptimizerSwapper:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
-        shutil.rmtree(self._dir, ignore_errors=True)
+        self._buffers.clear()
+        if self._dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
 
     def __del__(self):  # pragma: no cover
         try:

@@ -34,9 +34,12 @@ class TestOptimizerOffload:
         l1 = [float(e1.train_batch(batch)["loss"]) for _ in range(5)]
         l2 = [float(e2.train_batch(batch)["loss"]) for _ in range(5)]
         np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=1e-5)
-        # states actually live in host memory
-        leaf = e2.state["opt"]["exp_avg"]["tok_embed"]
-        assert leaf.sharding.memory_kind == "pinned_host"
+        # device=cpu routes through the chunk-streamed swapper: no fp32
+        # optimizer state in device memory ("pinned" tier on TPU, plain
+        # host buffers in the CPU test harness)
+        assert e2.state["opt"] is None
+        assert e2._swapper is not None
+        assert e2._swapper.storage in ("pinned", "host")
 
     def test_offload_checkpoint_roundtrip(self, tmp_path):
         cfg = {"train_batch_size": 16,
