@@ -72,6 +72,7 @@ class Autotuner:
         batch = int(self.base.get("train_batch_size", 8))
 
         meshes: List[Tuple[Dict[str, int], int]] = []  # (axes, zero stage)
+        experts = getattr(model_cfg, "num_experts", 1) or 1
         for tp in _divisors(n):
             if tp > 8 or (heads and heads % tp):
                 continue
@@ -82,11 +83,27 @@ class Autotuner:
             # fully-sharded variant
             if rest > 1:
                 meshes.append(({"fsdp": rest, "tensor": tp}, 3))
+            # pipeline variants: stages must divide the layer stack AND the
+            # remaining devices (the 1F1B schedule needs gas microbatches,
+            # handled by the gas loop below)
+            if layers:
+                for pp in _divisors(rest):
+                    if pp > 1 and pp <= 8 and layers % pp == 0 \
+                            and rest // pp >= 1:
+                        meshes.append(
+                            ({"pipe": pp, "data": rest // pp,
+                              "tensor": tp}, 1))
+        # expert axis: MoE models shard the expert stack
+        if experts > 1:
+            for ep in _divisors(min(n, experts)):
+                if ep > 1 and experts % ep == 0 and n % ep == 0:
+                    meshes.append(({"expert": ep, "data": n // ep}, 1))
 
-        gas_opts = [1, 2, 4]
-        gas_opts = [g for g in gas_opts
-                    if batch % (g * 1) == 0][:max(1, int(
-                        self.at_cfg.get("num_tuning_micro_batch_sizes", 3)))]
+        # gas candidates follow the batch's actual divisor structure instead
+        # of a hardcoded [1, 2, 4]
+        gas_opts = [g for g in _divisors(batch) if g <= 16]
+        gas_opts = gas_opts[:max(1, int(
+            self.at_cfg.get("num_tuning_micro_batch_sizes", 3)))]
 
         remat_opts: List[Optional[str]] = [None]
         if model_cfg is not None and hasattr(model_cfg, "remat_policy"):

@@ -1,2 +1,3 @@
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityError, compute_elastic_config, get_compatible_gpus)
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent

@@ -16,6 +16,7 @@ gather/scatter are static-shape `jnp.take_along_axis` ops XLA vectorizes.
 
 import dataclasses
 import math
+import os
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -204,3 +205,121 @@ def random_ltd_layer(x, layer_fn, keep: int, rng, *args, **kwargs):
     if isinstance(out, tuple):
         return (full,) + out[1:]
     return full
+
+
+# --------------------------------------------------------------------------
+# Indexed dataset + offline data analyzer
+# (reference: runtime/data_pipeline/data_sampling/indexed_dataset.py — the
+# Megatron mmap .bin/.idx format — and data_analyzer.py:18 DataAnalyzer:
+# an offline pass computing per-sample metrics consumed by the curriculum
+# sampler)
+# --------------------------------------------------------------------------
+
+class IndexedDataset:
+    """mmap-backed variable-length token dataset: `prefix.bin` holds the
+    concatenated int32 token streams, `prefix.idx` the (offset, length)
+    table. Random access costs one mmap slice — no loading, no pickling."""
+
+    MAGIC = 0x44535450  # "DSTP"
+
+    def __init__(self, prefix: str):
+        idx = np.fromfile(f"{prefix}.idx", dtype=np.int64)
+        if len(idx) < 3 or idx[0] != self.MAGIC:
+            raise ValueError(f"{prefix}.idx is not an indexed dataset")
+        n = int(idx[1])
+        self._offsets = idx[2:2 + n + 1]
+        self._data = np.memmap(f"{prefix}.bin", dtype=np.int32, mode="r")
+
+    def __len__(self):
+        return len(self._offsets) - 1
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        return np.asarray(self._data[self._offsets[i]:self._offsets[i + 1]])
+
+    @property
+    def lengths(self) -> np.ndarray:
+        return np.diff(self._offsets)
+
+
+def write_indexed_dataset(samples, prefix: str) -> int:
+    """samples: iterable of 1-D int token arrays (streamed; O(1) memory).
+    Returns the sample count."""
+    offsets = [0]
+    with open(f"{prefix}.bin", "wb") as f:
+        for s in samples:
+            arr = np.ascontiguousarray(np.asarray(s, np.int32).reshape(-1))
+            arr.tofile(f)
+            offsets.append(offsets[-1] + arr.size)
+    n = len(offsets) - 1
+    header = np.array([IndexedDataset.MAGIC, n], np.int64)
+    np.concatenate([header, np.asarray(offsets, np.int64)]).tofile(
+        f"{prefix}.idx")
+    return n
+
+
+class DataAnalyzer:
+    """Offline per-sample metric pass (reference: data_analyzer.py:18).
+
+    metrics: {name: fn(sample_tokens) -> float}. `run` writes, per metric,
+    `<name>_values.npy` (value per sample id) and `<name>_order.npy`
+    (sample ids sorted easiest-first) into out_dir — exactly the artifacts
+    the curriculum sampler consumes."""
+
+    BUILTIN = {"seqlen": lambda toks: float(len(toks)),
+               "vocab_rarity": lambda toks: float(
+                   np.mean(np.asarray(toks, np.float64)))}
+
+    def __init__(self, metrics: Optional[Dict[str, Any]] = None):
+        self.metrics = metrics or {"seqlen": self.BUILTIN["seqlen"]}
+
+    def run(self, dataset, out_dir: str) -> Dict[str, str]:
+        os.makedirs(out_dir, exist_ok=True)
+        values = {name: [] for name in self.metrics}
+        for i in range(len(dataset)):
+            sample = dataset[i]
+            toks = sample["input_ids"] if isinstance(sample, dict) else sample
+            for name, fn in self.metrics.items():
+                values[name].append(fn(toks))
+        paths = {}
+        for name, vals in values.items():
+            v = np.asarray(vals, np.float64)
+            order = np.argsort(v, kind="stable").astype(np.int64)
+            np.save(os.path.join(out_dir, f"{name}_values.npy"), v)
+            np.save(os.path.join(out_dir, f"{name}_order.npy"), order)
+            paths[name] = out_dir
+        return paths
+
+
+class CurriculumSampler:
+    """Difficulty-gated sampler over a DataAnalyzer index (reference:
+    data_sampling/data_sampler.py DeepSpeedDataSampler): at each step, draws
+    batches uniformly from the easiest samples whose metric value is within
+    the scheduler's current difficulty."""
+
+    def __init__(self, metric_dir: str, metric: str,
+                 scheduler: CurriculumScheduler, batch_size: int, *,
+                 rank: int = 0, world_size: int = 1, seed: int = 0):
+        self.values = np.load(os.path.join(metric_dir,
+                                           f"{metric}_values.npy"))
+        self.order = np.load(os.path.join(metric_dir, f"{metric}_order.npy"))
+        self._sorted_vals = self.values[self.order]  # once, not per step
+        self.scheduler = scheduler
+        self.batch_size = batch_size
+        self.rank, self.world = rank, world_size
+        # every rank MUST draw the identical stream (seed only): the global
+        # batch is the shared draw, each rank takes its strided rows —
+        # per-rank seeds would duplicate/skip samples across the dp group
+        self._rng = np.random.default_rng(seed)
+
+    def eligible(self, step: int) -> np.ndarray:
+        d = self.scheduler.update_difficulty(step)
+        cutoff = int(np.searchsorted(self._sorted_vals, d, side="right"))
+        cutoff = max(cutoff, self.batch_size * self.world)
+        return self.order[:cutoff]
+
+    def sample(self, step: int) -> np.ndarray:
+        """Sample ids for this rank's micro-batch at `step`."""
+        pool = self.eligible(step)
+        picks = self._rng.choice(pool, size=self.batch_size * self.world,
+                                 replace=len(pool) < self.batch_size * self.world)
+        return picks[self.rank::self.world]

@@ -391,23 +391,29 @@ class Engine:
             pure_dp = (self.plan.tensor == 1 and self.plan.pipe == 1
                        and self.plan.fsdp == 1 and self.plan.expert == 1
                        and self.plan.seq == 1)
-            ok = (pure_dp and zero_cfg.stage == 0 and not self._fp16
+            # ZeRO stays off by design: the 1-bit algorithm keeps FULL
+            # momentum + master per rank (local momentum accumulates the
+            # full local gradient before compression), so optimizer-state
+            # sharding cannot compose — the reference's 1-bit optimizers
+            # carry the same ZeRO restriction.
+            ok = (pure_dp and zero_cfg.stage == 0
                   and not self._offload_opt and not self._nvme_opt)
             if ok:
                 self._onebit_comm = True
+                extras = []
+                if self._fp16:
+                    extras.append("fp16 loss scaling in-step")
                 if config.gradient_clipping:
-                    logger.warning(
-                        "1-bit compressed path: gradient clipping is ignored "
-                        "(a per-rank clip on local grads would desynchronize "
-                        "parameters; the reference has the same caveat)")
+                    extras.append("synchronized norm-proxy clipping")
                 logger.info("1-bit optimizer: compressed communication over "
                             f"data axis ({self.plan.data} ranks), packed "
-                            "sign all-gather in the compressed phase")
+                            "sign all-gather in the compressed phase"
+                            + (f" ({', '.join(extras)})" if extras else ""))
             else:
                 logger.warning(
                     "1-bit optimizer: compressed communication requires a "
                     "pure data-parallel mesh, zero stage 0, and no "
-                    "fp16/offload — falling back to dense (error-feedback "
+                    "offload — falling back to dense (error-feedback "
                     "sign update semantics are preserved, bytes are not "
                     "reduced)")
 
@@ -642,7 +648,7 @@ class Engine:
         cfg = self.config
         off = cfg.zero_optimization.offload_optimizer
         p = dict(cfg.optimizer.params) if cfg.optimizer else {}
-        name = (cfg.optimizer.name if cfg.optimizer else "adamw").lower()
+        name = _opt_name(cfg)
         grad_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self.grad_specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -677,7 +683,7 @@ class Engine:
         off_p = cfg.zero_optimization.offload_param
         off_o = cfg.zero_optimization.offload_optimizer
         p = dict(cfg.optimizer.params) if cfg.optimizer else {}
-        name = (cfg.optimizer.name if cfg.optimizer else "adamw").lower()
+        name = _opt_name(cfg)
         lr = self._schedule if self._schedule is not None else p.get("lr", 1e-3)
         return InfinityExecutor(
             self.model.config, rng=self._rng,
@@ -964,6 +970,10 @@ class Engine:
         rv = set(opt.rank_varying)
         from jax import lax
 
+        fp16 = self._fp16
+        fp16_cfg = cfg.fp16
+        clip = cfg.gradient_clipping
+
         def per_device(state, batch, rng):
             params = state["params"]
             opt_local = {
@@ -971,32 +981,76 @@ class Engine:
                     if k in rv and v is not None else v)
                 for k, v in state["opt"].items()}
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
+            scale = (state["loss_scale"]["scale"] if fp16
+                     else jnp.float32(1.0))
 
             def micro(p, mb, r):
-                return jax.value_and_grad(
-                    lambda q: model.loss_fn(q, mb, r, False))(p)
+                def loss_fn(q):
+                    loss = model.loss_fn(q, mb, r, False)
+                    return loss * scale.astype(loss.dtype) if fp16 else loss
+                return jax.value_and_grad(loss_fn)(p)
 
             grads, loss = self._accum_micro_grads(
                 lambda p, mb, r: micro(p, mb, r), params, batch, gas, rng)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            if fp16:
+                grads = fp16_mod.unscale_grads(
+                    grads, fp16_mod.LossScaleState(**state["loss_scale"]))
+                loss = loss / scale
+                # ANY rank overflowing must skip the step on EVERY rank —
+                # divergent skips would desynchronize the replicated params
+                overflow = lax.pmax(
+                    fp16_mod.has_overflow(grads).astype(jnp.float32),
+                    "data") > 0
+            else:
+                overflow = jnp.zeros((), jnp.bool_)
+
+            # RMS of the per-rank local grad norms — an UPPER bound on the
+            # true norm of the averaged gradient (computing that exactly
+            # would need the dense all-reduce this path avoids). The scalar
+            # psum makes it IDENTICAL on every rank, so clipping by it
+            # cannot desynchronize parameters.
+            gsq = sum(jnp.sum(jnp.square(g))
+                      for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(lax.pmean(gsq, "data"))
+            if clip and clip > 0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
 
             new_params, new_opt = opt.update_phase(
                 grads, opt_local, params, phase=phase, axis="data")
+            if fp16:
+                # freeze EVERYTHING on overflow (params, moments, error
+                # feedback) — reference: step:1635 overflow path
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n),
+                    new_params, params)
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(overflow, o, n),
+                    new_opt, opt_local)
             new_opt = {
                 k: (jax.tree.map(lambda a: a[None], v)
                     if k in rv and v is not None else v)
                 for k, v in new_opt.items()}
             mean_loss = lax.pmean(loss, "data")
-            # diagnostic: RMS of the per-rank local grad norms — an UPPER
-            # bound on the true norm of the averaged gradient (computing that
-            # exactly would need the dense all-reduce this path avoids)
-            gsq = sum(jnp.sum(jnp.square(g))
-                      for g in jax.tree.leaves(grads))
-            gnorm = jnp.sqrt(lax.pmean(gsq, "data"))
             new_state = {"params": new_params, "opt": new_opt,
-                         "step": state["step"] + 1}
+                         "step": jnp.where(overflow, state["step"],
+                                           state["step"] + 1)}
+            if fp16:
+                new_ls = fp16_mod.update_loss_scale(
+                    fp16_mod.LossScaleState(**state["loss_scale"]), overflow,
+                    dynamic=fp16_cfg.dynamic,
+                    scale_window=fp16_cfg.loss_scale_window,
+                    min_scale=fp16_cfg.min_loss_scale,
+                    max_hysteresis=fp16_cfg.hysteresis,
+                    consecutive_hysteresis=fp16_cfg.consecutive_hysteresis)
+                new_state["loss_scale"] = {"scale": new_ls.scale,
+                                           "good_steps": new_ls.good_steps,
+                                           "hysteresis": new_ls.hysteresis}
             metrics = {"loss": mean_loss, "grad_norm": gnorm,
-                       "overflow": jnp.zeros((), jnp.bool_)}
+                       "overflow": overflow}
+            if fp16:
+                metrics["loss_scale"] = state["loss_scale"]["scale"]
             return new_state, metrics
 
         def spec_of(tree, varying_keys=()):
@@ -1006,7 +1060,12 @@ class Engine:
         state_spec = {"params": P(),
                       "opt": spec_of(self.state["opt"], rv),
                       "step": P()}
+        if fp16:
+            state_spec["loss_scale"] = {k: P() for k in
+                                        self.state["loss_scale"]}
         out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
+        if fp16:
+            out_metrics_spec["loss_scale"] = P()
         # per-leaf batch specs: scalar side-channels replicate, rows shard
         batch_spec = P("data") if batch is None else jax.tree.map(
             lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(), batch)
@@ -1067,7 +1126,8 @@ class Engine:
             step_fn = self._get_onebit_step(phase, batch)
             with self.mesh:
                 self.state, metrics = step_fn(self.state, batch, sub)
-            self._onebit_applied += 1
+            if not (self._fp16 and bool(metrics["overflow"])):
+                self._onebit_applied += 1  # overflow steps don't advance
         else:
             if self._offload_opt:
                 self.state["opt"] = self._opt_to_device(self.state["opt"])

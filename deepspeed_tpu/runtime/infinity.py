@@ -668,8 +668,20 @@ class InfinityExecutor:
     # ------------------------------------------------------------------
     def save_checkpoint(self, path: str) -> Dict[str, Any]:
         """Copy chunk files + return the small HBM-resident state for the
-        engine's regular checkpoint machinery."""
+        engine's regular checkpoint machinery. A shapes manifest makes the
+        chunks self-describing (utils/zero_to_fp32.py reconstructs the fp32
+        tree offline with no engine)."""
+        import json as _json
         self.store.save_to(os.path.join(path, "infinity_chunks"))
+        leaf_names = ["/".join(str(getattr(k, "key", k)) for k in p)
+                      for p, _ in jax.tree_util.tree_flatten_with_path(
+                          jax.tree.unflatten(self._treedef,
+                                             list(range(len(self._sizes)))))[0]]
+        with open(os.path.join(path, "infinity_shapes.json"), "w") as f:
+            _json.dump({"chunk": self.chunk,
+                        "num_layers": self.cfg.num_layers,
+                        "leaf_names": leaf_names,
+                        "leaf_shapes": [list(s) for s in self._shapes]}, f)
         return {"nl_params": jax.device_get(self.nl_params),
                 "nl_opt": jax.device_get(self.nl_opt),
                 "applied_steps": self.applied_steps}

@@ -1,0 +1,167 @@
+"""Offline checkpoint -> consolidated fp32 state dict (no engine needed).
+
+Reference: ``deepspeed/utils/zero_to_fp32.py:311,360`` — merge a dead run's
+ZeRO shard files into one fp32 state_dict from the command line. TPU-native
+differences: GSPMD checkpoints are already logically consolidated (Orbax
+stores the global array), so "merging" means extracting the fp32 MASTER
+weights — from the optimizer state, from NVMe/host swap chunks
+(``optswap.npz``), or from a ZeRO-Infinity layer-chunk directory — falling
+back to upcasting the model params when no master exists.
+
+CLI:  python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <out.npz> [--tag T]
+"""
+
+import argparse
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["convert_zero_checkpoint_to_fp32_state_dict",
+           "get_fp32_state_dict_from_zero_checkpoint"]
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = k if not prefix else f"{prefix}/{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        elif v is not None:
+            out[key] = v
+    return out
+
+
+def _resolve_tag(ckpt_dir: str, tag: Optional[str]) -> str:
+    if tag is not None:
+        return str(tag)
+    latest = os.path.join(ckpt_dir, "latest")
+    if not os.path.exists(latest):
+        raise FileNotFoundError(f"no 'latest' file under {ckpt_dir} and no "
+                                "--tag given")
+    with open(latest) as f:
+        return f.read().strip()
+
+
+def _to_np(tree):
+    import jax
+    return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tree)
+
+
+def _masters_from_swap_chunks(chunks: Dict[str, np.ndarray], params
+                              ) -> Dict:
+    """Rebuild the fp32 master tree from flat (3, C) swap chunks. The chunk
+    layout is the swapper's: leaves in jax.tree.flatten order, concatenated
+    then split into fixed-size chunks (master is plane 0)."""
+    import jax
+    leaves, treedef = jax.tree.flatten(params)
+    flat = np.concatenate([chunks[f"chunk_{i}"][0]
+                           for i in range(len(chunks))])
+    out, off = [], 0
+    for leaf in leaves:
+        size = int(np.prod(leaf.shape))
+        out.append(flat[off:off + size].reshape(leaf.shape)
+                   .astype(np.float32))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def _infinity_fp32(path: str) -> Dict[str, np.ndarray]:
+    """ZeRO-Infinity layer-chunk checkpoint: per-layer opt_i.bin chunks
+    (master = plane 0) + the shapes manifest written alongside."""
+    import ml_dtypes
+    with open(os.path.join(path, "infinity_shapes.json")) as f:
+        man = json.load(f)
+    chunk = int(man["chunk"])
+    names, shapes = man["leaf_names"], man["leaf_shapes"]
+    cdir = os.path.join(path, "infinity_chunks")
+    layers: Dict[str, list] = {n: [] for n in names}
+    L = int(man["num_layers"])
+    for i in range(L):
+        p = os.path.join(cdir, f"opt_{i}.bin")
+        if os.path.exists(p):
+            flat = np.fromfile(p, np.float32).reshape(3, chunk)[0]
+        else:  # never stepped: master == bf16 params
+            bits = np.fromfile(os.path.join(cdir, f"param_{i}.bin"),
+                               np.uint16)
+            flat = bits.view(ml_dtypes.bfloat16).astype(np.float32)
+        off = 0
+        for n, shape in zip(names, shapes):
+            size = int(np.prod(shape))
+            layers[n].append(flat[off:off + size].reshape(shape))
+            off += size
+    out = {f"layers/{n}": np.stack(v) for n, v in layers.items()}
+    # non-layer params: masters live in the small npz (nl_opt/*/master)
+    meta_p = os.path.join(path, "infinity_meta.json")
+    npz_p = os.path.join(path, "infinity_small.npz")
+    with open(meta_p) as f:
+        dtypes = json.load(f)["dtypes"]
+    with np.load(npz_p) as z:
+        for k in z.files:
+            key = k.replace("__", "/")
+            if key.startswith("nl_opt/") and key.endswith("/master"):
+                name = key[len("nl_opt/"):-len("/master")]
+                arr = z[k]
+                if "bfloat16" in dtypes.get(key, ""):
+                    arr = arr.view(ml_dtypes.bfloat16)
+                out[name] = np.asarray(arr, np.float32)
+    return out
+
+
+def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
+                                             tag: Optional[str] = None
+                                             ) -> Dict[str, np.ndarray]:
+    """Flat {name: fp32 array} dict from a checkpoint directory."""
+    path = os.path.join(ckpt_dir, _resolve_tag(ckpt_dir, tag))
+    if os.path.exists(os.path.join(path, "infinity_shapes.json")):
+        return _infinity_fp32(path)
+
+    from deepspeed_tpu.runtime.checkpointing import OrbaxCheckpointEngine
+    state = OrbaxCheckpointEngine().load(os.path.join(path, "state"))
+    state = _to_np(state)
+    params = state["params"]
+    opt = state.get("opt")
+
+    swap_file = os.path.join(path, "optswap.npz")
+    if os.path.exists(swap_file):
+        with np.load(swap_file) as z:
+            masters = _masters_from_swap_chunks(
+                {k: z[k] for k in z.files}, params)
+    elif isinstance(opt, dict) and opt.get("master") is not None:
+        masters = opt["master"]
+    else:
+        import jax
+        masters = jax.tree.map(lambda a: np.asarray(a, np.float32), params)
+    flat = _flatten(masters)
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(ckpt_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None
+                                               ) -> str:
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    if not output_file.endswith(".npz"):
+        output_file += ".npz"
+    np.savez(output_file, **{k.replace("/", "__"): v for k, v in sd.items()})
+    total = sum(v.size for v in sd.values())
+    print(f"wrote {len(sd)} fp32 tensors ({total/1e6:.1f}M params) to "
+          f"{output_file}")
+    return output_file
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Extract a consolidated fp32 state dict from a "
+                    "deepspeed_tpu checkpoint directory (no engine needed)")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    a = p.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(a.checkpoint_dir,
+                                               a.output_file, tag=a.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -165,3 +165,69 @@ class TestRandomLTD:
         assert np.isfinite(losses).all() and losses[-1] < losses[0]
         # schedule reached full seq -> model back to dense
         assert engine._ltd_keep == 64
+
+
+class TestIndexedDatasetAnalyzer:
+    """Reference: data_sampling/indexed_dataset.py + data_analyzer.py:18 +
+    the curriculum sampler that consumes the analyzer's index."""
+
+    def _write(self, tmp, n=50, seed=0):
+        from deepspeed_tpu.runtime.data_pipeline import write_indexed_dataset
+        rng = np.random.default_rng(seed)
+        samples = [rng.integers(0, 100, size=rng.integers(4, 64))
+                   for _ in range(n)]
+        prefix = str(tmp / "ds")
+        count = write_indexed_dataset(samples, prefix)
+        return prefix, samples, count
+
+    def test_indexed_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import IndexedDataset
+        prefix, samples, count = self._write(tmp_path)
+        ds = IndexedDataset(prefix)
+        assert len(ds) == count == len(samples)
+        for i in (0, 7, len(ds) - 1):
+            np.testing.assert_array_equal(ds[i], samples[i].astype(np.int32))
+        np.testing.assert_array_equal(ds.lengths,
+                                      [len(s) for s in samples])
+
+    def test_analyzer_and_curriculum_sampler(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler, CurriculumSampler, DataAnalyzer,
+            IndexedDataset)
+        prefix, samples, _ = self._write(tmp_path)
+        ds = IndexedDataset(prefix)
+        paths = DataAnalyzer().run(ds, str(tmp_path / "metrics"))
+        vals = np.load(tmp_path / "metrics" / "seqlen_values.npy")
+        np.testing.assert_array_equal(vals, [len(s) for s in samples])
+        order = np.load(tmp_path / "metrics" / "seqlen_order.npy")
+        assert (np.diff(vals[order]) >= 0).all()
+
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1}})
+        sampler = CurriculumSampler(str(tmp_path / "metrics"), "seqlen",
+                                    sched, batch_size=4)
+        early = sampler.sample(1)
+        late = sampler.sample(10)
+        assert len(early) == 4 and len(late) == 4
+        # early in the curriculum: only short samples are eligible
+        max_early = max(len(samples[i]) for i in early)
+        assert max_early <= max(16, 8 + 4)  # near min_difficulty
+        # sharded: two ranks see disjoint rows of the same draw
+        s0 = CurriculumSampler(str(tmp_path / "metrics"), "seqlen", sched,
+                               batch_size=2, rank=0, world_size=2, seed=3)
+        s1 = CurriculumSampler(str(tmp_path / "metrics"), "seqlen", sched,
+                               batch_size=2, rank=1, world_size=2, seed=3)
+        a, b = s0.sample(5), s1.sample(5)
+        assert len(a) == 2 and len(b) == 2
+        # ranks partition ONE shared draw: identical RNG stream, strided
+        # rows — a per-rank seed would duplicate/skip samples
+        ref = CurriculumSampler(str(tmp_path / "metrics"), "seqlen", sched,
+                                batch_size=2, rank=0, world_size=2, seed=3)
+        pool = ref.eligible(5)
+        full = ref._rng.choice(pool, size=4, replace=len(pool) < 4)
+        np.testing.assert_array_equal(a, full[0::2])
+        np.testing.assert_array_equal(b, full[1::2])

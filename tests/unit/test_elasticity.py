@@ -2,6 +2,7 @@
 tests/unit/elasticity/test_elastic.py cases)."""
 
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 import deepspeed_tpu
@@ -70,3 +71,64 @@ def test_engine_elastic_conflicting_batch_raises(devices8):
             "train_batch_size": 16,
             "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
             "elasticity": {"enabled": True}})
+
+
+class TestElasticAgent:
+    """Reference: elasticity/elastic_agent.py:25 — resume across scale
+    events. Simulated in-process: the device world shrinks 8 -> 4 and the
+    agent rebuilds + resumes from the latest checkpoint with the new
+    micro/gas split."""
+
+    def _factory(self):
+        from deepspeed_tpu.models import TransformerConfig, make_model
+        return lambda: make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=64, dtype=jnp.float32, attention_impl="xla"))
+
+    def _cfg(self):
+        return {
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "bf16": {"enabled": False},
+            "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                           "micro_batch_sizes": [2, 4],
+                           "min_gpus": 1, "max_gpus": 8, "version": 0.2},
+            "steps_per_print": 1000}
+
+    def test_world_shrink_resumes(self, tmp_path):
+        from deepspeed_tpu.elasticity import DSElasticAgent
+        world = {"n": 8}
+        agent = DSElasticAgent(self._factory(), self._cfg(), str(tmp_path),
+                               checkpoint_interval=2,
+                               device_count_fn=lambda: world["n"])
+        assert agent.world == 8
+        batch8 = agent.batch_size
+        rng = np.random.default_rng(0)
+        fixed = rng.integers(0, 64, (batch8, 32), dtype=np.int32)
+
+        def make_batch_fn(bs):
+            assert bs == batch8  # same global batch at every world size
+            return {"input_ids": fixed}
+
+        losses = [float(agent.train_batch(make_batch_fn)["loss"])
+                  for _ in range(6)]
+        step_before = agent.engine.global_steps
+
+        world["n"] = 4  # scale event: half the devices disappear
+        l_after = float(agent.train_batch(make_batch_fn)["loss"])
+        assert agent.scale_events == 1 and agent.world == 4
+        # resumed from the step-4 checkpoint, not from scratch
+        assert agent.engine.global_steps == step_before + 1
+        cfg = agent.engine.config
+        assert cfg.train_batch_size == batch8  # same global batch
+        assert (cfg.train_micro_batch_size_per_gpu
+                * cfg.gradient_accumulation_steps * 4 == batch8)
+        # loss continues from the trained trajectory (not re-initialized:
+        # a fresh model starts near ln(64) ~ 4.16)
+        assert l_after < losses[0] - 0.2, (l_after, losses)
+        assert abs(l_after - losses[-1]) < 0.5  # continues, no reset jump
+
+    def test_requires_elastic_section(self, tmp_path):
+        from deepspeed_tpu.elasticity import DSElasticAgent
+        with pytest.raises(ValueError, match="elasticity"):
+            DSElasticAgent(self._factory(), {"train_batch_size": 8},
+                           str(tmp_path))
