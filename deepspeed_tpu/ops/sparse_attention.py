@@ -182,160 +182,297 @@ def _interpret() -> bool:
 
 
 # --------------------------------------------------------------------------
-# kernels (flash-style online softmax over the adjacency lists)
+# kernels: flash-style online softmax over each row's adjacency list, with
+# MANUAL double-buffered DMA — K/V stay in HBM (pltpu.ANY) and each listed
+# block is copied into a 2-slot VMEM scratch one step ahead of its use.
+# Work and HBM traffic are exactly proportional to the row's TRUE degree:
+# no full-[S,D] VMEM residency (the round-2 design) and no padded grid
+# steps (a slot-grid design pays max_deg steps per row, and global rows
+# push max_deg to the full row width for BigBird/Longformer layouts).
 # --------------------------------------------------------------------------
 
-def _sp_fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                   sm_scale, causal, block, seq_len):
-    qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+M_FLOOR = -1e20
+
+
+# K/V (and the dK/dV pass's Q/dO) arrive CHANNEL-MAJOR ([B, N, D, S]): DMA
+# slices then run along the 128-aligned sequence dim (Mosaic rejects lane
+# slices of a D=64 minor dim). lse/delta keep [B, N, S, 1] — their minor dim
+# is full. The dots below contract the channel dim of the transposed tiles
+# directly, so no in-kernel transposes are needed.
+
+def _seq_dma(hbm_ref, scratch, sem, b, n, j, slot, block):
+    return pltpu.make_async_copy(
+        hbm_ref.at[b, n, :, pl.ds(j * block, block)],
+        scratch.at[slot], sem.at[slot])
+
+
+def _make_dma_ops(streams, idx_ref, row, b, n, block):
+    """Shared start/wait pair over a list of (hbm, scratch, sem) streams:
+    descriptors are rebuilt identically for start and wait (the Pallas
+    async-copy contract)."""
+    def _descs(t, slot):
+        j = jnp.maximum(idx_ref[row, t], 0)
+        return [_seq_dma(hbm, scr, sem, b, n, j, slot, block)
+                for hbm, scr, sem in streams]
+
+    def start(t, slot):
+        for d_ in _descs(t, slot):
+            d_.start()
+
+    def wait(t, slot):
+        for d_ in _descs(t, slot):
+            d_.wait()
+
+    return start, wait
+
+
+
+
+
+def _sp_fwd_kernel(idx_ref, cnt_ref, q_ref, kt_hbm, vt_hbm, o_ref, lse_ref,
+                   *, sm_scale, causal, block):
+    b, n, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    cnt = cnt_ref[qi]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale     # [block, D]
     d = q.shape[-1]
     q_start = qi * block
 
-    m0 = jnp.full((block, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block, 1), jnp.float32)
-    acc0 = jnp.zeros((block, d), jnp.float32)
+    def body(ks, vs, ksem, vsem):
+        start, wait = _make_dma_ops(
+            [(kt_hbm, ks, ksem), (vt_hbm, vs, vsem)], idx_ref, qi, b, n,
+            block)
 
-    def body(t, carry):
-        m, l, acc = carry
-        j = idx_ref[qi, t]
-        k = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        @pl.when(cnt > 0)
+        def _warm():
+            start(0, 0)
 
-    m, l, acc = jax.lax.fori_loop(0, cnt_ref[qi], body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+        def step(t, carry):
+            m, l, acc = carry
+            slot = t % 2
+
+            @pl.when(t + 1 < cnt)
+            def _prefetch():
+                start(t + 1, (t + 1) % 2)
+
+            wait(t, slot)
+            j = idx_ref[qi, t]
+            kt = ks[slot].astype(jnp.float32)           # [D, block]
+            vt = vs[slot].astype(jnp.float32)
+            # s[qr, kr] = sum_d q[qr, d] * kt[d, kr]
+            s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                q_pos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                k_pos = j * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_new = jnp.maximum(
+                jnp.maximum(m, jnp.max(s, -1, keepdims=True)), M_FLOOR)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, -1, keepdims=True)
+            # acc[qr, d] = sum_kr p[qr, kr] * vt[d, kr]
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p, vt, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((block, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block, 1), jnp.float32)
+        acc0 = jnp.zeros((block, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, cnt, step, (m0, l0, acc0))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(l_safe)
+
+    pl.run_scoped(
+        body,
+        ks=pltpu.VMEM((2, kt_hbm.shape[2], block), kt_hbm.dtype),
+        vs=pltpu.VMEM((2, vt_hbm.shape[2], block), vt_hbm.dtype),
+        ksem=pltpu.SemaphoreType.DMA((2,)),
+        vsem=pltpu.SemaphoreType.DMA((2,)))
 
 
-def _sp_bwd_dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, *, sm_scale, causal, block, seq_len):
-    qi = pl.program_id(2)
+def _sp_bwd_dq_kernel(idx_ref, cnt_ref, q_ref, kt_hbm, vt_hbm, do_ref,
+                      lse_ref, delta_ref, dq_ref, *, sm_scale, causal,
+                      block):
+    b, n, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    cnt = cnt_ref[qi]
     q_start = qi * block
-    q = q_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0].astype(jnp.float32)                 # [block, D]
     do = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     d = q.shape[-1]
 
-    def body(t, dq):
-        j = idx_ref[qi, t]
-        k = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+    def body(ks, vs, ksem, vsem):
+        start, wait = _make_dma_ops(
+            [(kt_hbm, ks, ksem), (vt_hbm, vs, vsem)], idx_ref, qi, b, n,
+            block)
 
-    dq = jax.lax.fori_loop(0, cnt_ref[qi], body,
-                           jnp.zeros((block, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+        @pl.when(cnt > 0)
+        def _warm():
+            start(0, 0)
+
+        def step(t, dq):
+            slot = t % 2
+
+            @pl.when(t + 1 < cnt)
+            def _prefetch():
+                start(t + 1, (t + 1) % 2)
+
+            wait(t, slot)
+            j = idx_ref[qi, t]
+            kt = ks[slot].astype(jnp.float32)           # [D, block]
+            vt = vs[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * sm_scale
+            if causal:
+                q_pos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                k_pos = j * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            # dp[qr, kr] = sum_d do[qr, d] * vt[d, kr]
+            dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * sm_scale
+            # dq[qr, d] = sum_kr ds[qr, kr] * kt[d, kr]
+            return dq + jax.lax.dot_general(
+                ds, kt, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, cnt, step,
+                               jnp.zeros((block, d), jnp.float32))
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        ks=pltpu.VMEM((2, kt_hbm.shape[2], block), kt_hbm.dtype),
+        vs=pltpu.VMEM((2, vt_hbm.shape[2], block), vt_hbm.dtype),
+        ksem=pltpu.SemaphoreType.DMA((2,)),
+        vsem=pltpu.SemaphoreType.DMA((2,)))
 
 
-def _sp_bwd_dkv_kernel(cidx_ref, ccnt_ref, q_ref, k_ref, v_ref, do_ref,
-                       lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale,
-                       causal, block, seq_len):
-    ki = pl.program_id(2)
+def _sp_bwd_dkv_kernel(cidx_ref, ccnt_ref, qt_hbm, k_ref, v_ref, dot_hbm,
+                       lset_hbm, deltat_hbm, dk_ref, dv_ref, *, sm_scale,
+                       causal, block):
+    """Computes in TRANSPOSED score space (s_t[kr, qr]) so the per-q-row
+    lse/delta broadcast along lanes — their [B, N, 1, S] layout gives
+    128-aligned DMA slices with no in-kernel transposes."""
+    b, n, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    cnt = ccnt_ref[ki]
     k_start = ki * block
-    k = k_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)                 # [block, D]
     v = v_ref[0, 0].astype(jnp.float32)
     d = k.shape[-1]
 
-    def body(t, carry):
-        dk, dv = carry
-        i = cidx_ref[ki, t]
-        q = q_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block, block), :]
-        delta = delta_ref[0, 0, pl.ds(i * block, block), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    def body(qs, dos, ls, dls, qsem, dosem, lsem, dlsem):
+        start, wait = _make_dma_ops(
+            [(qt_hbm, qs, qsem), (dot_hbm, dos, dosem),
+             (lset_hbm, ls, lsem), (deltat_hbm, dls, dlsem)],
+            cidx_ref, ki, b, n, block)
 
-    dk0 = jnp.zeros((block, d), jnp.float32)
-    dv0 = jnp.zeros((block, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, ccnt_ref[ki], body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        @pl.when(cnt > 0)
+        def _warm():
+            start(0, 0)
+
+        def step(t, carry):
+            dk, dv = carry
+            slot = t % 2
+
+            @pl.when(t + 1 < cnt)
+            def _prefetch():
+                start(t + 1, (t + 1) % 2)
+
+            wait(t, slot)
+            i = cidx_ref[ki, t]
+            qt = qs[slot].astype(jnp.float32)           # [D, block]
+            dot_ = dos[slot].astype(jnp.float32)        # [D, block]
+            lse_row = ls[slot]                          # [1, block]
+            delta_row = dls[slot]
+            # s_t[kr, qr] = sum_d k[kr, d] * qt[d, qr]
+            s_t = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32) \
+                * sm_scale
+            if causal:
+                k_pos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                q_pos = i * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s_t = jnp.where(q_pos >= k_pos, s_t, NEG_INF)
+            p_t = jnp.exp(s_t - lse_row)                # [bk, bq]
+            # dv[kr, d] = sum_qr p_t[kr, qr] * dot_[d, qr]
+            dv_new = dv + jax.lax.dot_general(
+                p_t, dot_, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            # dp_t[kr, qr] = sum_d v[kr, d] * dot_[d, qr]
+            dp_t = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+            ds_t = p_t * (dp_t - delta_row) * sm_scale
+            # dk[kr, d] = sum_qr ds_t[kr, qr] * qt[d, qr]
+            dk_new = dk + jax.lax.dot_general(
+                ds_t, qt, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        dk0 = jnp.zeros((block, d), jnp.float32)
+        dv0 = jnp.zeros((block, d), jnp.float32)
+        dk, dv = jax.lax.fori_loop(0, cnt, step, (dk0, dv0))
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        qs=pltpu.VMEM((2, qt_hbm.shape[2], block), qt_hbm.dtype),
+        dos=pltpu.VMEM((2, dot_hbm.shape[2], block), dot_hbm.dtype),
+        ls=pltpu.VMEM((2, 1, block), jnp.float32),
+        dls=pltpu.VMEM((2, 1, block), jnp.float32),
+        qsem=pltpu.SemaphoreType.DMA((2,)),
+        dosem=pltpu.SemaphoreType.DMA((2,)),
+        lsem=pltpu.SemaphoreType.DMA((2,)),
+        dlsem=pltpu.SemaphoreType.DMA((2,)))
 
 
-# --------------------------------------------------------------------------
-# pallas_call plumbing
-# --------------------------------------------------------------------------
-
-def _smem_spec(shape):
-    return pl.BlockSpec(shape, lambda b, n, i: tuple(0 for _ in shape),
-                        memory_space=pltpu.SMEM)
+def _compiler_params():
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel"))
 
 
 def _sp_fwd(q, k, v, idx, cnt, sm_scale, causal, block):
     B, N, S, D = q.shape
-    grid = (B, N, S // block)
-    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, n, i: (b, n, 0, 0),
-                           memory_space=pltpu.VMEM)
+    blk = pl.BlockSpec((1, 1, block, D),
+                       lambda b, n, i, idx_, cnt_: (b, n, i, 0),
+                       memory_space=pltpu.VMEM)
+    hbm = pl.BlockSpec(memory_space=pl.ANY)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, N, S // block),
+        in_specs=[blk, hbm, hbm],
+        out_specs=[
+            blk,
+            pl.BlockSpec((1, 1, block, 1),
+                         lambda b, n, i, idx_, cnt_: (b, n, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
     kernel = functools.partial(_sp_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block=block, seq_len=S)
+                               causal=causal, block=block)
     o, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            _smem_spec(idx.shape), _smem_spec(cnt.shape),
-            pl.BlockSpec((1, 1, block, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            kv_spec, kv_spec,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block, D), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block, 1), lambda b, n, i: (b, n, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, N, S, 1), jnp.float32),
         ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(idx, cnt, q, k, v)
+    )(idx, cnt, q, jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3))
     return o, lse
 
 
@@ -346,37 +483,44 @@ def _sp_bwd(sm_scale, causal, block, adjacency, residuals, g):
     B, N, S, D = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    full = pl.BlockSpec((1, 1, S, D), lambda b, n, i: (b, n, 0, 0),
-                        memory_space=pltpu.VMEM)
-    full_vec = pl.BlockSpec((1, 1, S, 1), lambda b, n, i: (b, n, 0, 0),
-                            memory_space=pltpu.VMEM)
-    blk = pl.BlockSpec((1, 1, block, D), lambda b, n, i: (b, n, i, 0),
+    blk = pl.BlockSpec((1, 1, block, D),
+                       lambda b, n, i, idx_, cnt_: (b, n, i, 0),
                        memory_space=pltpu.VMEM)
-    blk_vec = pl.BlockSpec((1, 1, block, 1), lambda b, n, i: (b, n, i, 0),
+    blk_vec = pl.BlockSpec((1, 1, block, 1),
+                           lambda b, n, i, idx_, cnt_: (b, n, i, 0),
                            memory_space=pltpu.VMEM)
+    hbm = pl.BlockSpec(memory_space=pl.ANY)
 
     dq = pl.pallas_call(
         functools.partial(_sp_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block=block, seq_len=S),
-        grid=(B, N, S // block),
-        in_specs=[_smem_spec(idx.shape), _smem_spec(cnt.shape),
-                  blk, full, full, blk, blk_vec, blk_vec],
-        out_specs=blk,
+                          block=block),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, N, S // block),
+            in_specs=[blk, hbm, hbm, blk, blk_vec, blk_vec],
+            out_specs=blk),
         out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(idx, cnt, q, k, v, do, lse, delta)
+    )(idx, cnt, q, jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3), do, lse,
+      delta)
 
+    # dK/dV pass: the grid's block index is a K block; Q/dO/lse/delta are
+    # DMA'd per listed row of the TRANSPOSED adjacency (cidx)
     dk, dv = pl.pallas_call(
         functools.partial(_sp_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block=block, seq_len=S),
-        grid=(B, N, S // block),
-        in_specs=[_smem_spec(cidx.shape), _smem_spec(ccnt.shape),
-                  full, blk, blk, full, full_vec, full_vec],
-        out_specs=[blk, blk],
+                          causal=causal, block=block),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, N, S // block),
+            in_specs=[hbm, blk, blk, hbm, hbm, hbm],
+            out_specs=[blk, blk]),
         out_shape=[jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
                    jax.ShapeDtypeStruct((B, N, S, D), q.dtype)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(cidx, ccnt, q, k, v, do, lse, delta)
+    )(cidx, ccnt, jnp.swapaxes(q, 2, 3), k, v, jnp.swapaxes(do, 2, 3),
+      jnp.swapaxes(lse, 2, 3), jnp.swapaxes(delta, 2, 3))
     return dq, dk, dv
 
 
