@@ -226,9 +226,6 @@ def _make_dma_ops(streams, idx_ref, row, b, n, block):
     return start, wait
 
 
-
-
-
 def _sp_fwd_kernel(idx_ref, cnt_ref, q_ref, kt_hbm, vt_hbm, o_ref, lse_ref,
                    *, sm_scale, causal, block):
     b, n, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
