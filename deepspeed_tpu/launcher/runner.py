@@ -66,20 +66,31 @@ def parse_inclusion_exclusion(hosts: Dict[str, int], include: str,
 def build_ssh_commands(hosts: Dict[str, int], script_cmd: List[str],
                        master_addr: str = None,
                        port: int = DEFAULT_COORD_PORT,
-                       export_envs: Dict[str, str] = None) -> List[List[str]]:
-    """One ssh command per host with the rendezvous env baked in."""
+                       export_envs: Dict[str, str] = None,
+                       use_agent: bool = True) -> List[List[str]]:
+    """One ssh command per host. With use_agent (default), each host runs
+    the per-node launch agent (launcher/launch.py — jax.distributed env
+    wiring + signal handling + process-tree kill); the raw env-prefix form
+    remains for minimal targets without the package installed."""
     hostnames = list(hosts)
     master = master_addr or hostnames[0]
     cmds = []
     for pid, host in enumerate(hostnames):
         envs = {
+            # the single source of truth: the agent and comm both read these
             "COORDINATOR_ADDRESS": f"{master}:{port}",
             "NUM_PROCESSES": str(len(hostnames)),
             "PROCESS_ID": str(pid),
         }
         envs.update(export_envs or {})
         env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in envs.items())
-        remote = f"cd {shlex.quote(os.getcwd())} && {env_str} {' '.join(map(shlex.quote, script_cmd))}"
+        if use_agent:
+            agent = (f"{sys.executable} -m deepspeed_tpu.launcher.launch "
+                     f"-- {' '.join(map(shlex.quote, script_cmd))}")
+            remote = f"cd {shlex.quote(os.getcwd())} && {env_str} {agent}"
+        else:
+            remote = (f"cd {shlex.quote(os.getcwd())} && {env_str} "
+                      f"{' '.join(map(shlex.quote, script_cmd))}")
         cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])
     return cmds
 
@@ -116,6 +127,10 @@ def main(argv=None):
     parser.add_argument("--zone", default=None, help="gcloud zone")
     parser.add_argument("--dry_run", action="store_true",
                         help="print the launch commands without executing")
+    parser.add_argument("--no_agent", action="store_true",
+                        help="skip the per-node launch agent (raw env-prefix "
+                             "ssh — for hosts without deepspeed_tpu "
+                             "installed)")
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -141,7 +156,8 @@ def main(argv=None):
         return subprocess.call(script_cmd)
 
     cmds = build_ssh_commands(hosts, script_cmd, args.master_addr,
-                              args.master_port, _read_ds_env())
+                              args.master_port, _read_ds_env(),
+                              use_agent=not args.no_agent)
     if args.dry_run:
         for c in cmds:
             print(" ".join(map(shlex.quote, c)))

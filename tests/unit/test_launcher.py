@@ -1,0 +1,112 @@
+"""Launcher tests (reference: tests/unit/launcher/test_multinode_runner.py
+asserts command construction; launch.py behavior is exercised with real
+subprocesses here)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import LaunchAgent, build_child_env
+from deepspeed_tpu.launcher.runner import (build_ssh_commands, fetch_hostfile,
+                                           parse_inclusion_exclusion)
+
+WORLD = {"coordinator": "10.0.0.1:1234", "num_nodes": 4}
+
+
+class TestLaunchAgent:
+    def test_env_wiring_standalone(self):
+        env = build_child_env(WORLD, 2, base_env={})
+        # the names comm.init_distributed actually reads
+        assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:1234"
+        assert env["NUM_PROCESSES"] == "4"
+        assert env["PROCESS_ID"] == "2"
+        # torch-style aliases
+        assert env["RANK"] == "2" and env["WORLD_SIZE"] == "4"
+        assert env["MASTER_ADDR"] == "10.0.0.1"
+        assert env["MASTER_PORT"] == "1234"
+
+    def test_env_passthrough_from_runner(self):
+        # the runner's env prefix is the source of truth: no world_info
+        base = {"COORDINATOR_ADDRESS": "h:9", "NUM_PROCESSES": "2",
+                "PROCESS_ID": "1"}
+        env = build_child_env(base_env=base)
+        assert env["COORDINATOR_ADDRESS"] == "h:9"
+        assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+
+    def test_bad_world_info_is_argument_error(self):
+        from deepspeed_tpu.launcher.launch import _parse_world_info
+        import argparse
+        with pytest.raises(argparse.ArgumentTypeError, match="world_info"):
+            _parse_world_info("coordinator=h:8476")
+
+    def test_child_sees_env_and_rc_passthrough(self, tmp_path):
+        script = tmp_path / "child.py"
+        script.write_text(
+            "import os, sys, json\n"
+            "print(json.dumps({k: os.environ[k] for k in "
+            "('PROCESS_ID', 'NUM_PROCESSES')}))\n"
+            "sys.exit(7)\n")
+        out = tmp_path / "out.txt"
+        agent = LaunchAgent(
+            [sys.executable, str(script)], WORLD, 1)
+        # capture stdout via redirection child-side is overkill; re-spawn
+        # through the agent and read rc only, then verify env separately
+        rc = agent.run()
+        assert rc == 7
+        env = build_child_env(WORLD, 1)
+        got = subprocess.run([sys.executable, str(script)], env=env,
+                             capture_output=True, text=True)
+        assert json.loads(got.stdout) == {"PROCESS_ID": "1",
+                                          "NUM_PROCESSES": "4"}
+
+    def test_signal_kills_process_group(self, tmp_path):
+        """A SIGTERM to the agent tears down a child that spawns its own
+        subprocess AND ignores SIGTERM (the kill-escalation path,
+        reference launch.py:103)."""
+        script = tmp_path / "stubborn.py"
+        script.write_text(
+            "import signal, subprocess, sys, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "subprocess.Popen([sys.executable, '-c', "
+            "'import time; time.sleep(60)'])\n"
+            "time.sleep(60)\n")
+        agent = LaunchAgent([sys.executable, str(script)], WORLD, 0,
+                            kill_grace_s=0.5)
+        t0 = time.time()
+
+        def fire():
+            time.sleep(0.8)  # let the child start
+            agent._forward_signal(signal.SIGTERM, None)
+
+        threading.Thread(target=fire, daemon=True).start()
+        rc = agent.run()
+        assert time.time() - t0 < 20
+        assert rc != 0  # killed, not a clean exit
+
+
+class TestRunnerCommands:
+    def test_hostfile_and_ssh_cmds(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("hostA slots=4\nhostB slots=4\n# comment\n")
+        hosts = fetch_hostfile(str(hf))
+        assert hosts == {"hostA": 4, "hostB": 4}
+        hosts = parse_inclusion_exclusion(hosts, include="", exclude="hostB")
+        assert list(hosts) == ["hostA"]
+        cmds = build_ssh_commands({"hostA": 4, "hostB": 4},
+                                  ["python", "train.py"])
+        assert len(cmds) == 2
+        assert cmds[0][0] == "ssh" and "hostA" in cmds[0]
+        # the remote command routes through the per-node launch agent,
+        # with the rendezvous carried ONLY by the env prefix
+        assert "deepspeed_tpu.launcher.launch" in cmds[0][-1]
+        assert "PROCESS_ID=1" in cmds[1][-1]
+        assert "world_info" not in cmds[0][-1]
+        raw = build_ssh_commands({"hostA": 4}, ["python", "t.py"],
+                                 use_agent=False)
+        assert "launcher.launch" not in raw[0][-1]
