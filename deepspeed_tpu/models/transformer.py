@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 Params = Dict[str, Any]
 
@@ -309,6 +310,45 @@ def logical_axes(cfg: TransformerConfig) -> Params:
 # --------------------------------------------------------------------------
 # building blocks
 # --------------------------------------------------------------------------
+
+def _constrain_batch_axes(x):
+    """Pin an activation [B, S, ...] to the canonical batch-sharded layout.
+
+    The embedding gather reads a vocab/embed-sharded table, and without a
+    constraint GSPMD propagates the *weight's* sharding onto the activation —
+    the layer-scan carry then runs layernorm on a hidden-sharded tensor and
+    SPMD falls back to full rematerialization resharding it for attention
+    ("Involuntary full rematerialization", spmd_partitioner.cc). One
+    constraint at the model boundary keeps every downstream activation
+    batch-sharded; weights stay fsdp/tensor-sharded and XLA inserts the
+    all-gathers on use (the ZeRO-3 contract).
+
+    No-op outside a mesh context, on 1-device meshes, and inside shard_map
+    bodies (manual axes see per-shard views the constraint must not touch).
+    """
+    try:
+        from jax._src import mesh as _mesh_lib
+        env_mesh = _mesh_lib.thread_resources.env.physical_mesh
+    except Exception:
+        return x
+    if env_mesh is None or env_mesh.empty or env_mesh.size == 1:
+        return x
+    try:
+        from jax.sharding import AxisType, get_abstract_mesh
+        am = get_abstract_mesh()
+        if am.axis_names and any(t is AxisType.Manual
+                                 for t in getattr(am, "axis_types", ())):
+            return x
+    except Exception:
+        pass
+    from deepspeed_tpu.parallel.mesh import BATCH_AXES
+    shape = dict(env_mesh.shape)
+    batch = tuple(a for a in BATCH_AXES if shape.get(a, 1) > 1)
+    if not batch:
+        return x
+    seq_ax = "seq" if shape.get("seq", 1) > 1 else None
+    return jax.lax.with_sharding_constraint(x, P(batch, seq_ax))
+
 
 def _norm(x, scale, bias, cfg: TransformerConfig):
     x32 = x.astype(jnp.float32)
@@ -676,6 +716,7 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     if cfg.embed_norm:
         x = _norm(x, params["embed_norm_scale"],
                   params.get("embed_norm_bias"), cfg)
+    x = _constrain_batch_axes(x)
 
     layers = layer_override if layer_override is not None else params["layers"]
 
@@ -784,6 +825,22 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     return logits
 
 
+def _gold_logit(logits, safe_labels):
+    """logits[..., safe_labels] via a one-hot contraction, not a gather.
+
+    take_along_axis differentiates to a scatter-add, which XLA SPMD cannot
+    partition when the vocab axis is tensor-sharded — it replicates the full
+    [B,S,V] f32 tensor every step ("Involuntary full rematerialization").
+    The one-hot masked reduction keeps the contraction local to each vocab
+    shard (each chip sums its chunk, SPMD inserts one psum of [B,S]), and its
+    transpose is a broadcast-multiply, which shards cleanly. Exact for f32:
+    the mask selects a single element, no summation error.
+    """
+    iota = lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = (iota == safe_labels[..., None]).astype(logits.dtype)
+    return jnp.sum(logits * onehot, axis=-1)
+
+
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     """Mean next-token CE. logits [B,S,V] fp32; labels [B,S] (already aligned —
     caller shifts, or pass input_ids as labels and we shift here via
@@ -792,7 +849,7 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0)
     logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    gold = _gold_logit(logits, safe_labels)
     nll = (logz - gold) * valid
     return nll.sum() / jnp.maximum(valid.sum(), 1)
 
@@ -920,7 +977,7 @@ def chunked_cross_entropy(x, head, labels, chunk: int,
         valid = lc != ignore_index
         safe = jnp.where(valid, lc, 0)
         logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        gold = _gold_logit(logits, safe)
         nll = (logz - gold) * valid
         return (tot + nll.sum(), cnt + valid.sum()), None
 

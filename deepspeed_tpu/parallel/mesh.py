@@ -30,6 +30,10 @@ from deepspeed_tpu.utils.logging import logger
 # the slowest links (DCN) and tensor innermost so TP rides fastest ICI links.
 AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
 
+# the axes that together carry the global batch dim (engine._batch_spec and
+# the model-side activation constraint must agree on this set)
+BATCH_AXES = ("data", "fsdp", "expert")
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:

@@ -758,9 +758,10 @@ class Engine:
     def _batch_spec(self):
         # expert groups consume distinct data (expert-data-parallelism);
         # sequence dim shards over `seq` when sequence parallelism is on
+        from deepspeed_tpu.parallel.mesh import BATCH_AXES
         if self.plan.seq > 1:
-            return P(("data", "fsdp", "expert"), "seq")
-        return P(("data", "fsdp", "expert"))
+            return P(BATCH_AXES, "seq")
+        return P(BATCH_AXES)
 
     @staticmethod
     def _accum_micro_grads(micro_fn, params, batch, gas: int, rng,

@@ -1,0 +1,85 @@
+"""The multi-chip program must compile replication-free.
+
+Reference counterpart: DeepSpeed has no compiler warning to watch — its
+failure mode is silently-added collectives. Here XLA SPMD tells us when it
+falls back to replicating a tensor ("Involuntary full rematerialization"):
+at real shapes that is an activation-sized all-to-all in the hot loop, so we
+treat the warning as an error. Guards VERDICT r3 weakness #1 (the
+take_along_axis scatter-add in the loss path, models/transformer.py) and any
+future sharding regression.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.models.transformer import _gold_logit, cross_entropy_loss
+from deepspeed_tpu.utils.hlo_check import (assert_no_spmd_replication,
+                                           capture_spmd_warnings)
+
+
+def test_gold_logit_matches_gather():
+    # the one-hot contraction must be numerically identical to the gather
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 16, 64)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 64, size=(4, 16)), jnp.int32)
+    want = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    got = _gold_logit(logits, labels)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_cross_entropy_ignore_index_unchanged():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 8, 32)).astype(np.float32))
+    labels = np.asarray(rng.integers(0, 32, size=(2, 8)), np.int32)
+    labels[0, :4] = -100
+    loss = cross_entropy_loss(logits, jnp.asarray(labels))
+    # hand-computed reference
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    want, n = 0.0, 0
+    for b in range(2):
+        for s in range(8):
+            if labels[b, s] != -100:
+                want -= float(lp[b, s, labels[b, s]])
+                n += 1
+    np.testing.assert_allclose(float(loss), want / n, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mesh_axes", [{"fsdp": 4, "tensor": 2},
+                                       {"data": 8}])
+def test_train_step_compiles_without_spmd_replication(mesh_axes, devices8):
+    """fsdp x tensor (and pure-dp) train steps: zero SPMD fallback warnings."""
+    devices = devices8
+    dp = mesh_axes.get("fsdp", 1) * mesh_axes.get("data", 1)
+    model = make_model(TransformerConfig(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        max_seq_len=128, dtype=jnp.float32, attention_impl="xla"),
+        name="spmd-clean")
+    config = {
+        "train_batch_size": 2 * dp * 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 0},
+        "mesh": {"axes": mesh_axes},
+        "gradient_clipping": 1.0,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=config,
+                                          devices=list(devices))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 512, size=(config["train_batch_size"], 128), dtype=np.int32)}
+    metrics = assert_no_spmd_replication(engine.train_batch, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_capture_helper_sees_fd2_writes():
+    # the helper must actually capture C-level fd-2 writes, not just sys.stderr
+    import os
+    matches = []
+    with capture_spmd_warnings(matches):
+        os.write(2, b"[SPMD] Involuntary full rematerialization test line\n")
+    assert len(matches) == 1
