@@ -141,11 +141,16 @@ class Engine:
         self.mesh: Mesh = mesh if mesh is not None else build_mesh(self.plan, devices)
         from deepspeed_tpu.parallel.context import set_parallel_context
         set_parallel_context(self.mesh, self.plan)
-        # ZeRO-Infinity layer streaming is a single-device executor: its
-        # batch triad resolves against dp=1 regardless of the test harness's
-        # virtual device count
+        # ZeRO-Infinity layer streaming: with an explicit mesh it composes
+        # with data/fsdp parallelism (batch triad resolves against the full
+        # dp degree); with no mesh config it stays the legacy single-device
+        # capacity executor regardless of the harness's device count
+        self._infinity_multi = (_infinity_mode(config)
+                                and bool(config.mesh.axes)
+                                and self.plan.world_size > 1)
         config.resolve_batch_size(
-            1 if _infinity_mode(config) else self.plan.dp_world_size)
+            self.plan.dp_world_size
+            if (not _infinity_mode(config) or self._infinity_multi) else 1)
         logger.info(zero_mod.describe(config.zero_optimization, self.plan))
         logger.info(f"batch: train={config.train_batch_size} "
                     f"micro={config.train_micro_batch_size_per_gpu} "
@@ -280,15 +285,29 @@ class Engine:
                         "offload_param.device=nvme pairs with "
                         "offload_optimizer.device=nvme (the executor streams "
                         "param AND optimizer chunks per layer)")
-            if self.plan.world_size > 1:
+            if self._infinity_multi:
+                # offload composed with data/fsdp parallelism (reference:
+                # ZeRO-3 + NVMe at 512 GPUs, stage3.py:65): layer chunks
+                # shard over fsdp, batch over (data, fsdp)
+                if (self.plan.tensor > 1 or self.plan.pipe > 1
+                        or self.plan.seq > 1 or self.plan.expert > 1):
+                    raise ValueError(
+                        "layer-streamed offload shards over data/fsdp only "
+                        "(tensor/pipe/seq/expert must be 1)")
+            elif self.plan.world_size > 1:
                 if get_accelerator().platform == "cpu":
-                    # CPU test harness (8 virtual devices): the executor's
-                    # unsharded jits run on the default device
-                    logger.warning("the layer-streamed executor is single-"
-                                   "device; running on device 0")
+                    # CPU test harness: single-device executor is fine
+                    logger.warning(
+                        "the layer-streamed executor runs single-device "
+                        "without an explicit mesh config; set mesh.axes "
+                        "{data/fsdp} to shard it")
                 else:
-                    raise ValueError("the layer-streamed executor requires a "
-                                     "single-device mesh in this version")
+                    # on real multi-chip hardware silently training on one
+                    # chip (with 7 idle) is never what the user configured
+                    raise ValueError(
+                        "multi-device layer-streamed offload requires an "
+                        "explicit mesh config: set mesh.axes {'data': N} "
+                        "and/or {'fsdp': N}")
             if self._pp_mode:
                 raise ValueError("layer-streamed offload with pipeline "
                                  "parallelism is not supported")
@@ -697,7 +716,8 @@ class Engine:
             bias_correction=p.get("bias_correction", True),
             grad_clip=cfg.gradient_clipping or 0.0,
             param_cache_bytes=off_p.max_in_cpu,
-            gas=cfg.gradient_accumulation_steps)
+            gas=cfg.gradient_accumulation_steps,
+            mesh=self.mesh if self._infinity_multi else None)
 
     def _state_shardings_from(self, state_shapes):
         """Build shardings for the full train-state pytree: params use

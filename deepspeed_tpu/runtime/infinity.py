@@ -69,7 +69,10 @@ class LayerStore:
         self.chunk = chunk_elems
         self.backend = backend
         self._host: Dict[str, Any] = {}
-        self._host_sh = host_sharding  # pinned backend: pinned_host sharding
+        # pinned backend: per-kind pinned_host shardings ({"param": ...,
+        # "opt": ...}) — on a multi-device mesh each device pins only its
+        # fsdp shard of the chunk
+        self._host_sh = host_sharding
         self._aio_r = self._aio_w = None
         self._dir = None
         if backend == "nvme":
@@ -100,7 +103,9 @@ class LayerStore:
         if self.backend == "pinned":
             # eager DMA into TPU-host pinned DRAM (async dispatch); the
             # handle is the storage
-            self._host[self._key(kind, i)] = jax.device_put(arr, self._host_sh)
+            sh = self._host_sh[kind] if isinstance(self._host_sh, dict) \
+                else self._host_sh
+            self._host[self._key(kind, i)] = jax.device_put(arr, sh)
         elif self.backend == "host":
             self._host[self._key(kind, i)] = np.ascontiguousarray(arr).copy()
         elif self._aio_w is not None:
@@ -144,7 +149,20 @@ class LayerStore:
         for f in os.listdir(self._dir):
             shutil.copyfile(os.path.join(self._dir, f), os.path.join(dst, f))
 
-    def load_from(self, src: str):
+    def load_from(self, src: str, saved_chunk: Optional[int] = None):
+        """Restore chunks. `saved_chunk` (from the shapes manifest) may
+        differ from self.chunk when the fsdp degree changed between save and
+        load — chunks are zero-padded past the real layer numel, so
+        re-chunking is a truncate-or-pad of the pad region."""
+        saved = saved_chunk or self.chunk
+
+        def rechunk(plane):
+            if saved == self.chunk:
+                return plane
+            if saved > self.chunk:
+                return np.ascontiguousarray(plane[:self.chunk])
+            return np.pad(plane, (0, self.chunk - saved))
+
         for f in os.listdir(src):
             if not f.endswith(".bin"):
                 continue
@@ -152,7 +170,10 @@ class LayerStore:
             dtype = np.uint16 if kind == "param" else np.float32
             arr = np.fromfile(os.path.join(src, f), dtype)
             if kind == "opt":
-                arr = arr.reshape(_PLANES, self.chunk)
+                arr = np.stack([rechunk(p)
+                                for p in arr.reshape(_PLANES, saved)])
+            else:
+                arr = rechunk(arr)
             self._write(kind, int(i), arr)
 
     def close(self):
@@ -174,7 +195,7 @@ class InfinityExecutor:
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
                  bias_correction: bool = True, grad_clip: float = 0.0,
                  backend: str = "nvme", param_cache_bytes: int = 0,
-                 gas: int = 1):
+                 gas: int = 1, mesh=None):
         if model_cfg.num_experts > 1:
             raise ValueError("offload_param.device=nvme supports dense "
                              "transformers (MoE experts not yet streamed)")
@@ -200,17 +221,52 @@ class InfinityExecutor:
         self._shapes = [l.shape[1:] for l in self._leaves]   # drop L=1 dim
         self._sizes = [int(np.prod(s)) for s in self._shapes]
         numel = sum(self._sizes)
-        self.chunk = ((numel + 127) // 128) * 128
+        self._pinned = backend == "pinned"
+
+        # --- mesh: offload composes with data/fsdp parallelism (reference:
+        # ZeRO-3 + NVMe at 512 GPUs, stage3.py:65 + partitioned_param_
+        # swapper.py:35). Layer chunks shard over `fsdp` (each device stages
+        # only its shard; one all-gather on use = the ZeRO-3 fetch); batch
+        # shards over (data, fsdp); grads reduce-scatter back to `fsdp`; the
+        # fused Adam sweep is fully shard-local.
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        if mesh is not None and mesh.size > 1:
+            self.mesh = mesh
+        else:
+            dev = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+            self.mesh = Mesh(np.asarray([dev]).reshape(1, 1),
+                             ("data", "fsdp"))
+        mesh_shape = dict(self.mesh.shape)
+        for ax in ("tensor", "pipe", "seq", "expert"):
+            if mesh_shape.get(ax, 1) > 1:
+                raise ValueError(f"layer-streamed offload shards over "
+                                 f"data/fsdp only; mesh axis '{ax}' > 1")
+        self._F = mesh_shape.get("fsdp", 1)
+        self.dp = self._F * mesh_shape.get("data", 1)
+        self._batch_axes = tuple(a for a in ("data", "fsdp")
+                                 if a in mesh_shape)
+        self._x_spec = P(self._batch_axes)
+        self._bits_spec = P("fsdp")
+        self._opt_spec = P(None, "fsdp")
+        self._x_sh = NamedSharding(self.mesh, self._x_spec)
+        self._bits_dev_sh = NamedSharding(self.mesh, self._bits_spec)
+        self._opt_dev_sh = NamedSharding(self.mesh, self._opt_spec)
+        self._repl_dev_sh = NamedSharding(self.mesh, P())
+        self._bits_host_sh = NamedSharding(self.mesh, self._bits_spec,
+                                           memory_kind="pinned_host")
+        self._opt_host_sh = NamedSharding(self.mesh, self._opt_spec,
+                                          memory_kind="pinned_host")
+        self._repl_host_sh = NamedSharding(self.mesh, P(),
+                                           memory_kind="pinned_host")
+
+        # chunk rounded so every fsdp shard is lane-aligned
+        align = 128 * self._F
+        self.chunk = ((numel + align - 1) // align) * align
         self.layer_params = numel
         self.num_params = L * numel
-        self._pinned = backend == "pinned"
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        dev = jax.devices()[0]
-        m1 = Mesh(np.array([dev]), ("_inf",))
-        self._host_sh = NamedSharding(m1, P(), memory_kind="pinned_host")
-        self._dev_sh = NamedSharding(m1, P(), memory_kind="device")
         self.store = LayerStore(nvme_path, L, self.chunk, backend=backend,
-                                host_sharding=self._host_sh)
+                                host_sharding={"param": self._bits_host_sh,
+                                               "opt": self._opt_host_sh})
         self._pool = ThreadPoolExecutor(max_workers=2)
         self._pending_write = None
         # host bf16-bits cache of param chunks (fast refetch for bwd/next
@@ -239,12 +295,25 @@ class InfinityExecutor:
         chunk = self.chunk
         b1, b2, eps = self.b1, self.b2, self.eps
         wd, awm, bc = self.wd, self.awm, self.bc
+        multi = self.mesh.size > 1
+        x_spec, bits_spec, opt_spec = (self._x_spec, self._bits_spec,
+                                       self._opt_spec)
+        from jax.sharding import PartitionSpec as P
         from deepspeed_tpu.models.transformer import (
             _norm, transformer_layer, chunked_cross_entropy)
+
+        def wsc(t, spec):
+            # constraints are what make the multi-device program ZeRO-3:
+            # bits replicate (one all-gather) at use, grads land fsdp-sharded
+            # (reduce-scatter), activations stay batch-sharded
+            return jax.lax.with_sharding_constraint(t, spec) if multi else t
 
         def unflatten(flat_bits):
             """uint16 bf16-bits (C,) -> layer param pytree (compute dtype)."""
             flat = jax.lax.bitcast_convert_type(flat_bits, jnp.bfloat16)
+            # one explicit all-gather of the bf16 chunk (the ZeRO-3 fetch);
+            # without it every dynamic_slice below would gather separately
+            flat = wsc(flat, P())
             flat = flat.astype(cfg.dtype)
             out, off = [], 0
             for size, shape in zip(sizes, shapes):
@@ -258,7 +327,7 @@ class InfinityExecutor:
             y, _aux = transformer_layer(x, p, cfg, mask=mask,
                                         positions=positions,
                                         deterministic=True)
-            return y
+            return wsc(y, x_spec)
 
         self._layer_fwd = jax.jit(layer_fwd)
 
@@ -277,10 +346,13 @@ class InfinityExecutor:
                                             positions=positions,
                                             deterministic=True)
                 return y
-            flat32 = jax.lax.bitcast_convert_type(
-                flat_bits, jnp.bfloat16).astype(jnp.float32)
+            flat32 = wsc(jax.lax.bitcast_convert_type(
+                flat_bits, jnp.bfloat16), P()).astype(jnp.float32)
             _, vjp = jax.vjp(f, flat32, x)
             dp, dx = vjp(dy)
+            # batch-sum cotangent reduce-scatters onto the fsdp shards
+            dp = wsc(dp, bits_spec)
+            dx = wsc(dx, x_spec)
             return dp, dx, jnp.sum(dp.astype(jnp.float32) ** 2)
 
         self._layer_bwd = jax.jit(layer_bwd)
@@ -293,7 +365,7 @@ class InfinityExecutor:
             if cfg.embed_norm:
                 x = _norm(x, nl["embed_norm_scale"],
                           nl.get("embed_norm_bias"), cfg)
-            return x
+            return wsc(x, x_spec)
 
         def top_loss(nl, x, labels):
             h = _norm(x, nl["final_norm_scale"], nl.get("final_norm_bias"),
@@ -307,7 +379,7 @@ class InfinityExecutor:
         def top_fwd_bwd(nl, x, labels):
             (loss, (dnl, dx)) = jax.value_and_grad(
                 top_loss, argnums=(0, 1))(nl, x, labels)
-            return loss, dnl, dx
+            return loss, dnl, wsc(dx, x_spec)
 
         self._top_fwd_bwd = jax.jit(top_fwd_bwd)
         self._top_loss = jax.jit(top_loss)
@@ -358,6 +430,10 @@ class InfinityExecutor:
             return jnp.stack([master, m, v]), new_bits
 
         self._adam_chunk = jax.jit(adam_chunk, donate_argnums=(0,))
+        # lazily-initialized opt chunk, born with the right fsdp sharding
+        self._zeros_opt = jax.jit(
+            lambda: jnp.zeros((_PLANES, chunk), jnp.float32),
+            out_shardings=self._opt_dev_sh)
 
     # ------------------------------------------------------------------
     def _init_params(self, rng):
@@ -382,7 +458,7 @@ class InfinityExecutor:
             flat = jnp.pad(flat, (0, self.chunk - flat.shape[0]))
             return jax.lax.bitcast_convert_type(flat, jnp.uint16)
 
-        one_layer = jax.jit(one_layer)
+        one_layer = jax.jit(one_layer, out_shardings=self._bits_dev_sh)
         keys = jax.random.split(jax.random.fold_in(rng, 17), L + 1)
         for i in range(L):
             bits = one_layer(keys[i])
@@ -398,7 +474,8 @@ class InfinityExecutor:
             return {k: jax.tree.map(lambda a: a.astype(cfg.dtype), v)
                     for k, v in full.items() if k != "layers"}
 
-        self.nl_params = jax.jit(nl_init)(keys[L])
+        self.nl_params = jax.jit(nl_init,
+                                 out_shardings=self._repl_dev_sh)(keys[L])
         self.nl_opt = jax.tree.map(
             lambda p: {"master": p.astype(jnp.float32),
                        "m": jnp.zeros(p.shape, jnp.float32),
@@ -407,7 +484,9 @@ class InfinityExecutor:
         if self._pinned:
             # embed/head fp32 state (12 bytes/param — GBs at 7B vocab+width)
             # lives on the host tier too
-            self.nl_opt = jax.device_put(self.nl_opt, self._host_sh)
+            self.nl_opt = jax.device_put(self.nl_opt, self._repl_host_sh)
+        elif self.mesh.size > 1:
+            self.nl_opt = jax.device_put(self.nl_opt, self._repl_dev_sh)
 
         def nl_adam(opt, grads, params, lr_t, step, coef):
             b1, b2, eps = self.b1, self.b2, self.eps
@@ -457,10 +536,12 @@ class InfinityExecutor:
     def _param_dev(self, i: int):
         """Device handle for layer i's param bits. Pinned backend: eager
         pinned_host->HBM DMA (async dispatch — issuing it a layer ahead IS
-        the prefetch). File backends: host numpy (the jit call uploads)."""
+        the prefetch). File backends: host numpy (the jit call uploads;
+        multi-device meshes shard the upload so each chip receives only its
+        fsdp slice)."""
         h = self._get_param(i)
-        if self._pinned:
-            return jax.device_put(h, self._dev_sh)
+        if self._pinned or self.mesh.size > 1:
+            return jax.device_put(h, self._bits_dev_sh)
         return h
 
     def _fetch_param_async(self, i: int):
@@ -473,17 +554,22 @@ class InfinityExecutor:
     def _resolve_param(self, fut, i: int):
         if self._pinned:
             return fut if fut is not None else self._param_dev(i)
-        return fut.result() if fut is not None else self._get_param(i)
+        h = fut.result() if fut is not None else self._get_param(i)
+        if self.mesh.size > 1:
+            # sharded upload: each chip receives only its fsdp slice (the
+            # in-graph all-gather redistributes over ICI, not host links)
+            return jax.device_put(h, self._bits_dev_sh)
+        return h
 
-    def _to_host(self, x_dev):
+    def _to_host(self, x_dev, host_sh=None):
         """Stage a device array on the TPU host (pinned) or here (numpy)."""
         if self._pinned:
-            return jax.device_put(x_dev, self._host_sh)
+            return jax.device_put(x_dev, host_sh or self._bits_host_sh)
         return np.asarray(jax.device_get(x_dev))
 
-    def _to_dev(self, h):
-        if self._pinned:
-            return jax.device_put(h, self._dev_sh)
+    def _to_dev(self, h, dev_sh=None):
+        if self._pinned or self.mesh.size > 1:
+            return jax.device_put(h, dev_sh or self._bits_dev_sh)
         return jnp.asarray(h)
 
     def _drain_write(self):
@@ -523,11 +609,26 @@ class InfinityExecutor:
         mask = batch.get("attention_mask")
         if mask is not None:
             mask = jnp.asarray(mask)
+        if self.mesh.size > 1:
+            mb = ids.shape[0] // self.gas if self.gas > 1 else ids.shape[0]
+            if mb % self.dp:
+                raise ValueError(
+                    f"microbatch {mb} not divisible by data*fsdp={self.dp}")
+            ids = jax.device_put(ids, self._x_sh)
+            labels = jax.device_put(labels, self._x_sh)
+            if mask is not None:
+                mask = jax.device_put(mask, self._x_sh)
         return ids, labels, mask
 
     def train_batch(self, batch) -> Dict[str, Any]:
         """One optimizer step: forward/backward sweeps over the layer files,
-        host-staged grads, global-norm clip, fused-Adam update sweep."""
+        host-staged grads, global-norm clip, fused-Adam update sweep. The
+        mesh context makes the jits' sharding constraints resolvable
+        (no-op on the 1-device mesh)."""
+        with self.mesh:
+            return self._train_batch(batch)
+
+    def _train_batch(self, batch) -> Dict[str, Any]:
         L = self.cfg.num_layers
         ids_all, labels_all, mask_all = self._batch_arrays(batch)
         B = ids_all.shape[0]
@@ -617,11 +718,11 @@ class InfinityExecutor:
 
         # non-layer (embed/head) update first: frees its fp32 grads before
         # the layer sweep's chunk buffers arrive
-        nl_opt_dev = (jax.device_put(self.nl_opt, self._dev_sh)
+        nl_opt_dev = (jax.device_put(self.nl_opt, self._repl_dev_sh)
                       if self._pinned else self.nl_opt)
         new_nl_opt, self.nl_params = self._nl_adam(
             nl_opt_dev, nl_grads, self.nl_params, lr_t, stepc, coef_t)
-        self.nl_opt = (jax.device_put(new_nl_opt, self._host_sh)
+        self.nl_opt = (jax.device_put(new_nl_opt, self._repl_host_sh)
                        if self._pinned else new_nl_opt)
         del nl_grads
 
@@ -633,8 +734,8 @@ class InfinityExecutor:
                 opt_fut = (self.store.read_opt(i + 1) if self._pinned
                            else self._pool.submit(self.store.read_opt, i + 1))
             have = opt_host is not None
-            opt_dev = (self._to_dev(opt_host) if have
-                       else jnp.zeros((_PLANES, self.chunk), jnp.float32))
+            opt_dev = (self._to_dev(opt_host, self._opt_dev_sh) if have
+                       else self._zeros_opt())
             new_buf, new_bits = self._adam_chunk(
                 opt_dev, self._to_dev(grad_stage[i]), self._param_dev(i),
                 jnp.asarray(have), lr_t, stepc, coef_t)
@@ -656,14 +757,15 @@ class InfinityExecutor:
 
     def eval_batch(self, batch):
         L = self.cfg.num_layers
-        ids, labels, mask = self._batch_arrays(batch)
-        x = self._embed_fwd(self.nl_params, ids)
-        fut = self._fetch_param_async(0)
-        for i in range(L):
-            bits = self._resolve_param(fut, i)
-            fut = self._fetch_param_async(i + 1) if i + 1 < L else None
-            x = self._layer_fwd(bits, x, mask, None)
-        return self._top_loss(self.nl_params, x, labels)
+        with self.mesh:
+            ids, labels, mask = self._batch_arrays(batch)
+            x = self._embed_fwd(self.nl_params, ids)
+            fut = self._fetch_param_async(0)
+            for i in range(L):
+                bits = self._resolve_param(fut, i)
+                fut = self._fetch_param_async(i + 1) if i + 1 < L else None
+                x = self._layer_fwd(bits, x, mask, None)
+            return self._top_loss(self.nl_params, x, labels)
 
     # ------------------------------------------------------------------
     def save_checkpoint(self, path: str) -> Dict[str, Any]:
@@ -687,12 +789,29 @@ class InfinityExecutor:
                 "applied_steps": self.applied_steps}
 
     def load_checkpoint(self, path: str, small_state: Dict[str, Any]):
-        self.store.load_from(os.path.join(path, "infinity_chunks"))
+        import json as _json
+        saved_chunk = None
+        manifest = os.path.join(path, "infinity_shapes.json")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                meta = _json.load(f)
+            saved_chunk = meta.get("chunk")
+            if meta.get("num_layers") != self.cfg.num_layers:
+                raise ValueError(
+                    f"checkpoint has {meta.get('num_layers')} layers, model "
+                    f"has {self.cfg.num_layers}")
+            # re-chunking only ever touches the zero-pad region: both the
+            # saved and the current chunk are >= the real layer numel
+        self.store.load_from(os.path.join(path, "infinity_chunks"),
+                             saved_chunk=saved_chunk)
         self._param_cache.clear()
         self.nl_params = jax.tree.map(jnp.asarray, small_state["nl_params"])
         self.nl_opt = jax.tree.map(jnp.asarray, small_state["nl_opt"])
         if self._pinned:
-            self.nl_opt = jax.device_put(self.nl_opt, self._host_sh)
+            self.nl_opt = jax.device_put(self.nl_opt, self._repl_host_sh)
+        elif self.mesh.size > 1:
+            self.nl_params = jax.device_put(self.nl_params, self._repl_dev_sh)
+            self.nl_opt = jax.device_put(self.nl_opt, self._repl_dev_sh)
         self.applied_steps = int(small_state["applied_steps"])
 
     def close(self):

@@ -83,3 +83,20 @@ def test_capture_helper_sees_fd2_writes():
     with capture_spmd_warnings(matches):
         os.write(2, b"[SPMD] Involuntary full rematerialization test line\n")
     assert len(matches) == 1
+
+
+def test_replicated_tensor_scanner():
+    """replicated_tensor_bytes flags large replicated float tensors in
+    compiled HLO and ignores small/sharded ones."""
+    from deepspeed_tpu.utils.hlo_check import replicated_tensor_bytes
+    hlo = "\n".join([
+        "  %big = f32[1024,1024] broadcast(%x), sharding={replicated}",
+        "  %small = f32[4,4] broadcast(%x), sharding={replicated}",
+        "  %sharded = f32[1024,1024] add(%a, %b), "
+        "sharding={devices=[4,1]<=[4]}",
+        "  %bigbf = bf16[2048,1024]{1,0} copy(%c), sharding={replicated}",
+    ])
+    hits = replicated_tensor_bytes(hlo, min_bytes=1 << 20)
+    assert len(hits) == 2
+    assert hits[0][0] == 1024 * 1024 * 4
+    assert hits[1][0] == 2048 * 1024 * 2
