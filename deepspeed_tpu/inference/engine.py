@@ -24,12 +24,28 @@ from deepspeed_tpu.parallel import (
 
 def init_inference(model, config=None, mesh=None, dtype=None, **kwargs):
     """Reference: ``deepspeed/__init__.py:214``. `model` is a ModelSpec with a
-    decode-capable apply (models/transformer.py provides one)."""
-    cfg = Config.load(config) if not isinstance(config, InferenceConfig) else None
-    icfg = config if isinstance(config, InferenceConfig) else InferenceConfig(
-        tensor_parallel=kwargs.get("mp_size", getattr(cfg.tensor_parallel, "tp_size", 1) if cfg else 1),
-        dtype=dtype)
-    return InferenceEngine(model, icfg, mesh=mesh)
+    decode-capable apply (models/transformer.py provides one). Dict configs
+    accept InferenceConfig field names directly (quantize_bits, max_tokens,
+    fuse_gemms, ...) alongside the training-config surface."""
+    if isinstance(config, InferenceConfig):
+        return InferenceEngine(model, config, mesh=mesh)
+    fields = {f.name for f in dataclasses.fields(InferenceConfig)}
+    raw = dict(config) if isinstance(config, dict) else {}
+    raw.update(kwargs)
+    icfg_kwargs = {k: v for k, v in raw.items() if k in fields}
+    rest = {k: v for k, v in raw.items() if k not in fields and k != "mp_size"}
+    # training-config spelling: "tensor_parallel": {"tp_size": N}
+    tp_val = icfg_kwargs.get("tensor_parallel")
+    if isinstance(tp_val, dict):
+        rest["tensor_parallel"] = icfg_kwargs.pop("tensor_parallel")
+    cfg = Config.load(rest if isinstance(config, dict) else config)
+    icfg_kwargs.setdefault(
+        "tensor_parallel",
+        raw.get("mp_size", getattr(cfg.tensor_parallel, "tp_size", 1)
+                if cfg else 1))
+    if dtype is not None:
+        icfg_kwargs["dtype"] = dtype
+    return InferenceEngine(model, InferenceConfig(**icfg_kwargs), mesh=mesh)
 
 
 @dataclasses.dataclass
@@ -45,6 +61,10 @@ class InferenceConfig:
     # csrc/transformer/inference): layer weights stored int8 in HBM,
     # dequantized one layer at a time inside the scan
     quantize_bits: Optional[int] = None
+    # qkv + up/gate GEMV fusion for the decode path (reference: qkv_gemm /
+    # fused_gemm_gelu); tp=1 only. None -> on for float weights, off for
+    # int8 (measured: fusion hurts the dequant-in-scan path ~20% on v5e)
+    fuse_gemms: Optional[bool] = None
 
 
 class InferenceEngine:
@@ -70,18 +90,33 @@ class InferenceEngine:
         # int8 weight-only quantization: rebuild the model with the
         # dequant-in-scan forward and the {"q","scale"} param structure
         self._quantized = bool(config.quantize_bits)
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        is_tf = isinstance(getattr(model, "config", None), TransformerConfig)
+        # decode GEMV fusion (wqkv, w_in_gate): tp=1 only — the concat dim
+        # would interleave head shards under tensor parallelism
+        fuse = (config.fuse_gemms if config.fuse_gemms is not None
+                else not self._quantized)
+        self._fused = (fuse and is_tf and tp == 1
+                       and model.config.num_experts == 1)
         if self._quantized:
             import dataclasses as _dc
             from deepspeed_tpu.models.transformer import (
-                TransformerConfig, quantized_logical_axes)
+                fused_logical_axes, quantized_logical_axes)
             from deepspeed_tpu.models import make_model as _mk
-            if not isinstance(getattr(model, "config", None),
-                              TransformerConfig):
+            if not is_tf:
                 raise ValueError("quantize_bits requires a transformer "
                                  "ModelSpec")
             qcfg = _dc.replace(model.config, quantized_weights=True)
+            base_axes = fused_logical_axes(qcfg) if self._fused else None
             model = _dc.replace(_mk(qcfg, name=model.name),
-                                logical_axes=quantized_logical_axes(qcfg))
+                                logical_axes=quantized_logical_axes(
+                                    qcfg, base_axes=base_axes))
+            self.model = model
+        elif self._fused:
+            import dataclasses as _dc
+            from deepspeed_tpu.models.transformer import fused_logical_axes
+            model = _dc.replace(model,
+                                logical_axes=fused_logical_axes(model.config))
             self.model = model
 
         # AutoTP equivalent: logical axes -> tensor-axis sharding
@@ -90,30 +125,49 @@ class InferenceEngine:
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.param_specs,
             is_leaf=lambda x: isinstance(x, P))
+
+        def _fuse(p):
+            if not is_tf:
+                return p
+            from deepspeed_tpu.models.transformer import (fuse_layer_stack,
+                                                          unfuse_layer_stack)
+            lay = p.get("layers", {}) if isinstance(p, dict) else {}
+            fused_in = isinstance(lay, dict) and ("wqkv" in lay
+                                                  or "w_in_gate" in lay)
+            if self._fused and not fused_in:
+                return fuse_layer_stack(p, model.config)
+            if not self._fused and fused_in:
+                return unfuse_layer_stack(p, model.config)
+            return p
+
         if self._quantized:
             from deepspeed_tpu.models.transformer import quantize_layer_stack
             if params is None:
                 rng = rng if rng is not None else jax.random.PRNGKey(0)
                 params = model.init(rng)
             quant_fn = jax.jit(
-                lambda p: quantize_layer_stack(jax.tree.map(
+                lambda p: quantize_layer_stack(_fuse(jax.tree.map(
                     lambda x: x.astype(self.dtype)
                     if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-                    else x, p), bits=int(config.quantize_bits)),
+                    else x, p)), bits=int(config.quantize_bits)),
                 out_shardings=self.param_shardings)
             with mesh:
                 params = quant_fn(jax.tree.map(jnp.asarray, params))
         elif params is None:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
             init_fn = jax.jit(
-                lambda k: jax.tree.map(lambda p: p.astype(self.dtype), model.init(k)),
+                lambda k: _fuse(jax.tree.map(
+                    lambda p: p.astype(self.dtype), model.init(k))),
                 out_shardings=self.param_shardings)
             with mesh:
                 params = init_fn(rng)
         else:
-            params = jax.tree.map(
-                lambda p, s: jax.device_put(jnp.asarray(p, self.dtype), s),
-                params, self.param_shardings)
+            cast_fn = jax.jit(
+                lambda p: _fuse(jax.tree.map(
+                    lambda x: jnp.asarray(x, self.dtype), p)),
+                out_shardings=self.param_shardings)
+            with mesh:
+                params = cast_fn(jax.tree.map(jnp.asarray, params))
         self.params = params
 
         self._forward = jax.jit(lambda p, ids: model.apply(p, ids))

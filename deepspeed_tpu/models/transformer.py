@@ -346,7 +346,14 @@ def _constrain_batch_axes(x):
     batch = tuple(a for a in BATCH_AXES if shape.get(a, 1) > 1)
     if not batch:
         return x
+    dp = 1
+    for a in batch:
+        dp *= shape[a]
+    if x.shape[0] % dp:  # ad-hoc small batches (inference) stay unsharded
+        return x
     seq_ax = "seq" if shape.get("seq", 1) > 1 else None
+    if seq_ax and x.shape[1] % shape["seq"]:
+        seq_ax = None
     return jax.lax.with_sharding_constraint(x, P(batch, seq_ax))
 
 
@@ -462,12 +469,21 @@ def _activation(x, gate, cfg: TransformerConfig):
     return jax.nn.gelu(x)
 
 
-def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None):
+def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
+                      kv_row=None):
     """Single-token GQA attention against a KV ring buffer, with NO repeat of
     the kv heads in memory (reference's decode kernels repeat in registers:
     ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``).
 
     q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]; index: current position (scalar).
+
+    kv_row: the CURRENT token's (k, v) [B, Nkv, 1, D], kept OUT of the
+    buffer — its logit joins the softmax separately and the caller writes
+    the row into the cache afterwards. This is what makes the decode loop's
+    cache update O(row) instead of O(buffer): inserting the row here would
+    force XLA to rewrite (copy) the whole ring buffer every token (the
+    reference's fixed decode workspace has the same do-not-reallocate
+    property, inference_context.h).
 
     On TPU this dispatches to the length-aware Pallas kernel
     (ops/decode_attention.py) — HBM traffic per step is the VALID cache
@@ -485,7 +501,7 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None):
                   and jax.default_backend() in ("tpu", "axon") and D >= 64)
     if use_pallas:
         from deepspeed_tpu.ops.decode_attention import decode_attention
-        return decode_attention(q, ck, cv, index)
+        return decode_attention(q, ck, cv, index, kv_row=kv_row)
     qg = q.reshape(B, Nkv, rep, D)
     scores = jnp.einsum("bgrd,bgtd->bgrt", qg, ck).astype(jnp.float32)
     scores = scores / math.sqrt(D)
@@ -493,6 +509,20 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None):
         rel = (jnp.arange(T) - index).astype(jnp.float32)        # k - q
         slopes = alibi_slopes(Nq).reshape(Nkv, rep)
         scores = scores + slopes[None, :, :, None] * rel[None, None, None, :]
+    if kv_row is not None:
+        k_row, v_row = kv_row                    # [B, Nkv, 1, D]
+        # buffer rows at >= index are stale; the current token's logit is
+        # computed from the fresh row (its rel distance is 0 — no alibi term)
+        valid = (jnp.arange(T) < index)[None, None, None, :]
+        scores = jnp.where(valid, scores, -1e30)
+        s_self = jnp.einsum("bgrd,bgtd->bgrt", qg,
+                            k_row.astype(qg.dtype)).astype(jnp.float32)
+        s_self = s_self / math.sqrt(D)
+        scores = jnp.concatenate([scores, s_self], axis=-1)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bgrt,bgtd->bgrd", probs[..., :T], cv)
+        out = out + probs[..., T:] * v_row.astype(q.dtype)
+        return out.reshape(B, 1, Nq, D)
     valid = (jnp.arange(T) <= index)[None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -539,16 +569,85 @@ def quantize_layer_stack(params: Params, bits: int = 8) -> Params:
     return out
 
 
-def quantized_logical_axes(cfg: TransformerConfig) -> Params:
+def quantized_logical_axes(cfg: TransformerConfig,
+                           base_axes: Optional[Params] = None) -> Params:
     """logical_axes variant matching the quantize_layer_stack structure."""
-    axes = logical_axes(cfg)
+    axes = base_axes if base_axes is not None else logical_axes(cfg)
 
     def one(a):
         if a is None or len(a) < 3:
             return a
         return {"q": a, "scale": (a[0],) + (None,) * (len(a) - 2) + (a[-1],)}
+    axes = dict(axes)
     axes["layers"] = {k: one(v) for k, v in axes["layers"].items()}
     return axes
+
+
+def fuse_layer_stack(params: Params, cfg: TransformerConfig) -> Params:
+    """Inference weight fusion: wq/wk/wv -> wqkv, w_in/w_gate -> w_in_gate.
+
+    Decode at short context is op-latency bound (L layers x ~7 thin GEMVs
+    per token); fusing cuts that to ~4 launches per layer. The reference's
+    decode path fuses identically (qkv_gemm / fused_gemm_gelu,
+    ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``). Apply
+    BEFORE quantize_layer_stack; tensor-parallel layouts must stay unfused
+    (the concat dim would interleave head shards).
+    """
+    if cfg.num_experts > 1:
+        return params  # PR-MoE reads w_in/w_gate in its residual branch
+    L = dict(params["layers"])
+    if "wq" in L:
+        L["wqkv"] = jnp.concatenate(
+            [L.pop("wq"), L.pop("wk"), L.pop("wv")], axis=-1)
+        if "bq" in L:
+            L["bqkv"] = jnp.concatenate(
+                [L.pop("bq"), L.pop("bk"), L.pop("bv")], axis=-1)
+    if "w_gate" in L and "w_in" in L and "b_in" not in L:
+        L["w_in_gate"] = jnp.concatenate(
+            [L.pop("w_in"), L.pop("w_gate")], axis=-1)
+    return {**params, "layers": L}
+
+
+def unfuse_layer_stack(params: Params, cfg: TransformerConfig) -> Params:
+    """Inverse of fuse_layer_stack (e.g. re-sharding fused weights onto a
+    tensor-parallel mesh, which needs the per-projection layout)."""
+    L = dict(params["layers"])
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    if "wqkv" in L:
+        w = L.pop("wqkv")
+        L["wq"] = w[..., :nh * hd]
+        L["wk"] = w[..., nh * hd:(nh + nkv) * hd]
+        L["wv"] = w[..., (nh + nkv) * hd:]
+        if "bqkv" in L:
+            b = L.pop("bqkv")
+            L["bq"] = b[..., :nh * hd]
+            L["bk"] = b[..., nh * hd:(nh + nkv) * hd]
+            L["bv"] = b[..., (nh + nkv) * hd:]
+    if "w_in_gate" in L:
+        w = L.pop("w_in_gate")
+        half = w.shape[-1] // 2
+        L["w_in"], L["w_gate"] = w[..., :half], w[..., half:]
+    return {**params, "layers": L}
+
+
+def fused_logical_axes(cfg: TransformerConfig) -> Params:
+    """logical_axes matching the fuse_layer_stack structure."""
+    axes = logical_axes(cfg)
+    if cfg.num_experts > 1:
+        return axes
+    layers = dict(axes["layers"])
+    if "wq" in layers:
+        layers["wqkv"] = ("layers", "embed", "qkv")
+        for k in ("wq", "wk", "wv"):
+            layers.pop(k, None)
+        if "bq" in layers:
+            layers["bqkv"] = ("layers", "qkv")
+            for k in ("bq", "bk", "bv"):
+                layers.pop(k, None)
+    if "w_gate" in layers and "w_in" in layers and "b_in" not in layers:
+        layers["w_in_gate"] = ("layers", "embed", "mlp")
+        layers.pop("w_in"), layers.pop("w_gate")
+    return {**axes, "layers": layers}
 
 
 def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
@@ -556,9 +655,13 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                       cache=None, return_kv: bool = False):
     """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
-    cache=(ck, cv, index): decode mode — x is [B, 1, H], the new K/V row is
-    written at `index` and attention runs over the buffer. return_kv: also
-    return the (post-rotary) K/V so a prefill pass can seed the cache.
+    cache=(ck, cv, index[, read_len]): decode mode — x is [B, 1, H]. The
+    buffer is NOT modified: attention treats the fresh (k, v) row as a
+    separate softmax term (rows >= index in the buffer are stale), and the
+    third return value is that (k_row, v_row) [B, nkv, 1, hd] for the
+    CALLER to write at `index` (decode_step batches all layers' rows into
+    one tiny column update). return_kv: also return the (post-rotary) K/V
+    so a prefill pass can seed the cache.
     """
     p = _maybe_dequant(layer_params, cfg)
     B, S, H = x.shape
@@ -568,11 +671,24 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     if cfg.activation_quant_bits:
         from deepspeed_tpu.ops.quantizer import fake_quant
         h = fake_quant(h, bits=cfg.activation_quant_bits)
-    q = h @ p["wq"].astype(h.dtype)
-    k = h @ p["wk"].astype(h.dtype)
-    v = h @ p["wv"].astype(h.dtype)
-    if "bq" in p:
-        q, k, v = q + p["bq"].astype(h.dtype), k + p["bk"].astype(h.dtype), v + p["bv"].astype(h.dtype)
+    if "wqkv" in p:
+        # fused projection (see fuse_layer_stack): one GEMV instead of three
+        # — decode at short context is op-latency bound, and the reference
+        # fuses the same way (qkv_gemm, pt_binding.cpp)
+        qkv = h @ p["wqkv"].astype(h.dtype)
+        if "bqkv" in p:
+            qkv = qkv + p["bqkv"].astype(h.dtype)
+        q = qkv[..., :nh * hd]
+        k = qkv[..., nh * hd:(nh + nkv) * hd]
+        v = qkv[..., (nh + nkv) * hd:]
+    else:
+        q = h @ p["wq"].astype(h.dtype)
+        k = h @ p["wk"].astype(h.dtype)
+        v = h @ p["wv"].astype(h.dtype)
+        if "bq" in p:
+            q, k, v = (q + p["bq"].astype(h.dtype),
+                       k + p["bk"].astype(h.dtype),
+                       v + p["bv"].astype(h.dtype))
     q = q.reshape(B, S, nh, hd)
     k = k.reshape(B, S, nkv, hd)
     v = v.reshape(B, S, nkv, hd)
@@ -587,17 +703,21 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         read_len = cache[3] if len(cache) > 3 else None
         k_row = jnp.swapaxes(k, 1, 2).astype(ck.dtype)   # [B, nkv, 1, hd]
         v_row = jnp.swapaxes(v, 1, 2).astype(cv.dtype)
-        ck = lax.dynamic_update_slice(ck, k_row, (0, 0, index, 0))
-        cv = lax.dynamic_update_slice(cv, v_row, (0, 0, index, 0))
+        # the buffer is NOT modified here: the fresh row joins the softmax
+        # separately and the decode loop writes all layers' rows with one
+        # O(L*B*nkv*hd) update — rewriting the ring buffer per layer would
+        # copy the whole cache every token (the ctx-2048 decode cliff)
         # windowed decode: attention reads a STATIC prefix of the ring
         # buffer (the decode loop guarantees index < read_len), so XLA only
         # touches O(read_len) bytes instead of max_len
         if read_len is not None and read_len < ck.shape[2]:
             attn_out = _decode_attention(q, ck[:, :, :read_len],
-                                         cv[:, :, :read_len], index, cfg)
+                                         cv[:, :, :read_len], index, cfg,
+                                         kv_row=(k_row, v_row))
         else:
-            attn_out = _decode_attention(q, ck, cv, index, cfg)
-        new_kv = (ck, cv)
+            attn_out = _decode_attention(q, ck, cv, index, cfg,
+                                         kv_row=(k_row, v_row))
+        new_kv = (k_row, v_row)
     else:
         if return_kv:
             new_kv = (k, v)
@@ -644,6 +764,14 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                 moe_out * coef[..., 1:2].astype(h.dtype)
         else:
             out = moe_out
+    elif "w_in_gate" in p:
+        # fused up+gate projection (see fuse_layer_stack)
+        ug = h @ p["w_in_gate"].astype(h.dtype)
+        half = ug.shape[-1] // 2
+        act = _activation(ug[..., :half], ug[..., half:], cfg)
+        out = act @ p["w_out"].astype(h.dtype)
+        if "b_out" in p:
+            out = out + p["b_out"].astype(h.dtype)
     else:
         up = h @ p["w_in"].astype(h.dtype)
         if "b_in" in p:
@@ -943,13 +1071,18 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
         layer_p, ck, cv = xs
         if cfg.offload_params:
             layer_p = _fetch_layer(layer_p, cfg)
-        y, _, (nck, ncv) = transformer_layer(
+        y, _, (k_row, v_row) = transformer_layer(
             x_c, layer_p, cfg, positions=positions, deterministic=True,
             cache=(ck, cv, index, read_len), return_kv=False)
-        return y, (nck, ncv)
+        return y, (k_row, v_row)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"],
-                                           cache["v"]))
+    x, (k_rows, v_rows) = lax.scan(body, x, (params["layers"], cache["k"],
+                                             cache["v"]))
+    # one tiny [L, B, nkv, 1, hd] column write — the ring buffers update
+    # in place (XLA aliases the dus when the cache is a loop carry /
+    # donated input), instead of the scan re-stacking full buffers
+    new_k = lax.dynamic_update_slice(cache["k"], k_rows, (0, 0, 0, index, 0))
+    new_v = lax.dynamic_update_slice(cache["v"], v_rows, (0, 0, 0, index, 0))
     x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
     head = params.get("lm_head")
     if head is None:

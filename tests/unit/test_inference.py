@@ -141,7 +141,8 @@ def test_int8_weight_only_inference(devices8):
     eng_q = InferenceEngine(model, InferenceConfig(dtype=jnp.float32,
                                                    quantize_bits=8),
                             params=jax.device_get(eng_fp.params))
-    wq = eng_q.params["layers"]["wq"]
+    lay = eng_q.params["layers"]
+    wq = lay["wqkv"] if "wqkv" in lay else lay["wq"]  # tp=1 fuses qkv
     assert wq["q"].dtype == jnp.int8
     ids = np.random.default_rng(7).integers(0, cfg.vocab_size,
                                             size=(2, 12)).astype(np.int32)

@@ -1,0 +1,143 @@
+"""Universal checkpoint: save at mesh A, resume at mesh B.
+
+Reference: ``deepspeed/checkpoint/universal_checkpoint.py:10`` +
+``reshape_3d_utils.py`` + ``tests/unit/model_parallelism/
+test_configurable_parallel_mp.py`` (resize TP/PP on resume). The reference
+needs explicit reshape tooling because its shards are rank-local files;
+here Orbax stores the GLOBAL arrays, so restore-at-a-different-mesh is a
+property to prove, not machinery to build. These tests prove it: the loss
+trajectory after resume must match the original run continuing.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, make_model
+
+
+def _model():
+    return make_model(TransformerConfig(
+        vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+        max_seq_len=64, dtype=jnp.float32, attention_impl="xla",
+        tie_embeddings=False), name="uckpt")
+
+
+def _cfg(mesh_axes, gas=2, micro=2):
+    dp = 1
+    for ax, n in mesh_axes.items():
+        if ax in ("data", "fsdp", "expert"):
+            dp *= n
+    return {
+        "train_batch_size": micro * gas * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3 if mesh_axes.get("fsdp", 1) > 1
+                              else 1},
+        "mesh": {"axes": mesh_axes},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000000,
+    }
+
+
+def _batch(B, S=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (B, S), dtype=np.int32)}
+
+
+def _engine(mesh_axes, devices, gas=2):
+    cfg = _cfg(mesh_axes, gas=gas)
+    engine, *_ = deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                          devices=devices)
+    return engine, cfg["train_batch_size"]
+
+
+class TestCrossMeshCheckpoint:
+    """The (tp, fsdp, pp)-degree-change matrix VERDICT r3 item 4 asks for."""
+
+    def _save_and_ref(self, tmp_path, devices8, mesh_axes, steps=3, cont=2):
+        engine, B = _engine(mesh_axes, devices8)
+        batch = _batch(B)
+        for _ in range(steps):
+            engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path), tag="x")
+        ref = [float(engine.train_batch(batch)["loss"])
+               for _ in range(cont)]
+        return ref, B
+
+    def _resume(self, tmp_path, devices8, mesh_axes, B_ref, cont=2,
+                devices=None, gas=2):
+        engine, B = _engine(mesh_axes, devices if devices is not None
+                            else devices8, gas=gas)
+        assert B == B_ref, "global batch must match for trajectory parity"
+        engine.load_checkpoint(str(tmp_path), tag="x")
+        batch = _batch(B)
+        return [float(engine.train_batch(batch)["loss"])
+                for _ in range(cont)]
+
+    def test_fsdp4_tp2_to_fsdp2_tp4(self, tmp_path, devices8):
+        ref, B = self._save_and_ref(tmp_path, devices8,
+                                    {"fsdp": 4, "tensor": 2})
+        # dp halves (4 -> 2): keep the global batch with micro=4
+        cfg = _cfg({"fsdp": 2, "tensor": 4}, gas=2, micro=4)
+        assert cfg["train_batch_size"] == B
+        engine, *_ = deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                              devices=devices8)
+        engine.load_checkpoint(str(tmp_path), tag="x")
+        got = [float(engine.train_batch(_batch(B))["loss"])
+               for _ in range(2)]
+        np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+    def test_fsdp8_to_data2_fsdp4(self, tmp_path, devices8):
+        ref, B = self._save_and_ref(tmp_path, devices8, {"fsdp": 8})
+        got = self._resume(tmp_path, devices8, {"data": 2, "fsdp": 4}, B)
+        np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+    def test_fsdp4_tp2_to_pipeline(self, tmp_path, devices8):
+        """Resume a GSPMD-trained checkpoint under pipeline parallelism."""
+        ref, B = self._save_and_ref(tmp_path, devices8,
+                                    {"fsdp": 4, "tensor": 2})
+        cfg = _cfg({"pipe": 2, "data": 4}, gas=2, micro=2)
+        assert cfg["train_batch_size"] == B
+        engine, *_ = deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                              devices=devices8)
+        engine.load_checkpoint(str(tmp_path), tag="x")
+        got = [float(engine.train_batch(_batch(B))["loss"])
+               for _ in range(2)]
+        # 1F1B recomputes the same math; bf16-free model -> tight tol
+        np.testing.assert_allclose(ref, got, rtol=5e-4, atol=5e-5)
+
+    def test_mesh_shrink_8_to_2(self, tmp_path, devices8):
+        ref, B = self._save_and_ref(tmp_path, devices8, {"fsdp": 8})
+        cfg = _cfg({"fsdp": 2}, gas=2, micro=8)
+        assert cfg["train_batch_size"] == B
+        engine, *_ = deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                              devices=devices8[:2])
+        engine.load_checkpoint(str(tmp_path), tag="x")
+        got = [float(engine.train_batch(_batch(B))["loss"])
+               for _ in range(2)]
+        np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+
+
+def test_inspect_cli(tmp_path, devices8, capsys):
+    engine, B = _engine({"fsdp": 4, "tensor": 2}, devices8)
+    engine.train_batch(_batch(B))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    from deepspeed_tpu.utils.ckpt_tools import main
+    main(["inspect", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "t1" in out and "params" in out
+
+
+def test_validate_mesh_cli(tmp_path, devices8, capsys):
+    engine, B = _engine({"fsdp": 4, "tensor": 2}, devices8)
+    engine.train_batch(_batch(B))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    from deepspeed_tpu.utils.ckpt_tools import main
+    rc = main(["validate", str(tmp_path), "--mesh", "fsdp=2,tensor=4"])
+    assert rc == 0
+    rc = main(["validate", str(tmp_path), "--mesh", "tensor=3"])
+    out = capsys.readouterr().out
+    assert rc != 0 and "divis" in out.lower()
