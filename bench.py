@@ -292,7 +292,11 @@ def _kernel_parity_matrix() -> dict:
         ok = ok and max(errs) < REL_TOL
         cases += 1
 
-    # decode kernel: legacy (row in buffer) and fresh-row modes
+    # decode kernel: legacy (row in buffer) and fresh-row modes, checked
+    # against the XLA fallback in models/transformer._decode_attention
+    # (cfg=None forces the XLA path) so the masking contract lives in ONE
+    # place instead of a re-implemented reference drifting here
+    from deepspeed_tpu.models.transformer import _decode_attention
     for T, Nkv, rep, D, idx, row_mode in [(2048, 8, 1, 64, 1500, True),
                                           (1024, 2, 4, 128, 600, True),
                                           (1024, 4, 2, 64, 900, False)]:
@@ -301,26 +305,16 @@ def _kernel_parity_matrix() -> dict:
         q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.bfloat16)
         ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.bfloat16)
         cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.bfloat16)
-        qg = q.reshape(B, Nkv, rep, D).astype(jnp.float32)
-        s = jnp.einsum("bgrd,bgtd->bgrt", qg, ck.astype(jnp.float32))
-        s = s / math.sqrt(D)
         if row_mode:
             k_row = jax.random.normal(ks[3], (B, Nkv, 1, D), jnp.bfloat16)
             v_row = jax.random.normal(ks[4], (B, Nkv, 1, D), jnp.bfloat16)
             out = decode_attention(q, ck, cv, idx, kv_row=(k_row, v_row))
-            s = jnp.where((jnp.arange(T) < idx)[None, None, None], s, -1e30)
-            s1 = jnp.einsum("bgrd,bgtd->bgrt", qg,
-                            k_row.astype(jnp.float32)) / math.sqrt(D)
-            p = jax.nn.softmax(jnp.concatenate([s, s1], -1), axis=-1)
-            ref = (jnp.einsum("bgrt,bgtd->bgrd", p[..., :T],
-                              cv.astype(jnp.float32))
-                   + p[..., T:] * v_row.astype(jnp.float32))
+            ref = _decode_attention(q, ck, cv, idx, None,
+                                    kv_row=(k_row, v_row))
         else:
             out = decode_attention(q, ck, cv, idx)
-            s = jnp.where((jnp.arange(T) <= idx)[None, None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            ref = jnp.einsum("bgrt,bgtd->bgrd", p, cv.astype(jnp.float32))
-        err = _rel_err(out.reshape(B, Nkv, rep, D), ref)
+            ref = _decode_attention(q, ck, cv, idx, None)
+        err = _rel_err(out, ref)
         worst = max(worst, err)
         ok = ok and err < REL_TOL
         cases += 1

@@ -22,13 +22,16 @@ from deepspeed_tpu.parallel import (
     MeshPlan, build_mesh, make_rules, spec_tree)
 
 
-def init_inference(model, config=None, mesh=None, dtype=None, **kwargs):
+def init_inference(model, config=None, mesh=None, dtype=None, params=None,
+                   rng=None, **kwargs):
     """Reference: ``deepspeed/__init__.py:214``. `model` is a ModelSpec with a
     decode-capable apply (models/transformer.py provides one). Dict configs
     accept InferenceConfig field names directly (quantize_bits, max_tokens,
-    fuse_gemms, ...) alongside the training-config surface."""
+    fuse_gemms, ...) alongside the training-config surface. params: a
+    pre-built tree (e.g. load_hf_params output) instead of random init."""
     if isinstance(config, InferenceConfig):
-        return InferenceEngine(model, config, mesh=mesh)
+        return InferenceEngine(model, config, mesh=mesh, params=params,
+                               rng=rng)
     fields = {f.name for f in dataclasses.fields(InferenceConfig)}
     raw = dict(config) if isinstance(config, dict) else {}
     raw.update(kwargs)
@@ -45,7 +48,8 @@ def init_inference(model, config=None, mesh=None, dtype=None, **kwargs):
                 if cfg else 1))
     if dtype is not None:
         icfg_kwargs["dtype"] = dtype
-    return InferenceEngine(model, InferenceConfig(**icfg_kwargs), mesh=mesh)
+    return InferenceEngine(model, InferenceConfig(**icfg_kwargs), mesh=mesh,
+                           params=params, rng=rng)
 
 
 @dataclasses.dataclass

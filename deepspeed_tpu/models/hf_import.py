@@ -12,8 +12,13 @@ and TP/FSDP "slicing" is `jax.device_put(leaf, NamedSharding)` — GSPMD moves
 only each device's slice to it. The same table run backwards exports our tree
 to an HF-layout state dict (the zero_to_fp32/16-bit-export interop path).
 
-Supported families: Llama/Mistral-style (GQA, rotary, silu-GLU, rmsnorm) and
-GPT-2 style (fused-qkv Conv1D, learned positions, gelu, layernorm).
+Supported families: Llama/Mistral (GQA, rotary, silu-GLU, rmsnorm), Mixtral
+(MoE), GPT-2 (fused-qkv Conv1D, learned positions), OPT, BLOOM (alibi,
+embed-LN, interleaved fused qkv), BERT/RoBERTa (bidirectional post-LN
+encoder, segment embeddings), GPT-J (parallel block, shared LN, partial
+interleaved rotary, head bias), GPT-NeoX (parallel residual, two LNs,
+partial rotary). Reference coverage: the per-architecture policy containers
+in ``deepspeed/module_inject/containers/``.
 """
 
 import json
@@ -278,24 +283,158 @@ def _gpt2_table(cfg):
     return L
 
 
-_SKIP = re.compile(r"(rotary_emb\.inv_freq|\.attn\.(bias|masked_bias)$)")
+def _bert_table(cfg):
+    """BERT/RoBERTa encoder (reference: module_inject/containers/bert.py):
+    post-LN blocks — attention.output.LayerNorm is our ln1 (applied after
+    the attention residual), output.LayerNorm our ln2. The pooler and MLM
+    head are out of scope (hidden states + tied-embedding logits)."""
+    pre = r"^(?:bert\.|roberta\.)?"
+    lyr = pre + r"encoder\.layer\.(\d+)\."
+    att = lyr + r"attention\."
+    return [
+        (pre + r"embeddings\.word_embeddings\.weight$", ("tok_embed",), None),
+        (pre + r"embeddings\.position_embeddings\.weight$",
+         ("pos_embed",), None),
+        (pre + r"embeddings\.token_type_embeddings\.weight$",
+         ("tok_type_embed",), None),
+        (pre + r"embeddings\.LayerNorm\.weight$", ("embed_norm_scale",), None),
+        (pre + r"embeddings\.LayerNorm\.bias$", ("embed_norm_bias",), None),
+        (att + r"self\.query\.weight$", ("layers", "wq"), _t),
+        (att + r"self\.query\.bias$", ("layers", "bq"), None),
+        (att + r"self\.key\.weight$", ("layers", "wk"), _t),
+        (att + r"self\.key\.bias$", ("layers", "bk"), None),
+        (att + r"self\.value\.weight$", ("layers", "wv"), _t),
+        (att + r"self\.value\.bias$", ("layers", "bv"), None),
+        (att + r"output\.dense\.weight$", ("layers", "wo"), _t),
+        (att + r"output\.dense\.bias$", ("layers", "bo"), None),
+        (att + r"output\.LayerNorm\.weight$", ("layers", "ln1_scale"), None),
+        (att + r"output\.LayerNorm\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"intermediate\.dense\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"intermediate\.dense\.bias$", ("layers", "b_in"), None),
+        (lyr + r"output\.dense\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"output\.dense\.bias$", ("layers", "b_out"), None),
+        (lyr + r"output\.LayerNorm\.weight$", ("layers", "ln2_scale"), None),
+        (lyr + r"output\.LayerNorm\.bias$", ("layers", "ln2_bias"), None),
+    ]
+
+
+def _roberta_table(cfg):
+    """RoBERTa = BERT layout with position rows offset by padding_idx+1=2
+    (HF's create_position_ids_from_input_ids). Detection needs the
+    'roberta.' key prefix; for bare encoder state dicts pass
+    family="roberta" explicitly."""
+    S = cfg.max_seq_len
+
+    def pos_slice(w):
+        return w[2:2 + S]
+
+    table = []
+    for pat, dest, tf in _bert_table(cfg):
+        if dest == ("pos_embed",):
+            tf = pos_slice
+        table.append((pat, dest, tf))
+    return table
+
+
+def _gptj_table(cfg):
+    """GPT-J (reference: module_inject/containers/gptj.py): parallel
+    attn+MLP block with ONE shared LN — ln_1 fills both our ln1 and ln2
+    slots; bias-free attention projections; lm_head carries a bias."""
+    pre = r"^(?:transformer\.)?"
+    lyr = pre + r"h\.(\d+)\."
+    return [
+        (pre + r"wte\.weight$", ("tok_embed",), None),
+        (pre + r"ln_f\.weight$", ("final_norm_scale",), None),
+        (pre + r"ln_f\.bias$", ("final_norm_bias",), None),
+        (r"^lm_head\.weight$", ("lm_head",), _t),
+        (r"^lm_head\.bias$", ("lm_head_bias",), None),
+        (lyr + r"ln_1\.weight$",
+         ("layers", ("ln1_scale", "ln2_scale")), lambda w: [w, w]),
+        (lyr + r"ln_1\.bias$",
+         ("layers", ("ln1_bias", "ln2_bias")), lambda b: [b, b]),
+        (lyr + r"attn\.q_proj\.weight$", ("layers", "wq"), _t),
+        (lyr + r"attn\.k_proj\.weight$", ("layers", "wk"), _t),
+        (lyr + r"attn\.v_proj\.weight$", ("layers", "wv"), _t),
+        (lyr + r"attn\.out_proj\.weight$", ("layers", "wo"), _t),
+        (lyr + r"mlp\.fc_in\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"mlp\.fc_in\.bias$", ("layers", "b_in"), None),
+        (lyr + r"mlp\.fc_out\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"mlp\.fc_out\.bias$", ("layers", "b_out"), None),
+    ]
+
+
+def _gptneox_table(cfg):
+    """GPT-NeoX (reference: module_inject/containers/gptneox.py): parallel
+    residual with two LNs, per-head-interleaved fused qkv like BLOOM."""
+    nh, hd = cfg.num_heads, cfg.dim_per_head
+
+    def split_qkv(w):  # [3H, H], rows interleaved [nh, 3, hd]
+        w = w.reshape(nh, 3, hd, w.shape[-1])
+        return [np.ascontiguousarray(w[:, i].reshape(nh * hd, -1).T)
+                for i in range(3)]
+
+    def split_qkv_bias(b):
+        b = b.reshape(nh, 3, hd)
+        return [np.ascontiguousarray(b[:, i].reshape(-1)) for i in range(3)]
+
+    pre = r"^(?:gpt_neox\.)?"
+    lyr = pre + r"layers\.(\d+)\."
+    return [
+        (pre + r"embed_in\.weight$", ("tok_embed",), None),
+        (pre + r"final_layer_norm\.weight$", ("final_norm_scale",), None),
+        (pre + r"final_layer_norm\.bias$", ("final_norm_bias",), None),
+        (r"^embed_out\.weight$", ("lm_head",), _t),
+        (lyr + r"input_layernorm\.weight$", ("layers", "ln1_scale"), None),
+        (lyr + r"input_layernorm\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"post_attention_layernorm\.weight$",
+         ("layers", "ln2_scale"), None),
+        (lyr + r"post_attention_layernorm\.bias$",
+         ("layers", "ln2_bias"), None),
+        (lyr + r"attention\.query_key_value\.weight$",
+         ("layers", ("wq", "wk", "wv")), split_qkv),
+        (lyr + r"attention\.query_key_value\.bias$",
+         ("layers", ("bq", "bk", "bv")), split_qkv_bias),
+        (lyr + r"attention\.dense\.weight$", ("layers", "wo"), _t),
+        (lyr + r"attention\.dense\.bias$", ("layers", "bo"), None),
+        (lyr + r"mlp\.dense_h_to_4h\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"mlp\.dense_h_to_4h\.bias$", ("layers", "b_in"), None),
+        (lyr + r"mlp\.dense_4h_to_h\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"mlp\.dense_4h_to_h\.bias$", ("layers", "b_out"), None),
+    ]
+
+
+_SKIP = re.compile(r"(rotary_emb\.inv_freq|\.attn\.(bias|masked_bias)$"
+                   r"|\.attention\.(bias|masked_bias|rotary_emb)"
+                   r"|pooler\.dense\.|cls\.|position_ids$)")
 
 
 _TABLES = {"llama": _llama_table, "gpt2": _gpt2_table,
            "mixtral": _mixtral_table, "opt": _opt_table,
-           "bloom": _bloom_table}
+           "bloom": _bloom_table, "bert": _bert_table,
+           "roberta": _roberta_table,
+           "gptj": _gptj_table, "gpt_neox": _gptneox_table}
 
 
 def _detect_family(keys) -> str:
-    # order matters: OPT has self_attn.q_proj too (under decoder.), and
-    # Mixtral is llama + block_sparse_moe — test the distinctive keys first
+    # order matters: OPT has self_attn.q_proj too (under decoder.), BERT has
+    # word_embeddings (BLOOM's marker), NeoX has dense_h_to_4h (also
+    # BLOOM's) — test the distinctive keys first
     for k in keys:
         if "block_sparse_moe" in k:
             return "mixtral"
+        if k.startswith("roberta."):
+            return "roberta"
+        if "encoder.layer." in k or "token_type_embeddings" in k:
+            return "bert"
+        if ("gpt_neox." in k or "embed_in." in k or "embed_out." in k
+                or (".attention.query_key_value" in k
+                    and "self_attention" not in k)):
+            return "gpt_neox"
         if "decoder.embed_positions" in k or "decoder.layers." in k:
             return "opt"
-        if ("word_embeddings" in k or "self_attention." in k
-                or "dense_h_to_4h" in k or "dense_4h_to_h" in k):
+        # bloom-DISTINCTIVE only: plain word_embeddings is also BERT's and
+        # dense_h_to_4h is also NeoX's — those must stay pending
+        if "word_embeddings_layernorm" in k or "self_attention." in k:
             return "bloom"
     for k in keys:
         if "decoder." in k:
@@ -303,16 +442,23 @@ def _detect_family(keys) -> str:
         if ("self_attn.q_proj" in k or "embed_tokens" in k
                 or k.startswith(("model.layers.", "layers."))):
             return "llama"
+        # GPT-J: bias-free separated projections under .attn. (GPT-2's are
+        # fused c_attn; llama's sit under .self_attn.)
+        if ".self_attn." not in k and (
+                ".attn.q_proj" in k or ".attn.k_proj" in k
+                or ".attn.v_proj" in k or ".attn.out_proj" in k
+                or ".mlp.fc_in." in k or ".mlp.fc_out." in k):
+            return "gptj"
         # gpt2 needs a DISTINCTIVE marker, not just the h.* prefix (BLOOM
-        # also uses h.N. — its input_layernorm keys must stay pending until
-        # a family-distinctive key streams by)
-        if (".attn.c_attn." in k or "wte." in k or "wpe." in k
-                or ".ln_1." in k or ".ln_2." in k
+        # also uses h.N., GPT-J shares wte/ln_1 — its keys must stay
+        # pending until a family-distinctive key streams by)
+        if (".attn.c_attn." in k or "wpe." in k
+                or ".ln_2." in k
                 or ".mlp.c_fc." in k or ".mlp.c_proj." in k
                 or ".attn.c_proj." in k):
             return "gpt2"
     raise ValueError("unrecognized checkpoint family; expected Llama/Mixtral/"
-                     "OPT/GPT-2-style keys")
+                     "OPT/BLOOM/GPT-2/BERT/GPT-J/GPT-NeoX-style keys")
 
 
 # --------------------------------------------------------------------------
@@ -575,6 +721,15 @@ def export_hf_state_dict(params, cfg, *, family: Optional[str] = None
 # HF config -> TransformerConfig
 # --------------------------------------------------------------------------
 
+def _even_rotary(head_dim: int, pct: float) -> int:
+    rd = int(head_dim * pct)
+    if rd % 2:
+        raise ValueError(
+            f"rotary_pct {pct} of head_dim {head_dim} gives odd "
+            f"rotary_dim {rd}; rotation pairs dims — use an even value")
+    return max(2, rd)
+
+
 def hf_config_to_transformer(hf_cfg, **overrides):
     """Build a TransformerConfig from a transformers PretrainedConfig (or a
     config.json dict)."""
@@ -638,6 +793,60 @@ def hf_config_to_transformer(hf_cfg, **overrides):
             position_type="alibi", activation="gelu",
             norm_type="layernorm", embed_norm=True,
             tie_embeddings=bool(get("tie_word_embeddings", True)))
+    elif mt in ("bert", "roberta"):
+        # encoder family (reference: module_inject/containers/bert.py +
+        # distilbert.py): bidirectional, post-LN, segment embeddings.
+        # RoBERTa's learned-position table carries a padding_idx+1=2 row
+        # offset (its import table slices it off), so usable positions are
+        # max_position_embeddings - 2.
+        max_pos = get("max_position_embeddings", 512)
+        if mt == "roberta":
+            max_pos -= 2
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            intermediate_size=get("intermediate_size"),
+            max_seq_len=max_pos,
+            norm_eps=get("layer_norm_eps", 1e-12),
+            position_type="learned", activation="gelu",
+            norm_type="layernorm", causal=False, norm_style="post",
+            embed_norm=True, final_norm=False,
+            type_vocab_size=get("type_vocab_size", 2) or 0,
+            tie_embeddings=True)
+    elif mt == "gptj":
+        # reference: module_inject/containers/gptj.py — parallel attn+MLP
+        # residual, single shared LN, partial interleaved rotary, head bias
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=get("n_embd"),
+            num_layers=get("n_layer"), num_heads=get("n_head"),
+            intermediate_size=get("n_inner") or 4 * get("n_embd"),
+            max_seq_len=get("n_positions", 2048),
+            norm_eps=get("layer_norm_epsilon", 1e-5),
+            position_type="rotary", rotary_dim=get("rotary_dim", 64),
+            rotary_interleaved=True, parallel_block=True,
+            activation="gelu", norm_type="layernorm", qkv_bias=False,
+            tie_embeddings=False, head_bias=True)
+    elif mt == "gpt_neox":
+        # reference: module_inject/containers/gptneox.py — parallel residual
+        # (two LNs), rotary over rotary_pct of the head dim
+        if not get("use_parallel_residual", True):
+            raise ValueError("gpt_neox use_parallel_residual=False is not "
+                             "supported (sequential NeoX variant)")
+        hd = get("hidden_size") // get("num_attention_heads")
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            intermediate_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm_eps=get("layer_norm_eps", 1e-5),
+            position_type="rotary",
+            rotary_dim=_even_rotary(hd, float(get("rotary_pct", 0.25))),
+            rope_theta=float(get("rotary_emb_base", 10000.0)),
+            parallel_block=True, activation="gelu",
+            norm_type="layernorm",
+            tie_embeddings=bool(get("tie_word_embeddings", False)))
     elif mt in ("gpt2", ""):
         kw = dict(
             vocab_size=get("vocab_size"), hidden_size=get("n_embd"),
@@ -658,3 +867,169 @@ def hf_config_to_transformer(hf_cfg, **overrides):
             "from HF beyond the window. Pass max_seq_len<=sliding_window to "
             "use the checkpoint within the window.")
     return TransformerConfig(**kw)
+
+
+# --------------------------------------------------------------------------
+# Megatron-LM TP-rank checkpoint merge
+# --------------------------------------------------------------------------
+
+def _flatten_nested(d, prefix=""):
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            yield from _flatten_nested(v, key)
+        else:
+            yield key, v
+
+
+def load_megatron_params(sources, cfg, dtype=None) -> Dict[str, Any]:
+    """Merge Megatron-LM tensor-parallel rank checkpoints into one tree.
+
+    Reference: ``deepspeed/runtime/state_dict_factory.py:189``
+    (MegatronSDLoader.merge_state_dict — qkv/mlp column merges, attention
+    dense / mlp output row merges). `sources`: one state dict (or .pt path /
+    nested Megatron checkpoint dict) per TP rank, rank order. Column-parallel
+    weights concat on the output dim, row-parallel on the input dim; fused
+    qkv is per-head interleaved ([nh/tp, 3, hd, H] per rank). Splitting to a
+    HIGHER tp degree needs no tool here: the merged tree re-shards onto any
+    mesh via NamedSharding (load_hf_params(shardings=...) semantics).
+    """
+    nh, hd = cfg.num_heads, cfg.dim_per_head
+    if cfg.kv_heads != nh:
+        raise ValueError("megatron merge supports MHA only (the fused qkv "
+                         f"interleave assumes kv_heads == num_heads; got "
+                         f"{cfg.kv_heads} != {nh})")
+    rank_sds = []
+    for src in sources:
+        if isinstance(src, dict) and not any(
+                hasattr(v, "shape") for v in src.values()):
+            # nested megatron layout ({'model': {'language_model': ...}});
+            # drop non-tensor metadata (iteration, args, rng_state, ...)
+            sd = {k: _to_numpy(v) for k, v in _flatten_nested(src)
+                  if hasattr(v, "shape")}
+        elif isinstance(src, dict):
+            sd = {k: _to_numpy(v) for k, v in src.items()
+                  if hasattr(v, "shape")}
+        else:
+            sd = {}
+            for k, v in _iter_state_dict(src):
+                sd[k] = v
+        # strip wrapper prefixes down to language_model.*
+        out = {}
+        for k, v in sd.items():
+            for pre in ("model.language_model.", "module.language_model.",
+                        "language_model."):
+                if k.startswith(pre):
+                    k = k[len(pre):]
+                    break
+            out[k] = v
+        rank_sds.append(out)
+
+    tp = len(rank_sds)
+    if nh % tp:
+        raise ValueError(f"num_heads {nh} not divisible by tp degree {tp}")
+
+    def gather(key):
+        vals = [sd[key] for sd in rank_sds if key in sd]
+        if len(vals) not in (0, tp):
+            raise ValueError(f"megatron merge: key {key!r} present in "
+                             f"{len(vals)}/{tp} ranks")
+        return vals
+
+    def merge_qkv(vals):
+        """Per-rank fused qkv [3H/tp, H] (heads interleaved) -> wq/wk/wv."""
+        qs, ks, vs = [], [], []
+        for w in vals:
+            per = nh // tp
+            if w.ndim == 2:
+                w4 = w.reshape(per, 3, hd, w.shape[-1])
+                qs.append(w4[:, 0].reshape(per * hd, -1))
+                ks.append(w4[:, 1].reshape(per * hd, -1))
+                vs.append(w4[:, 2].reshape(per * hd, -1))
+            else:  # bias [3H/tp]
+                b3 = w.reshape(per, 3, hd)
+                qs.append(b3[:, 0].reshape(-1))
+                ks.append(b3[:, 1].reshape(-1))
+                vs.append(b3[:, 2].reshape(-1))
+        cat = [np.concatenate(x, axis=0) for x in (qs, ks, vs)]
+        if cat[0].ndim == 2:
+            return [_t(c) for c in cat]
+        return cat
+
+    L = cfg.num_layers
+    layers: Dict[str, list] = {}
+    params: Dict[str, Any] = {}
+
+    def put_layer(name, i, arr):
+        layers.setdefault(name, [None] * L)[i] = arr
+
+    lyr = re.compile(r"^(?:encoder|transformer)\.layers\.(\d+)\.(.+)$")
+    for key in sorted(set().union(*[sd.keys() for sd in rank_sds])):
+        vals = gather(key)
+        if not vals:
+            continue
+        if key in ("embedding.word_embeddings.weight",):
+            params["tok_embed"] = np.concatenate(vals, axis=0)[:cfg.vocab_size]
+            continue
+        if key == "embedding.position_embeddings.weight":
+            params["pos_embed"] = vals[0]
+            continue
+        m = lyr.match(key)
+        if m is None:
+            if key.endswith("final_layernorm.weight"):
+                params["final_norm_scale"] = vals[0]
+            elif key.endswith("final_layernorm.bias"):
+                params["final_norm_bias"] = vals[0]
+            elif "output_layer" in key or "lm_head" in key:
+                params["lm_head"] = _t(np.concatenate(vals, axis=0))
+            elif "_extra_state" in key or "rotary" in key:
+                continue
+            else:
+                logger.warning(f"megatron merge: unmapped key {key!r}")
+            continue
+        i, rest = int(m.group(1)), m.group(2)
+        if rest == "input_layernorm.weight":
+            put_layer("ln1_scale", i, vals[0])
+        elif rest == "input_layernorm.bias":
+            put_layer("ln1_bias", i, vals[0])
+        elif rest == "post_attention_layernorm.weight":
+            put_layer("ln2_scale", i, vals[0])
+        elif rest == "post_attention_layernorm.bias":
+            put_layer("ln2_bias", i, vals[0])
+        elif rest in ("attention.query_key_value.weight",
+                      "self_attention.query_key_value.weight"):
+            q, k, v = merge_qkv(vals)
+            put_layer("wq", i, q), put_layer("wk", i, k), put_layer("wv", i, v)
+        elif rest in ("attention.query_key_value.bias",
+                      "self_attention.query_key_value.bias"):
+            q, k, v = merge_qkv(vals)
+            put_layer("bq", i, q), put_layer("bk", i, k), put_layer("bv", i, v)
+        elif rest in ("attention.dense.weight", "self_attention.dense.weight"):
+            put_layer("wo", i, _t(np.concatenate(vals, axis=1)))  # row-par
+        elif rest in ("attention.dense.bias", "self_attention.dense.bias"):
+            put_layer("bo", i, vals[0])
+        elif rest == "mlp.dense_h_to_4h.weight":
+            put_layer("w_in", i, _t(np.concatenate(vals, axis=0)))  # col-par
+        elif rest == "mlp.dense_h_to_4h.bias":
+            put_layer("b_in", i, np.concatenate(vals, axis=0))
+        elif rest == "mlp.dense_4h_to_h.weight":
+            put_layer("w_out", i, _t(np.concatenate(vals, axis=1)))
+        elif rest == "mlp.dense_4h_to_h.bias":
+            put_layer("b_out", i, vals[0])
+        elif "_extra_state" in rest or "rotary" in rest:
+            continue
+        else:
+            logger.warning(f"megatron merge: unmapped layer key {key!r}")
+
+    want = np.dtype("float32") if dtype is None else np.dtype(dtype)
+    for name, stack in layers.items():
+        missing = [i for i, a in enumerate(stack) if a is None]
+        if missing:
+            raise ValueError(f"megatron merge: layer param {name!r} missing "
+                             f"for layers {missing}")
+        params.setdefault("layers", {})[name] = np.stack(stack).astype(want)
+    params = {k: (v.astype(want) if hasattr(v, "astype") else v)
+              for k, v in params.items()}
+    if cfg.tie_embeddings:
+        params.pop("lm_head", None)
+    return params

@@ -47,6 +47,22 @@ class TransformerConfig:
     # layernorm right after the token embedding (BLOOM's
     # word_embeddings_layernorm)
     embed_norm: bool = False
+    # encoder family (BERT/RoBERTa; reference:
+    # module_inject/containers/bert.py): bidirectional attention,
+    # post-layernorm blocks, segment (token-type) embeddings
+    causal: bool = True
+    norm_style: str = "pre"             # pre | post (BERT is post-LN)
+    type_vocab_size: int = 0            # >0 -> tok_type_embed param
+    # GPT-J / GPT-NeoX block shape (reference: containers/{gptj,gptneox}.py):
+    # x + attn(ln1(x)) + mlp(ln2(x)) in ONE residual (GPT-J shares one LN —
+    # its import writes ln_1 into both slots), rotary over only the first
+    # rotary_dim dims, GPT-J's interleaved (rotate-every-two) pairing
+    parallel_block: bool = False
+    rotary_dim: Optional[int] = None
+    rotary_interleaved: bool = False
+    head_bias: bool = False             # GPT-J lm_head carries a bias
+    qkv_bias: bool = True               # layernorm models: attn proj biases
+    final_norm: bool = True             # BERT has no final LN (post-LN covers)
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
     dropout_rate: float = 0.0
@@ -230,10 +246,11 @@ def init_params(key, cfg: TransformerConfig) -> Params:
     if cfg.norm_type == "layernorm":
         layers["ln1_bias"] = jnp.zeros((L, H), dt)
         layers["ln2_bias"] = jnp.zeros((L, H), dt)
-        layers["bq"] = jnp.zeros((L, nh * hd), dt)
-        layers["bk"] = jnp.zeros((L, nkv * hd), dt)
-        layers["bv"] = jnp.zeros((L, nkv * hd), dt)
-        layers["bo"] = jnp.zeros((L, H), dt)
+        if cfg.qkv_bias:
+            layers["bq"] = jnp.zeros((L, nh * hd), dt)
+            layers["bk"] = jnp.zeros((L, nkv * hd), dt)
+            layers["bv"] = jnp.zeros((L, nkv * hd), dt)
+            layers["bo"] = jnp.zeros((L, H), dt)
         if "w_in" in layers:
             layers["b_in"] = jnp.zeros((L, F), dt)
             layers["b_out"] = jnp.zeros((L, H), dt)
@@ -241,15 +258,21 @@ def init_params(key, cfg: TransformerConfig) -> Params:
     params: Params = {
         "tok_embed": normal(next(k), (cfg.vocab_size, H)),
         "layers": layers,
-        "final_norm_scale": jnp.ones((H,), dt),
     }
+    if cfg.final_norm:
+        params["final_norm_scale"] = jnp.ones((H,), dt)
     if cfg.position_type == "learned":
         params["pos_embed"] = normal(next(k), (cfg.max_seq_len, H), scale=0.01)
+    if cfg.type_vocab_size:
+        params["tok_type_embed"] = normal(next(k), (cfg.type_vocab_size, H),
+                                          scale=0.01)
+    if cfg.head_bias and not cfg.tie_embeddings:
+        params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,), dt)
     if cfg.embed_norm:
         params["embed_norm_scale"] = jnp.ones((H,), dt)
         if cfg.norm_type == "layernorm":
             params["embed_norm_bias"] = jnp.zeros((H,), dt)
-    if cfg.norm_type == "layernorm":
+    if cfg.norm_type == "layernorm" and cfg.final_norm:
         params["final_norm_bias"] = jnp.zeros((H,), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(next(k), (H, cfg.vocab_size))
@@ -282,25 +305,34 @@ def logical_axes(cfg: TransformerConfig) -> Params:
         layers["w_gate"] = ("layers", "embed", "mlp")
     if cfg.norm_type == "layernorm":
         layers.update({
-            "ln1_bias": ("layers", "unmodeled"), "ln2_bias": ("layers", "unmodeled"),
-            "bq": ("layers", "qkv"), "bk": ("layers", "qkv"), "bv": ("layers", "qkv"),
-            "bo": ("layers", "unmodeled"),
+            "ln1_bias": ("layers", "unmodeled"),
+            "ln2_bias": ("layers", "unmodeled"),
         })
+        if cfg.qkv_bias:
+            layers.update({
+                "bq": ("layers", "qkv"), "bk": ("layers", "qkv"),
+                "bv": ("layers", "qkv"), "bo": ("layers", "unmodeled"),
+            })
         if "w_in" in layers:
             layers["b_in"] = ("layers", "mlp")
             layers["b_out"] = ("layers", "unmodeled")
     axes: Params = {
         "tok_embed": ("vocab", "embed"),
         "layers": layers,
-        "final_norm_scale": ("unmodeled",),
     }
+    if cfg.final_norm:
+        axes["final_norm_scale"] = ("unmodeled",)
     if cfg.position_type == "learned":
         axes["pos_embed"] = (None, "embed")
+    if cfg.type_vocab_size:
+        axes["tok_type_embed"] = (None, "embed")
+    if cfg.head_bias and not cfg.tie_embeddings:
+        axes["lm_head_bias"] = ("vocab",)
     if cfg.embed_norm:
         axes["embed_norm_scale"] = ("unmodeled",)
         if cfg.norm_type == "layernorm":
             axes["embed_norm_bias"] = ("unmodeled",)
-    if cfg.norm_type == "layernorm":
+    if cfg.norm_type == "layernorm" and cfg.final_norm:
         axes["final_norm_bias"] = ("unmodeled",)
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
@@ -385,17 +417,38 @@ def alibi_slopes(n_heads: int) -> jnp.ndarray:
     return jnp.asarray(pow2(cp2) + extra, jnp.float32)
 
 
-def rotary_embed(x, positions, theta: float):
-    """x: [B, S, N, D]; rotate pairs (d, d + D/2) — llama convention."""
+def rotary_embed(x, positions, theta: float, rotary_dim: Optional[int] = None,
+                 interleaved: bool = False):
+    """x: [B, S, N, D]. Default: rotate pairs (d, d + D/2) — llama
+    convention. rotary_dim: rotate only the first `rotary_dim` dims (GPT-J/
+    GPT-NeoX partial rotary). interleaved: pair (2d, 2d+1) instead — GPT-J's
+    rotate-every-two."""
     B, S, N, D = x.shape
-    half = D // 2
-    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32) * (math.log(theta) / half))
-    angles = positions.astype(jnp.float32)[:, :, None] * freqs[None, None, :]  # [B,S,half]
+    rd = rotary_dim if rotary_dim else D
+    if rd % 2:
+        raise ValueError(f"rotary_dim must be even, got {rd} (the rotation "
+                         "pairs dims)")
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    half = rd // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                    * (math.log(theta) / half))
+    angles = positions.astype(jnp.float32)[:, :, None] * freqs[None, None, :]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    if interleaved:
+        x1 = x_rot[..., 0::2].astype(jnp.float32)
+        x2 = x_rot[..., 1::2].astype(jnp.float32)
+        r1, r2 = x1 * cos - x2 * sin, x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(B, S, N, rd)
+    else:
+        x1 = x_rot[..., :half].astype(jnp.float32)
+        x2 = x_rot[..., half:].astype(jnp.float32)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
+    out = out.astype(x.dtype)
+    if rd < D:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
 
 
 def _use_pallas(cfg: TransformerConfig, seq_len: int) -> bool:
@@ -667,7 +720,10 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
 
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg)
+    post = cfg.norm_style == "post"
+    # post-LN (BERT): attention consumes x directly; the LN sits after each
+    # residual add. pre-LN (GPT/llama): LN feeds each sublayer.
+    h = x if post else _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg)
     if cfg.activation_quant_bits:
         from deepspeed_tpu.ops.quantizer import fake_quant
         h = fake_quant(h, bits=cfg.activation_quant_bits)
@@ -695,8 +751,10 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     if cfg.position_type == "rotary":
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
-        q = rotary_embed(q, positions, cfg.rope_theta)
-        k = rotary_embed(k, positions, cfg.rope_theta)
+        q = rotary_embed(q, positions, cfg.rope_theta, cfg.rotary_dim,
+                         cfg.rotary_interleaved)
+        k = rotary_embed(k, positions, cfg.rope_theta, cfg.rotary_dim,
+                         cfg.rotary_interleaved)
     new_kv = None
     if cache is not None:
         ck, cv, index = cache[:3]           # [B, nkv, T, hd]
@@ -721,13 +779,19 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     else:
         if return_kv:
             new_kv = (k, v)
-        attn_out = attention(q, k, v, mask=mask, causal=True, cfg=cfg)
+        attn_out = attention(q, k, v, mask=mask, causal=cfg.causal, cfg=cfg)
     attn_out = attn_out.reshape(B, S, nh * hd) @ p["wo"].astype(h.dtype)
     if "bo" in p:
         attn_out = attn_out + p["bo"].astype(h.dtype)
-    x = x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
-
-    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
+    if cfg.parallel_block:
+        # GPT-J/NeoX: one residual, both sublayers read the SAME input x
+        # (GPT-J shares a single LN — its import fills both slots with ln_1)
+        h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
+    else:
+        x = x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
+        if post:
+            x = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg)
+        h = x if post else _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
     if cfg.activation_quant_bits:
         from deepspeed_tpu.ops.quantizer import fake_quant
         h = fake_quant(h, bits=cfg.activation_quant_bits)
@@ -781,7 +845,13 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         out = act @ p["w_out"].astype(h.dtype)
         if "b_out" in p:
             out = out + p["b_out"].astype(h.dtype)
-    x = x + _dropout(out, cfg, dropout_rng, deterministic, 1)
+    if cfg.parallel_block:
+        x = (x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
+             + _dropout(out, cfg, dropout_rng, deterministic, 1))
+    else:
+        x = x + _dropout(out, cfg, dropout_rng, deterministic, 1)
+        if post:
+            x = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg)
     if cache is not None or return_kv:
         return x, aux, new_kv
     return x, aux
@@ -828,19 +898,25 @@ def _fetch_layer(layer_p, cfg: TransformerConfig):
 
 
 def forward(params: Params, input_ids, cfg: TransformerConfig, *,
-            attention_mask=None, positions=None, dropout_rng=None,
+            attention_mask=None, positions=None, token_type_ids=None,
+            dropout_rng=None,
             deterministic: bool = True, layer_override=None,
             return_aux: bool = False, return_kv: bool = False,
             return_hidden: bool = False, pld_theta=None):
     """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32).
 
     return_kv: also return the per-layer (post-rotary) K/V stacked on a
-    leading layer dim — the prefill path's cache seed."""
+    leading layer dim — the prefill path's cache seed. token_type_ids:
+    segment ids for encoder models (type_vocab_size > 0); None -> zeros."""
     B, S = input_ids.shape
     x = params["tok_embed"][input_ids].astype(cfg.dtype)
     if cfg.position_type == "learned":
         pos = positions if positions is not None else jnp.arange(S)[None]
         x = x + params["pos_embed"][pos].astype(cfg.dtype)
+    if "tok_type_embed" in params:
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros((B, S), jnp.int32))
+        x = x + params["tok_type_embed"][tt].astype(cfg.dtype)
     if cfg.embed_norm:
         x = _norm(x, params["embed_norm_scale"],
                   params.get("embed_norm_bias"), cfg)
@@ -939,13 +1015,17 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
         if return_kv:
             kv_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *kvs)
 
-    x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+    if cfg.final_norm:
+        x = _norm(x, params["final_norm_scale"],
+                  params.get("final_norm_bias"), cfg)
     if return_hidden:
         return x, aux_total
     head = params.get("lm_head")
     if head is None:
         head = params["tok_embed"].T
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     if return_kv:
         return logits, kv_stack
     if return_aux:
@@ -1083,11 +1163,15 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
     # donated input), instead of the scan re-stacking full buffers
     new_k = lax.dynamic_update_slice(cache["k"], k_rows, (0, 0, 0, index, 0))
     new_v = lax.dynamic_update_slice(cache["v"], v_rows, (0, 0, 0, index, 0))
-    x = _norm(x, params["final_norm_scale"], params.get("final_norm_bias"), cfg)
+    if cfg.final_norm:
+        x = _norm(x, params["final_norm_scale"],
+                  params.get("final_norm_bias"), cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["tok_embed"].T
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits[:, 0, :], {"k": new_k, "v": new_v, "index": index + 1}
 
 
