@@ -79,6 +79,12 @@ class OffloadDeviceConfig(ConfigModel):
     fast_init: bool = False
     max_in_cpu: int = 1_000_000_000
     ratio: float = 1.0
+    # run the optimizer ON the host over host-resident fp32 state (native
+    # fused CPU-Adam, the reference's DeepSpeedCPUAdam design): per step only
+    # compute-dtype grads/params cross the bus. Opt-in because a remote-relay
+    # dev setup pays the wire for the grad hop; on a real TPU-VM this is the
+    # intended ZeRO-Offload tier.
+    use_cpu_adam: bool = False
 
     @property
     def enabled(self) -> bool:

@@ -659,13 +659,17 @@ def export_hf_state_dict(params, cfg, *, family: Optional[str] = None
     Completes the interop contract (load_hf_params round-trips through it)."""
     import jax
     params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), params)
-    if (family in ("opt", "bloom", "mixtral") or cfg.num_experts > 1
-            or cfg.activation == "relu" or cfg.position_type == "alibi"):
+    if (family in ("opt", "bloom", "mixtral", "bert", "roberta", "gptj",
+                   "gpt_neox")
+            or cfg.num_experts > 1
+            or cfg.activation == "relu" or cfg.position_type == "alibi"
+            or cfg.parallel_block or not cfg.causal or not cfg.qkv_bias
+            or cfg.type_vocab_size or cfg.head_bias):
         raise NotImplementedError(
             "export_hf_state_dict covers the Llama and GPT-2 layouts; "
-            "Mixtral/OPT/BLOOM export is import-only for now (a gelu-OPT "
-            "tree is structurally gpt2-shaped — pass family='opt' to get "
-            "this error instead of a gpt2-layout dict)")
+            "Mixtral/OPT/BLOOM/BERT/GPT-J/GPT-NeoX export is import-only "
+            "for now (a gelu-OPT tree is structurally gpt2-shaped — pass "
+            "family='opt' to get this error instead of a gpt2-layout dict)")
     fam = family or ("gpt2" if cfg.position_type == "learned" else "llama")
     sd: Dict[str, np.ndarray] = {}
     lp = params["layers"]
@@ -981,7 +985,9 @@ def load_megatron_params(sources, cfg, dtype=None) -> Dict[str, Any]:
             elif key.endswith("final_layernorm.bias"):
                 params["final_norm_bias"] = vals[0]
             elif "output_layer" in key or "lm_head" in key:
-                params["lm_head"] = _t(np.concatenate(vals, axis=0))
+                # vocab dim may be Megatron-padded (divisible-by rounding)
+                params["lm_head"] = _t(
+                    np.concatenate(vals, axis=0)[:cfg.vocab_size])
             elif "_extra_state" in key or "rotary" in key:
                 continue
             else:

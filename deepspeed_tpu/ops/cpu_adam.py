@@ -1,0 +1,173 @@
+"""Native host-side fused AdamW (ctypes binding).
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py:12`` (DeepSpeedCPUAdam) over
+``csrc/adam/cpu_adam.cpp`` — the compute half of ZeRO-Offload: fp32
+master/m/v stay in host DRAM and the optimizer runs on host cores, so per
+step only bf16 grads cross down and bf16 params cross up (4 bytes/param
+instead of 28). Built JIT with g++ -O3 -march=native -fopenmp (the
+autovectorizer covers the reference's hand-rolled AVX macros).
+"""
+
+import ctypes
+import hashlib
+import math
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "adam", "dstpu_cpu_adam.cpp")
+
+_LIB = None
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("DSTPU_CACHE_DIR") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "deepspeed_tpu")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_cache_dir(), f"libdstpu_cpu_adam-{digest}.so")
+    if os.path.exists(so):
+        return so
+    tmp = f"{so}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return so
+    except Exception as e:  # pragma: no cover - toolchain missing
+        logger.warning(f"cpu_adam build failed: {e}")
+        return None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    so = _build()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.dstpu_adam_step_bf16.argtypes = [
+        f32p, f32p, f32p, u16p, u16p, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float]
+    lib.dstpu_adam_step_f32.argtypes = [
+        f32p, f32p, f32p, f32p, f32p, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float]
+    lib.dstpu_sq_norm_bf16.restype = ctypes.c_double
+    lib.dstpu_sq_norm_bf16.argtypes = [u16p, ctypes.c_int64]
+    lib.dstpu_sq_norm_f32.restype = ctypes.c_double
+    lib.dstpu_sq_norm_f32.argtypes = [f32p, ctypes.c_int64]
+    _LIB = lib
+    return lib
+
+
+def cpu_adam_available() -> bool:
+    return _load() is not None
+
+
+class CPUAdam:
+    """Fused host AdamW over flat fp32 state buffers (master, m, v).
+
+    State lives in numpy host memory owned by this object; step() consumes
+    a flat grad array (bf16-bits uint16 or float32) and returns the updated
+    params as bf16 bits (uint16) or fp32.
+    """
+
+    def __init__(self, n: int, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native cpu_adam library unavailable "
+                               "(g++ build failed)")
+        self._lib = lib
+        self.n = int(n)
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.wd = weight_decay
+        self.awm = adamw_mode
+        self.bc = bias_correction
+        self.master = np.zeros(self.n, np.float32)
+        self.m = np.zeros(self.n, np.float32)
+        self.v = np.zeros(self.n, np.float32)
+
+    def load_master(self, params: np.ndarray):
+        np.copyto(self.master, np.asarray(params, np.float32).reshape(-1))
+
+    @staticmethod
+    def _p(arr, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def sq_norm(self, grads: np.ndarray) -> float:
+        g = np.ascontiguousarray(grads).reshape(-1)
+        if g.dtype == np.uint16:
+            return float(self._lib.dstpu_sq_norm_bf16(
+                self._p(g, ctypes.c_uint16), g.size))
+        g = g.astype(np.float32, copy=False)
+        return float(self._lib.dstpu_sq_norm_f32(
+            self._p(g, ctypes.c_float), g.size))
+
+    def step(self, grads: np.ndarray, step_num: int, lr: Optional[float] = None,
+             grad_scale: float = 1.0, out: Optional[np.ndarray] = None):
+        """grads: uint16 (bf16 bits) or float32, length n. Returns updated
+        params (uint16 bf16 bits for bf16 grads, else float32)."""
+        g = np.ascontiguousarray(grads).reshape(-1)
+        if g.size != self.n:
+            raise ValueError(f"grad size {g.size} != state size {self.n}")
+        if self.bc:
+            c1 = 1.0 - self.b1 ** step_num
+            c2 = 1.0 - self.b2 ** step_num
+        else:
+            c1 = c2 = 1.0
+        lr_t = float(self.lr if lr is None else lr)
+        if g.dtype == np.uint16:
+            if out is None:
+                out = np.empty(self.n, np.uint16)
+            self._lib.dstpu_adam_step_bf16(
+                self._p(self.master, ctypes.c_float),
+                self._p(self.m, ctypes.c_float),
+                self._p(self.v, ctypes.c_float),
+                self._p(g, ctypes.c_uint16),
+                self._p(out, ctypes.c_uint16),
+                self.n, lr_t, self.b1, self.b2, self.eps, self.wd,
+                int(self.awm), c1, c2, float(grad_scale))
+            return out
+        g = g.astype(np.float32, copy=False)
+        if out is None:
+            out = np.empty(self.n, np.float32)
+        self._lib.dstpu_adam_step_f32(
+            self._p(self.master, ctypes.c_float),
+            self._p(self.m, ctypes.c_float),
+            self._p(self.v, ctypes.c_float),
+            self._p(g, ctypes.c_float),
+            self._p(out, ctypes.c_float),
+            self.n, lr_t, self.b1, self.b2, self.eps, self.wd,
+            int(self.awm), c1, c2, float(grad_scale))
+        return out
+
+    def clip_coef(self, sq_total: float, clip: float,
+                  grad_scale: float = 1.0) -> float:
+        """Global-norm clip coefficient to fold into grad_scale."""
+        gnorm = math.sqrt(sq_total) * grad_scale
+        if clip and clip > 0 and gnorm > clip:
+            return clip / (gnorm + 1e-6)
+        return 1.0
