@@ -366,6 +366,10 @@ class Config(ConfigModel):
     progressive_layer_drop: PLDConfig = config_field(PLDConfig)
     data_efficiency: DataEfficiencyConfig = config_field(DataEfficiencyConfig)
     compression_training: CompressionConfig = config_field(CompressionConfig)
+    # MoQ (reference: runtime/quantize.py Quantizer + "quantize_training"
+    # JSON section): start_bits -> target_bits over quantize_period steps,
+    # optionally eigenvalue-scheduled per layer
+    quantize_training: Dict[str, Any] = config_field({})
     elasticity: ElasticityConfig = config_field(ElasticityConfig)
     autotuning: AutotuningConfig = config_field(AutotuningConfig)
 

@@ -454,6 +454,8 @@ def rotary_embed(x, positions, theta: float, rotary_dim: Optional[int] = None,
 def _use_pallas(cfg: TransformerConfig, seq_len: int) -> bool:
     if cfg.attention_impl == "xla":
         return False
+    if cfg.dtype == jnp.float16:
+        return False  # Mosaic has no f16; fp16 models take the XLA path
     if cfg.position_type == "alibi":
         return False  # additive score bias not in the flash kernel yet
     try:
@@ -491,6 +493,10 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         return ring_attention(q, k, v, current_mesh(), causal=causal,
                               sm_scale=1.0 / math.sqrt(D))
     if cfg.sparse_attention and mask is None and segment_ids is None:
+        if q.dtype == jnp.float16 and jax.default_backend() in ("tpu",
+                                                                "axon"):
+            raise ValueError("sparse_attention kernels cannot run fp16 on "
+                             "TPU (Mosaic has no f16) — use bf16")
         from deepspeed_tpu.ops.sparse_attention import (
             get_sparsity_config, sparse_attention as _sparse_attn)
         sa = dict(cfg.sparse_attention)
@@ -551,6 +557,7 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
     # gets its length-awareness from the decode loop's static read windows)
     use_pallas = (cfg is not None and cfg.attention_impl == "pallas"
                   and cfg.position_type != "alibi"
+                  and q.dtype != jnp.float16  # Mosaic has no f16
                   and jax.default_backend() in ("tpu", "axon") and D >= 64)
     if use_pallas:
         from deepspeed_tpu.ops.decode_attention import decode_attention

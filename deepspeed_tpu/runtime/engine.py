@@ -337,10 +337,9 @@ class Engine:
             if self._pp_mode:
                 raise ValueError("layer-streamed offload with pipeline "
                                  "parallelism is not supported")
-            if config.fp16.enabled:
-                raise ValueError("layer-streamed offload supports bf16 "
-                                 "only (no fp16 loss scaling in the layer-"
-                                 "streamed step)")
+            # fp16 composes: the executor carries host-side dynamic loss
+            # scaling (storage bits stay bf16; the fp32 master in the opt
+            # chunks carries precision)
             if _opt_name(config) not in ("adam", "adamw"):
                 raise ValueError("layer-streamed offload supports the "
                                  f"Adam family only (got "
@@ -513,12 +512,30 @@ class Engine:
             self._act_quant = None
             self._act_quant_on = False
 
+        # --- MoQ (reference: runtime/quantize.py + engine eigenvalue
+        # events): eigenvalue-scheduled quantization of the layer stack
+        from deepspeed_tpu.runtime.quantize import build_moq
+        self._moq = None
+        if config.quantize_training.get("enabled"):
+            from deepspeed_tpu.models.transformer import TransformerConfig
+            if not isinstance(getattr(model, "config", None),
+                              TransformerConfig):
+                raise ValueError("quantize_training (MoQ) requires a "
+                                 "transformer ModelSpec (stacked layers)")
+            if self._pp_mode or _infinity_mode(config):
+                raise ValueError("quantize_training (MoQ) with pipeline or "
+                                 "layer-streamed offload is not supported")
+            if self._onebit_comm:
+                raise ValueError("quantize_training (MoQ) with the 1-bit "
+                                 "compressed-comm path is not supported "
+                                 "(the shard_map step bypasses the param "
+                                 "transform)")
+            self._moq = build_moq(config.quantize_training,
+                                  model.config.num_layers)
+
         # --- state init (sharded at creation; reference: zero.Init equivalent)
         self.state_shardings = None
         if self._infinity:
-            if self._compression is not None:
-                raise ValueError("compression_training with the layer-"
-                                 "streamed offload executor is not supported")
             self.state = None  # streamed: the full tree never materializes
             self._infinity_exec = self._build_infinity()
         else:
@@ -742,8 +759,10 @@ class Engine:
         p = dict(cfg.optimizer.params) if cfg.optimizer else {}
         name = _opt_name(cfg)
         lr = self._schedule if self._schedule is not None else p.get("lr", 1e-3)
+        import dataclasses as _dc
+        model_cfg = _dc.replace(self.model.config, dtype=self.compute_dtype)
         return InfinityExecutor(
-            self.model.config, rng=self._rng,
+            model_cfg, rng=self._rng,
             backend=self._infinity_backend,
             nvme_path=off_p.nvme_path or off_o.nvme_path,
             lr=lr, betas=tuple(p.get("betas", (0.9, 0.999))),
@@ -755,7 +774,9 @@ class Engine:
             grad_clip=cfg.gradient_clipping or 0.0,
             param_cache_bytes=off_p.max_in_cpu,
             gas=cfg.gradient_accumulation_steps,
-            mesh=self.mesh if self._infinity_multi else None)
+            mesh=self.mesh if self._infinity_multi else None,
+            fp16=(dataclasses.asdict(cfg.fp16) if cfg.fp16.enabled else None),
+            compression=self._compression)
 
     def _state_shardings_from(self, state_shapes):
         """Build shardings for the full train-state pytree: params use
@@ -837,7 +858,14 @@ class Engine:
                 return jnp.broadcast_to(x, (gas,))  # _pld_theta): replicate
             return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
-        mbs = jax.tree.map(split, batch)
+        if isinstance(batch, dict):
+            # "_"-prefixed keys are per-step side-channels (_pld_theta,
+            # _moq_bits), replicated across microbatches whatever their rank
+            mbs = {k: (jnp.broadcast_to(v, (gas,) + jnp.shape(v))
+                       if k.startswith("_") else split(v))
+                   for k, v in batch.items()}
+        else:
+            mbs = jax.tree.map(split, batch)
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         if postprocess is not None:
             zeros = postprocess(zeros)
@@ -871,10 +899,14 @@ class Engine:
 
         compression = self._compression
 
+        moq = self._moq
+
         def micro_grads(params, mb, rng, scale, step=None):
             def loss_fn(p):
                 if compression is not None:
                     p = compression.apply(p, step if step is not None else 0)
+                if moq is not None and "_moq_bits" in mb:
+                    p = moq.apply(p, mb["_moq_bits"])
                 loss = model.loss_fn(p, mb, rng, False)
                 if fp16:
                     loss = loss * scale.astype(loss.dtype)
@@ -1167,11 +1199,24 @@ class Engine:
             batch = dict(batch)
             batch["_pld_theta"] = np.float32(theta)  # traced input: the
             # continuously-decaying theta must not retrigger compilation
+        if self._moq is not None:
+            if self._moq.wants_eigenvalues(self.global_steps) \
+                    and self.state is not None:
+                evs = self._moq.layer_eigenvalues(
+                    self.model.loss_fn, self.state["params"],
+                    self._device_batch(batch), rng=sub)
+                self._moq.update_eigenvalues(evs, self.global_steps)
+            batch = dict(batch)
+            # traced [L] side-channel: schedule/eigenvalue updates must not
+            # retrigger compilation
+            batch["_moq_bits"] = self._moq.bits(self.global_steps)
         if self._infinity:
             # unsharded single-device executor: no mesh batch placement
             metrics = self._infinity_exec.train_batch(batch)
             self.global_steps += 1
             self.micro_steps += self.config.gradient_accumulation_steps
+            if self._fp16 and bool(metrics.get("overflow")):
+                self.skipped_steps += 1
             self.tput_timer.stop()
             self._log_step(dict(metrics))
             return metrics
@@ -1402,6 +1447,13 @@ class Engine:
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
             s = P(*spec[:min(x.ndim, len(spec))])  # 0-d leaves → replicated
             return jax.device_put(x, NamedSharding(self.mesh, s))
+        repl = NamedSharding(self.mesh, P())
+        if isinstance(batch, dict):
+            # "_"-prefixed side-channels (_pld_theta, _moq_bits) replicate:
+            # their leading dim is NOT the batch dim
+            return {k: (jax.device_put(jnp.asarray(v), repl)
+                        if k.startswith("_") else put(v))
+                    for k, v in batch.items()}
         return jax.tree.map(put, batch)
 
     def _log_step(self, metrics):
@@ -1541,6 +1593,8 @@ class Engine:
         os.makedirs(path, exist_ok=True)
         small = self._infinity_exec.save_checkpoint(path)
         client_state["applied_steps"] = small.pop("applied_steps")
+        if "loss_scale" in small:
+            client_state["loss_scale"] = small.pop("loss_scale")
         flat = _flatten_dict({"nl_params": small["nl_params"],
                               "nl_opt": small["nl_opt"]})
         dtypes, arrays = {}, {}
@@ -1577,9 +1631,11 @@ class Engine:
                 flat[key] = arr
         tree = _unflatten_dict(flat)
         client_state = meta["client_state"]
-        self._infinity_exec.load_checkpoint(
-            path, {"nl_params": tree["nl_params"], "nl_opt": tree["nl_opt"],
-                   "applied_steps": client_state.get("applied_steps", 0)})
+        small = {"nl_params": tree["nl_params"], "nl_opt": tree["nl_opt"],
+                 "applied_steps": client_state.get("applied_steps", 0)}
+        if "loss_scale" in client_state:
+            small["loss_scale"] = client_state["loss_scale"]
+        self._infinity_exec.load_checkpoint(path, small)
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))

@@ -195,7 +195,8 @@ class InfinityExecutor:
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
                  bias_correction: bool = True, grad_clip: float = 0.0,
                  backend: str = "nvme", param_cache_bytes: int = 0,
-                 gas: int = 1, mesh=None):
+                 gas: int = 1, mesh=None, fp16: Optional[Dict[str, Any]] = None,
+                 compression=None):
         if model_cfg.num_experts > 1:
             raise ValueError("offload_param.device=nvme supports dense "
                              "transformers (MoE experts not yet streamed)")
@@ -210,6 +211,26 @@ class InfinityExecutor:
         self.clip = grad_clip
         self.gas = gas
         self.applied_steps = 0
+        # fp16 dynamic loss scaling, host-side (reference: the loss-scaler
+        # state the fp16 optimizers carry, runtime/fp16/loss_scaler.py:84).
+        # Storage bits stay bf16; compute runs in cfg.dtype (fp16), the
+        # fp32 master in the opt chunk carries the precision.
+        self.fp16 = dict(fp16) if fp16 else None
+        if self.fp16:
+            static = float(self.fp16.get("loss_scale", 0.0) or 0.0)
+            self._dynamic_scale = static == 0.0     # reference: 0 = dynamic
+            self._scale = (static if not self._dynamic_scale else
+                           float(2.0 ** self.fp16.get("initial_scale_power",
+                                                      16)))
+            self._scale_window = int(self.fp16.get("loss_scale_window", 1000))
+            self._min_scale = float(self.fp16.get("min_loss_scale", 1.0))
+            self._hysteresis = int(self.fp16.get("hysteresis", 2))
+            self._good_steps = 0
+            self._hyst_left = self._hysteresis
+        # compression transform applied to each streamed layer's params
+        # (path-compatible with the monolithic engine path: the per-layer
+        # tree is wrapped under "layers/", masks computed per layer)
+        self.compression = compression
 
         L = self.cfg.num_layers
         # per-layer leaf template from a single-layer config (shapes only)
@@ -319,7 +340,9 @@ class InfinityExecutor:
             # (reduce-scatter), activations stay batch-sharded
             return jax.lax.with_sharding_constraint(t, spec) if multi else t
 
-        def unflatten(flat_bits):
+        compression = self.compression
+
+        def unflatten(flat_bits, step=None):
             """uint16 bf16-bits (C,) -> layer param pytree (compute dtype)."""
             flat = jax.lax.bitcast_convert_type(flat_bits, jnp.bfloat16)
             # one explicit all-gather of the bf16 chunk (the ZeRO-3 fetch);
@@ -331,10 +354,17 @@ class InfinityExecutor:
                 out.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
                            .reshape(shape))
                 off += size
-            return jax.tree.unflatten(treedef, out)
+            tree = jax.tree.unflatten(treedef, out)
+            if compression is not None:
+                # same leaf paths as the monolithic engine path sees
+                # ("layers/<name>"); masks are per-layer here
+                tree = compression.apply(
+                    {"layers": tree},
+                    step if step is not None else 0)["layers"]
+            return tree
 
-        def layer_fwd(flat_bits, x, mask, positions):
-            p = unflatten(flat_bits)
+        def layer_fwd(flat_bits, x, mask, positions, step):
+            p = unflatten(flat_bits, step)
             y, _aux = transformer_layer(x, p, cfg, mask=mask,
                                         positions=positions,
                                         deterministic=True)
@@ -342,7 +372,7 @@ class InfinityExecutor:
 
         self._layer_fwd = jax.jit(layer_fwd)
 
-        def layer_bwd(flat_bits, x, dy, mask, positions):
+        def layer_bwd(flat_bits, x, dy, mask, positions, step):
             """Recompute-VJP for one layer: returns (flat fp32 grads, dx,
             grad sq-norm). The fwd recompute inside vjp IS the remat."""
             def f(bits_f32, x):
@@ -353,6 +383,8 @@ class InfinityExecutor:
                     .reshape(shape).astype(cfg.dtype)
                     for off, size, shape in zip(
                         np.cumsum([0] + sizes[:-1]).tolist(), sizes, shapes)])
+                if compression is not None:
+                    p = compression.apply({"layers": p}, step)["layers"]
                 y, _aux = transformer_layer(x, p, cfg, mask=mask,
                                             positions=positions,
                                             deterministic=True)
@@ -387,9 +419,11 @@ class InfinityExecutor:
             c = cfg.loss_chunk if cfg.loss_chunk else min(1024, x.shape[1])
             return chunked_cross_entropy(h, head, labels, c)
 
-        def top_fwd_bwd(nl, x, labels):
+        def top_fwd_bwd(nl, x, labels, scale):
+            def scaled(nl, x):
+                return top_loss(nl, x, labels) * scale
             (loss, (dnl, dx)) = jax.value_and_grad(
-                top_loss, argnums=(0, 1))(nl, x, labels)
+                scaled, argnums=(0, 1))(nl, x)
             return loss, dnl, wsc(dx, x_spec)
 
         self._top_fwd_bwd = jax.jit(top_fwd_bwd)
@@ -652,6 +686,9 @@ class InfinityExecutor:
         loss_sum = 0.0
         sq_layer = [0.0] * L
 
+        scale = self._scale if self.fp16 else 1.0
+        scale_t = jnp.float32(scale)
+        step_t = jnp.int32(self.applied_steps)
         for g in range(gas):
             sl = slice(g * mb, (g + 1) * mb) if gas > 1 else slice(None)
             ids, labels = ids_all[sl], labels_all[sl]
@@ -665,12 +702,12 @@ class InfinityExecutor:
             for i in range(L):
                 bits = self._resolve_param(fut, i)
                 fut = self._fetch_param_async(i + 1) if i + 1 < L else None
-                x = self._layer_fwd(bits, x, mask, positions)
+                x = self._layer_fwd(bits, x, mask, positions, step_t)
                 acts.append(x)
 
             loss, dnl_top, dx = self._top_fwd_bwd(self.nl_params, acts[L],
-                                                  labels)
-            loss_sum += float(np.asarray(jax.device_get(loss)))
+                                                  labels, scale_t)
+            loss_sum += float(np.asarray(jax.device_get(loss))) / scale
 
             # ---- backward sweep (reverse, prefetch one behind) ----
             last_mb = g == gas - 1
@@ -679,7 +716,7 @@ class InfinityExecutor:
                 bits = self._resolve_param(fut, i)
                 fut = self._fetch_param_async(i - 1) if i > 0 else None
                 dp, dx, sq = self._layer_bwd(bits, acts[i], dx, mask,
-                                             positions)
+                                             positions, step_t)
                 acts[i + 1] = None  # free the activation as we pass it
                 if self._pinned:
                     if grad_stage[i] is not None:  # accumulate on device
@@ -703,7 +740,7 @@ class InfinityExecutor:
             nl_grads = dnl if nl_grads is None else self._tree_add(nl_grads,
                                                                    dnl)
 
-        # ---- global grad norm + clip coefficient ----
+        # ---- global grad norm + overflow + clip coefficient ----
         inv = 1.0 / gas
         sq_total = 0.0
         for i in range(L):
@@ -715,10 +752,21 @@ class InfinityExecutor:
             sq_total += s
         nl_sq = float(np.asarray(jax.device_get(
             self._nl_sq(nl_grads, jnp.float32(inv)))))
-        gnorm = math.sqrt(sq_total + nl_sq)
-        coef = inv
+        if self.fp16 and not np.isfinite(sq_total + nl_sq):
+            # overflow: nothing is written (chunks untouched), the loss
+            # scale shrinks — reference: loss_scaler.py:84 + step:1635
+            self._on_overflow()
+            self._drain_write()
+            return {"loss": jnp.float32(loss_sum / gas),
+                    "grad_norm": jnp.float32(float("nan")),
+                    "overflow": jnp.asarray(True),
+                    "loss_scale": jnp.float32(self._scale)}
+        gnorm = math.sqrt(sq_total + nl_sq) / scale
+        coef = inv / scale
         if self.clip and self.clip > 0 and gnorm > self.clip:
             coef *= self.clip / (gnorm + 1e-6)
+        if self.fp16:
+            self._on_good_step()
 
         # ---- update sweep ----
         self.applied_steps += 1
@@ -762,9 +810,30 @@ class InfinityExecutor:
             del opt_dev, new_buf, new_bits
         self._drain_write()
 
-        return {"loss": jnp.float32(loss_sum / gas),
-                "grad_norm": jnp.float32(gnorm),
-                "overflow": jnp.zeros((), jnp.bool_)}
+        out = {"loss": jnp.float32(loss_sum / gas),
+               "grad_norm": jnp.float32(gnorm),
+               "overflow": jnp.zeros((), jnp.bool_)}
+        if self.fp16:
+            out["loss_scale"] = jnp.float32(scale)
+        return out
+
+    def _on_overflow(self):
+        if not self._dynamic_scale:
+            return  # static scale: overflow skips the step, scale holds
+        self._hyst_left -= 1
+        if self._hyst_left <= 0:
+            self._scale = max(self._min_scale, self._scale / 2.0)
+            self._hyst_left = self._hysteresis
+        self._good_steps = 0
+
+    def _on_good_step(self):
+        if not self._dynamic_scale:
+            return
+        self._good_steps += 1
+        if self._good_steps >= self._scale_window:
+            self._scale *= 2.0
+            self._good_steps = 0
+            self._hyst_left = self._hysteresis
 
     def eval_batch(self, batch):
         L = self.cfg.num_layers
@@ -775,7 +844,8 @@ class InfinityExecutor:
             for i in range(L):
                 bits = self._resolve_param(fut, i)
                 fut = self._fetch_param_async(i + 1) if i + 1 < L else None
-                x = self._layer_fwd(bits, x, mask, None)
+                x = self._layer_fwd(bits, x, mask, None,
+                                    jnp.int32(self.applied_steps))
             return self._top_loss(self.nl_params, x, labels)
 
     # ------------------------------------------------------------------
@@ -795,9 +865,13 @@ class InfinityExecutor:
                         "num_layers": self.cfg.num_layers,
                         "leaf_names": leaf_names,
                         "leaf_shapes": [list(s) for s in self._shapes]}, f)
-        return {"nl_params": jax.device_get(self.nl_params),
-                "nl_opt": jax.device_get(self.nl_opt),
-                "applied_steps": self.applied_steps}
+        out = {"nl_params": jax.device_get(self.nl_params),
+               "nl_opt": jax.device_get(self.nl_opt),
+               "applied_steps": self.applied_steps}
+        if self.fp16:
+            out["loss_scale"] = [self._scale, self._good_steps,
+                                 self._hyst_left]
+        return out
 
     def load_checkpoint(self, path: str, small_state: Dict[str, Any]):
         import json as _json
@@ -824,6 +898,10 @@ class InfinityExecutor:
             self.nl_params = jax.device_put(self.nl_params, self._repl_dev_sh)
             self.nl_opt = jax.device_put(self.nl_opt, self._repl_dev_sh)
         self.applied_steps = int(small_state["applied_steps"])
+        if self.fp16 and "loss_scale" in small_state:
+            s, g, h = [float(x) for x in np.asarray(
+                small_state["loss_scale"]).reshape(-1)]
+            self._scale, self._good_steps, self._hyst_left = s, int(g), int(h)
 
     def close(self):
         self._drain_write()

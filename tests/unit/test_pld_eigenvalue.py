@@ -123,3 +123,76 @@ class TestEigenvalue:
         ev = Eigenvalue(max_iterations=8).compute_eigenvalue(
             lambda p: lm_loss(p, {"input_ids": ids}, cfg), params)
         assert np.isfinite(ev) and ev > 0
+
+
+class TestMoQ:
+    """MoQ wiring (VERDICT r3 item 9; reference: runtime/quantize.py:11 +
+    engine.py:1816 eigenvalue events): start->target bits over a period,
+    per-layer periods stretched by layer curvature."""
+
+    def _model(self):
+        from deepspeed_tpu.models import TransformerConfig, make_model
+        return make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=32, dtype=jnp.float32, attention_impl="xla"))
+
+    def _cfg(self, ev=False):
+        return {"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False}, "steps_per_print": 1000,
+                "quantize_training": {
+                    "enabled": True,
+                    "quantize_bits": {"start_bits": 12, "target_bits": 4},
+                    "quantize_schedule": {"quantize_period": 2,
+                                          "schedule_offset": 0},
+                    "eigenvalue": {"enabled": ev, "max_iter": 3,
+                                   "gas_boundary_resolution": 1}}}
+
+    def test_schedule_walks_bits_down(self):
+        from deepspeed_tpu.runtime.quantize import MoQ
+        moq = MoQ(self._cfg()["quantize_training"], num_layers=2)
+        assert moq.bits(0).tolist() == [12.0, 12.0]
+        assert moq.bits(4).tolist() == [10.0, 10.0]
+        assert moq.bits(100).tolist() == [4.0, 4.0]  # clipped at target
+        # eigenvalue stretch: layer 1 has 3x the curvature -> longer period
+        moq.update_eigenvalues(np.array([1.0, 3.0]), step=0)
+        b = moq.bits(4)
+        assert b[0] < b[1], b
+
+    def test_transform_bites(self):
+        """The traced transform must actually quantize (2 bits moves every
+        matmul weight measurably)."""
+        import jax
+        from deepspeed_tpu.runtime.quantize import MoQ
+        m = self._model()
+        p = m.init(jax.random.PRNGKey(0))
+        moq = MoQ({"quantize_bits": {"start_bits": 2, "target_bits": 2},
+                   "quantize_schedule": {"quantize_period": 1}}, num_layers=2)
+        pq = moq.apply(p, jnp.asarray(moq.bits(100)))
+        d = max(float(jnp.abs(a - b).max()) for a, b in
+                zip(jax.tree.leaves(p["layers"]),
+                    jax.tree.leaves(pq["layers"])))
+        assert d > 1e-3, d
+
+    def test_trains_and_quantizes(self):
+        import deepspeed_tpu
+        engine, *_ = deepspeed_tpu.initialize(model=self._model(),
+                                              config=self._cfg())
+        assert engine._moq is not None
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 64, (8, 32), dtype=np.int32)}
+        losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+
+    def test_eigenvalue_refresh_updates_periods(self):
+        import deepspeed_tpu
+        engine, *_ = deepspeed_tpu.initialize(model=self._model(),
+                                              config=self._cfg(ev=True))
+        rng = np.random.default_rng(1)
+        batch = {"input_ids": rng.integers(0, 64, (8, 32), dtype=np.int32)}
+        engine.train_batch(batch)
+        moq = engine._moq
+        assert moq._last_ev_step >= 0          # refresh ran at step 0
+        assert not np.allclose(moq._period_scale, 1.0)  # per-layer scales
+        losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+        assert np.isfinite(losses).all()
