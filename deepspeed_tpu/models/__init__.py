@@ -6,3 +6,6 @@ from deepspeed_tpu.models.transformer import (
 from deepspeed_tpu.models.hf_import import (
     load_hf_params, export_hf_state_dict, hf_config_to_transformer,
 )
+from deepspeed_tpu.models.unet import (
+    UNetConfig, make_unet_model, unet_forward, denoise_loss,
+)

@@ -1,0 +1,266 @@
+"""Diffusion UNet family — the spatial/diffusers corner, TPU-first.
+
+Reference coverage: ``deepspeed/model_implementations/diffusers/unet.py`` /
+``vae.py`` (CUDA-graphed UNet/VAE wrappers), the diffusers attention policy
+(``module_inject/containers/unet.py``, ``clip.py``, ``vae.py``) and the
+spatial kernels (``csrc/spatial/csrc/opt_bias_add.cu``).
+
+TPU-native re-design: the reference's pieces dissolve into the compiler —
+CUDA-graph capture is jit caching, and the fused bias-add variants are
+ordinary XLA fusions (conv + bias + nonlinearity fuse without a kernel,
+SURVEY §2.11 "spatial: XLA fusion, no kernel needed"). What remains real is
+the MODEL: a residual UNet with timestep embeddings and bottleneck
+self-attention, expressed as a ModelSpec so the training engine (any ZeRO
+stage) and the inference engine accept it like any transformer.
+
+Layout is NHWC (TPU conv layout); channels carry the "mlp" logical axis so
+tensor parallelism column-shards conv output channels the same way it
+shards MLP weights.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    base_channels: int = 64
+    channel_mults: Tuple[int, ...] = (1, 2)
+    num_res_blocks: int = 1
+    time_embed_dim: int = 256
+    attn_heads: int = 4              # bottleneck self-attention
+    norm_groups: int = 8
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def _timestep_embedding(t, dim: int):
+    """Sinusoidal timestep embedding (the DDPM convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _conv(x, w, b=None, stride: int = 1):
+    y = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        y = y + b.astype(x.dtype)   # bias-add fuses into the conv epilogue
+    return y
+
+
+def _group_norm(x, scale, bias, groups: int):
+    B, H, W, C = x.shape
+    g = math.gcd(groups, C)
+    x32 = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mean = x32.mean(axis=(1, 2, 4), keepdims=True)
+    var = x32.var(axis=(1, 2, 4), keepdims=True)
+    x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+    x32 = x32.reshape(B, H, W, C)
+    return (x32 * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _init_conv(key, kh, kw, cin, cout, dt, scale=None):
+    fan_in = kh * kw * cin
+    s = scale if scale is not None else 1.0 / math.sqrt(fan_in)
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * s).astype(dt)
+
+
+def _res_block_params(key, cin, cout, temb, dt):
+    ks = jax.random.split(key, 4)
+    p = {
+        "norm1_scale": jnp.ones((cin,), dt), "norm1_bias": jnp.zeros((cin,), dt),
+        "conv1": _init_conv(ks[0], 3, 3, cin, cout, dt),
+        "conv1_b": jnp.zeros((cout,), dt),
+        "temb_w": (jax.random.normal(ks[1], (temb, cout)) / math.sqrt(temb)).astype(dt),
+        "temb_b": jnp.zeros((cout,), dt),
+        "norm2_scale": jnp.ones((cout,), dt), "norm2_bias": jnp.zeros((cout,), dt),
+        "conv2": _init_conv(ks[2], 3, 3, cout, cout, dt, scale=1e-4),
+        "conv2_b": jnp.zeros((cout,), dt),
+    }
+    if cin != cout:
+        p["skip"] = _init_conv(ks[3], 1, 1, cin, cout, dt)
+    return p
+
+
+def _res_block(x, emb, p, cfg: UNetConfig):
+    h = _group_norm(x, p["norm1_scale"], p["norm1_bias"], cfg.norm_groups)
+    h = _conv(jax.nn.silu(h), p["conv1"], p["conv1_b"])
+    h = h + (jax.nn.silu(emb) @ p["temb_w"].astype(emb.dtype)
+             + p["temb_b"].astype(emb.dtype))[:, None, None, :]
+    h = _group_norm(h, p["norm2_scale"], p["norm2_bias"], cfg.norm_groups)
+    h = _conv(jax.nn.silu(h), p["conv2"], p["conv2_b"])
+    skip = _conv(x, p["skip"]) if "skip" in p else x
+    return skip + h
+
+
+def _attn_params(key, c, dt):
+    ks = jax.random.split(key, 4)
+    s = 1.0 / math.sqrt(c)
+    return {"norm_scale": jnp.ones((c,), dt), "norm_bias": jnp.zeros((c,), dt),
+            "wq": (jax.random.normal(ks[0], (c, c)) * s).astype(dt),
+            "wk": (jax.random.normal(ks[1], (c, c)) * s).astype(dt),
+            "wv": (jax.random.normal(ks[2], (c, c)) * s).astype(dt),
+            "wo": (jax.random.normal(ks[3], (c, c)) * 1e-4).astype(dt)}
+
+
+def _spatial_attention(x, p, cfg: UNetConfig):
+    """Bottleneck self-attention over H*W tokens (the diffusers
+    AttentionBlock; reference wraps it with the CLIP/UNet policy)."""
+    B, H, W, C = x.shape
+    h = _group_norm(x, p["norm_scale"], p["norm_bias"], cfg.norm_groups)
+    tok = h.reshape(B, H * W, C)
+    nh = cfg.attn_heads
+    hd = C // nh
+    q = (tok @ p["wq"].astype(tok.dtype)).reshape(B, H * W, nh, hd)
+    k = (tok @ p["wk"].astype(tok.dtype)).reshape(B, H * W, nh, hd)
+    v = (tok @ p["wv"].astype(tok.dtype)).reshape(B, H * W, nh, hd)
+    s = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32)
+    a = jax.nn.softmax(s / math.sqrt(hd), axis=-1).astype(tok.dtype)
+    o = jnp.einsum("bnst,btnd->bsnd", a, v).reshape(B, H * W, C)
+    o = o @ p["wo"].astype(o.dtype)
+    return x + o.reshape(B, H, W, C)
+
+
+def init_unet_params(key, cfg: UNetConfig) -> Params:
+    dt = cfg.param_dtype
+    ks = iter(jax.random.split(key, 64))
+    ch = cfg.base_channels
+    temb = cfg.time_embed_dim
+    p: Params = {
+        "temb_w1": (jax.random.normal(next(ks), (temb, temb))
+                    / math.sqrt(temb)).astype(dt),
+        "temb_b1": jnp.zeros((temb,), dt),
+        "temb_w2": (jax.random.normal(next(ks), (temb, temb))
+                    / math.sqrt(temb)).astype(dt),
+        "temb_b2": jnp.zeros((temb,), dt),
+        "conv_in": _init_conv(next(ks), 3, 3, cfg.in_channels, ch, dt),
+        "conv_in_b": jnp.zeros((ch,), dt),
+    }
+    chans = [ch]
+    c = ch
+    for li, mult in enumerate(cfg.channel_mults):
+        cout = ch * mult
+        for bi in range(cfg.num_res_blocks):
+            p[f"down_{li}_{bi}"] = _res_block_params(next(ks), c, cout,
+                                                     temb, dt)
+            c = cout
+            chans.append(c)
+        if li != len(cfg.channel_mults) - 1:
+            p[f"down_{li}_pool"] = _init_conv(next(ks), 3, 3, c, c, dt)
+            p[f"down_{li}_pool_b"] = jnp.zeros((c,), dt)
+            chans.append(c)
+    p["mid_block1"] = _res_block_params(next(ks), c, c, temb, dt)
+    p["mid_attn"] = _attn_params(next(ks), c, dt)
+    p["mid_block2"] = _res_block_params(next(ks), c, c, temb, dt)
+    for li, mult in reversed(list(enumerate(cfg.channel_mults))):
+        cout = ch * mult
+        for bi in range(cfg.num_res_blocks + 1):
+            p[f"up_{li}_{bi}"] = _res_block_params(
+                next(ks), c + chans.pop(), cout, temb, dt)
+            c = cout
+        if li != 0:
+            p[f"up_{li}_conv"] = _init_conv(next(ks), 3, 3, c, c, dt)
+            p[f"up_{li}_conv_b"] = jnp.zeros((c,), dt)
+    p["norm_out_scale"] = jnp.ones((c,), dt)
+    p["norm_out_bias"] = jnp.zeros((c,), dt)
+    p["conv_out"] = _init_conv(next(ks), 3, 3, c, cfg.out_channels, dt,
+                               scale=1e-4)
+    p["conv_out_b"] = jnp.zeros((cfg.out_channels,), dt)
+    return p
+
+
+def unet_forward(params: Params, x, t, cfg: UNetConfig):
+    """x: [B, H, W, in_channels]; t: [B] diffusion timestep -> eps
+    prediction [B, H, W, out_channels]."""
+    x = x.astype(cfg.dtype)
+    emb = _timestep_embedding(t, cfg.time_embed_dim).astype(cfg.dtype)
+    emb = jax.nn.silu(emb @ params["temb_w1"].astype(cfg.dtype)
+                      + params["temb_b1"].astype(cfg.dtype))
+    emb = emb @ params["temb_w2"].astype(cfg.dtype) \
+        + params["temb_b2"].astype(cfg.dtype)
+
+    h = _conv(x, params["conv_in"], params["conv_in_b"])
+    skips = [h]
+    for li, mult in enumerate(cfg.channel_mults):
+        for bi in range(cfg.num_res_blocks):
+            h = _res_block(h, emb, params[f"down_{li}_{bi}"], cfg)
+            skips.append(h)
+        if li != len(cfg.channel_mults) - 1:
+            h = _conv(h, params[f"down_{li}_pool"],
+                      params[f"down_{li}_pool_b"], stride=2)
+            skips.append(h)
+    h = _res_block(h, emb, params["mid_block1"], cfg)
+    h = _spatial_attention(h, params["mid_attn"], cfg)
+    h = _res_block(h, emb, params["mid_block2"], cfg)
+    for li, mult in reversed(list(enumerate(cfg.channel_mults))):
+        for bi in range(cfg.num_res_blocks + 1):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = _res_block(h, emb, params[f"up_{li}_{bi}"], cfg)
+        if li != 0:
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+            h = _conv(h, params[f"up_{li}_conv"], params[f"up_{li}_conv_b"])
+    h = _group_norm(h, params["norm_out_scale"], params["norm_out_bias"],
+                    cfg.norm_groups)
+    out = _conv(jax.nn.silu(h), params["conv_out"], params["conv_out_b"])
+    return out.astype(jnp.float32)
+
+
+def unet_logical_axes(cfg: UNetConfig) -> Params:
+    """Conv kernels column-shard their OUTPUT channels over the tensor axis
+    (the "mlp" rule) — the AutoTP analogue for spatial models."""
+    shapes = jax.eval_shape(lambda k: init_unet_params(k, cfg),
+                            jax.random.PRNGKey(0))
+
+    def one(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if leaf.ndim == 4:   # conv HWIO: shard output channels
+            return (None, None, None, "mlp")
+        if leaf.ndim == 2:   # dense [in, out]
+            return ("embed", "mlp")
+        return ("unmodeled",)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    return jax.tree_util.tree_unflatten(
+        treedef, [one(p, l) for p, l in flat])
+
+
+def denoise_loss(params: Params, batch: Dict[str, Any], cfg: UNetConfig,
+                 rng=None, deterministic: bool = True):
+    """Standard DDPM epsilon-prediction MSE. batch: {"x": noisy input,
+    "t": timesteps, "target": the noise to predict}."""
+    pred = unet_forward(params, jnp.asarray(batch["x"]),
+                        jnp.asarray(batch["t"]), cfg)
+    target = jnp.asarray(batch["target"], jnp.float32)
+    return jnp.mean(jnp.square(pred - target))
+
+
+def make_unet_model(cfg: UNetConfig, name: str = "unet"):
+    """ModelSpec for the engines: train with any ZeRO stage, run under
+    init_inference (which treats non-transformer specs as plain jitted
+    forwards — no KV cache, no GEMV fusion)."""
+    from deepspeed_tpu.models.transformer import ModelSpec
+    return ModelSpec(
+        init=lambda key: init_unet_params(key, cfg),
+        loss_fn=lambda params, batch, rng=None, deterministic=True:
+            denoise_loss(params, batch, cfg, rng, deterministic),
+        apply=lambda params, x, t=None, **kw: unet_forward(
+            params, x, t if t is not None else jnp.zeros(
+                (jnp.asarray(x).shape[0],), jnp.int32), cfg),
+        logical_axes=unet_logical_axes(cfg),
+        config=cfg,
+        name=name,
+    )

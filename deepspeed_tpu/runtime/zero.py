@@ -49,12 +49,40 @@ def zero_param_spec(spec: P, shape: Tuple[int, ...], plan: MeshPlan,
     matching `stage3_param_persistence_threshold` semantics.
     """
     if zero_cfg.stage < 3 or plan.fsdp <= 1:
-        return spec
+        return _divisible_spec(spec, shape, plan)
     numel = int(np.prod(shape)) if shape else 1
     if numel <= zero_cfg.stage3_param_persistence_threshold:
-        return P(*[None if ax == "fsdp" or (isinstance(ax, tuple) and "fsdp" in ax)
+        spec = P(*[None if ax == "fsdp" or (isinstance(ax, tuple) and "fsdp" in ax)
                    else ax for ax in spec])
-    return spec
+    return _divisible_spec(spec, shape, plan)
+
+
+def _divisible_spec(spec: P, shape: Tuple[int, ...], plan: MeshPlan) -> P:
+    """Drop axis assignments whose dim the mesh axis size does not divide
+    (e.g. a conv's 3-channel output on an fsdp=8 mesh): such params stay
+    replicated on that dim instead of failing sharding validation."""
+    sizes = plan.axis_sizes()
+    entries = _axis_entries(spec)
+    changed = False
+    for i, e in enumerate(entries):
+        if not e or i >= len(shape):
+            continue
+        kept = []
+        denom = 1
+        for a in e:
+            n = sizes.get(a, 1)
+            if shape[i] % (denom * n) == 0:
+                kept.append(a)
+                denom *= n
+        if len(kept) != len(e):
+            entries[i] = tuple(kept)
+            changed = True
+    if not changed:
+        return spec
+    out = [tuple(e) if len(e) > 1 else (e[0] if e else None) for e in entries]
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
 
 
 def _axis_entries(spec: P):

@@ -1,0 +1,83 @@
+"""Diffusion UNet family (reference: model_implementations/diffusers/
+{unet,vae}.py + module_inject containers for UNet/CLIP/VAE + csrc/spatial).
+The TPU equivalents of the reference's wrappers are jit caching and XLA
+conv-bias fusion; what these tests pin down is the real surface: a spatial
+ModelSpec trains under the engine (ZeRO stages) and runs under
+init_inference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import UNetConfig, make_unet_model, unet_forward
+
+
+def _cfg():
+    return UNetConfig(in_channels=3, out_channels=3, base_channels=16,
+                      channel_mults=(1, 2), num_res_blocks=1,
+                      time_embed_dim=32, attn_heads=2, norm_groups=4,
+                      dtype=jnp.float32)
+
+
+def _batch(B=4, H=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.normal(size=(B, H, H, 3)).astype(np.float32),
+            "t": rng.integers(0, 1000, (B,)).astype(np.int32),
+            "target": rng.normal(size=(B, H, H, 3)).astype(np.float32)}
+
+
+def test_forward_shapes_and_grads():
+    cfg = _cfg()
+    model = make_unet_model(cfg)
+    p = model.init(jax.random.PRNGKey(0))
+    b = _batch()
+    out = unet_forward(p, jnp.asarray(b["x"]), jnp.asarray(b["t"]), cfg)
+    assert out.shape == (4, 16, 16, 3)
+    loss, grads = jax.value_and_grad(model.loss_fn)(p, b)
+    assert np.isfinite(float(loss))
+    gn = sum(float(jnp.sum(jnp.square(g))) for g in jax.tree.leaves(grads))
+    assert gn > 0
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_trains_under_engine(stage):
+    model = make_unet_model(_cfg())
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": False},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0},
+        "steps_per_print": 1000})
+    b = _batch(B=8)
+    losses = [float(engine.train_batch(b)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_trains_on_mesh(devices8):
+    """data x tensor mesh: conv output channels column-shard over tensor."""
+    model = make_unet_model(_cfg())
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": False},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"axes": {"data": 4, "tensor": 2}},
+        "steps_per_print": 1000}, devices=devices8)
+    b = _batch(B=4)
+    losses = [float(engine.train_batch(b)["loss"]) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_inference_engine_accepts_spatial_spec():
+    model = make_unet_model(_cfg())
+    eng = deepspeed_tpu.init_inference(model, dtype=jnp.float32)
+    b = _batch(B=2)
+    out = np.asarray(eng.forward(b["x"]))
+    assert out.shape == (2, 16, 16, 3)
+    # timestep-conditioned through the spec's apply
+    out_t = np.asarray(model.apply(eng.params, jnp.asarray(b["x"]),
+                                   t=jnp.asarray(b["t"][:2])))
+    assert np.isfinite(out_t).all()
