@@ -141,6 +141,15 @@ def init_distributed(dist_backend: str = "xla",
         num_processes = _int_env("OMPI_COMM_WORLD_SIZE")
         process_id = _int_env("OMPI_COMM_WORLD_RANK")
         logger.info("discovered MPI environment for rendezvous")
+    if num_processes is None and auto_mpi_discovery and "PMI_SIZE" in env:
+        # MPICH / MVAPICH process managers (reference: mpi_discovery comm.py:595)
+        num_processes = _int_env("PMI_SIZE")
+        process_id = _int_env("PMI_RANK")
+        logger.info("discovered PMI (MPICH) environment for rendezvous")
+    if num_processes is None and "SLURM_NTASKS" in env:
+        num_processes = _int_env("SLURM_NTASKS")
+        process_id = _int_env("SLURM_PROCID")
+        logger.info("discovered SLURM environment for rendezvous")
     if num_processes and num_processes > 1:
         t0 = time.perf_counter()
         jax.distributed.initialize(coordinator_address=coordinator_address,

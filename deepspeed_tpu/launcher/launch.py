@@ -132,16 +132,34 @@ def main(argv=None) -> int:
                    help="optional standalone rendezvous override; normally "
                         "the runner exports COORDINATOR_ADDRESS/"
                         "NUM_PROCESSES/PROCESS_ID and this is omitted")
-    p.add_argument("--node_rank", type=int,
-                   default=int(os.environ.get(
-                       "PROCESS_ID", os.environ.get("NODE_RANK", 0))))
+    p.add_argument("--node_rank", type=int, default=None)
+    p.add_argument("--node_host", default=None,
+                   help="this node's hostname; its index in "
+                        "world_info['hosts'] becomes the node rank (the "
+                        "pdsh %%h path, where every node gets the SAME "
+                        "command line)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="user script command (after --)")
     a = p.parse_args(argv)
     cmd = a.cmd[1:] if a.cmd and a.cmd[0] == "--" else a.cmd
     if not cmd:
         p.error("no user command given (append: -- python train.py ...)")
-    agent = LaunchAgent(cmd, a.world_info, a.node_rank)
+    node_rank = a.node_rank
+    if node_rank is None and a.node_host is not None:
+        hosts = (a.world_info or {}).get("hosts")
+        if not hosts:
+            p.error("--node_host needs world_info with a 'hosts' list")
+        short = a.node_host.split(".")[0]
+        if a.node_host in hosts:
+            node_rank = hosts.index(a.node_host)
+        elif short in hosts:
+            node_rank = hosts.index(short)
+        else:
+            p.error(f"host {a.node_host!r} not in world_info hosts {hosts}")
+    if node_rank is None:
+        node_rank = int(os.environ.get(
+            "PROCESS_ID", os.environ.get("NODE_RANK", 0)))
+    agent = LaunchAgent(cmd, a.world_info, node_rank)
     logger.info(f"launch agent: node {agent.env.get('PROCESS_ID', '?')}/"
                 f"{agent.env.get('NUM_PROCESSES', '?')} coordinator="
                 f"{agent.env.get('COORDINATOR_ADDRESS', '?')} "

@@ -1,0 +1,190 @@
+"""Multinode runner backends: pdsh / OpenMPI / MPICH / MVAPICH / SLURM.
+
+Reference: ``deepspeed/launcher/multinode_runner.py:104-253`` (PDSHRunner,
+OpenMPIRunner, MPICHRunner, MVAPICHRunner, SlurmRunner — each builds the
+scheduler-specific command line around the user script). The TPU build keeps
+the same contract: a runner turns (hostfile, env, script) into ONE command.
+Per-process rendezvous comes from, in order of backend:
+
+- pdsh: every node runs the per-node launch agent with the SAME command;
+  the agent derives its node rank from ``--node_host %h`` against the
+  world_info host list, then exports COORDINATOR_ADDRESS/NUM_PROCESSES/
+  PROCESS_ID for ``comm.init_distributed``.
+- OpenMPI: OMPI_COMM_WORLD_{SIZE,RANK} (comm.py mpi discovery).
+- MPICH/MVAPICH: PMI_{SIZE,RANK} (comm.py PMI discovery).
+- SLURM: SLURM_{NTASKS,PROCID} (comm.py SLURM discovery).
+
+Unit-testable by construction like the reference
+(``tests/unit/launcher/test_multinode_runner.py``): ``get_cmd`` is pure.
+"""
+
+import base64
+import json
+import os
+import shlex
+import shutil
+import sys
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+EXPORT_ENVS = ("JAX_", "XLA_", "TPU_", "DSTPU_", "PYTHON", "PATH",
+               "LD_LIBRARY_PATH", "NCCL_", "MASTER_")
+
+
+def _exportable(env: Dict[str, str]) -> Dict[str, str]:
+    return {k: v for k, v in env.items()
+            if any(k.startswith(p) for p in EXPORT_ENVS)}
+
+
+class MultiNodeRunner:
+    name = "base"
+
+    def __init__(self, hosts: Dict[str, int], script_cmd: List[str],
+                 master_addr: Optional[str] = None, master_port: int = 29500,
+                 env: Optional[Dict[str, str]] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        """env is filtered by the EXPORT_ENVS prefix whitelist; extra_env
+        (e.g. the user's .deepspeed_env file) always propagates — matching
+        the ssh path's behavior."""
+        self.hosts = hosts
+        self.script_cmd = list(script_cmd)
+        self.master_addr = master_addr or (next(iter(hosts)) if hosts
+                                           else "localhost")
+        self.master_port = master_port
+        self.exports = dict(_exportable(env or {}))
+        self.exports.update(extra_env or {})
+
+    @property
+    def total_procs(self) -> int:
+        return sum(self.hosts.values())
+
+    def backend_exists(self) -> bool:
+        return True
+
+    def get_cmd(self) -> List[str]:
+        raise NotImplementedError
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Reference: PDSHRunner (multinode_runner.py:104) — fan out over ssh;
+    every node gets the SAME agent command and self-identifies via %h."""
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self) -> List[str]:
+        hostlist = ",".join(self.hosts)
+        exports = "".join(
+            f"export {k}={shlex.quote(str(v))}; "
+            for k, v in sorted(self.exports.items()))
+        winfo = base64.urlsafe_b64encode(json.dumps({
+            "coordinator": f"{self.master_addr}:{self.master_port}",
+            "num_nodes": len(self.hosts),
+            "hosts": list(self.hosts),
+        }).encode()).decode()
+        agent = (f"{exports}cd {shlex.quote(os.getcwd())}; "
+                 f"{shlex.quote(sys.executable)} -m "
+                 f"deepspeed_tpu.launcher.launch "
+                 f"--world_info {winfo} --node_host %h -- "
+                 + " ".join(map(shlex.quote, self.script_cmd)))
+        return ["pdsh", "-S", "-f", "1024", "-w", hostlist, agent]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """Reference: OpenMPIRunner (multinode_runner.py:148). Rendezvous via
+    OMPI_COMM_WORLD_* (comm.init_distributed mpi discovery)."""
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self) -> List[str]:
+        cmd = ["mpirun", "-n", str(self.total_procs),
+               "--host", ",".join(f"{h}:{n}" for h, n in self.hosts.items()),
+               "--mca", "btl", "^openib",
+               "--mca", "btl_tcp_if_include", "eth0"]
+        for k, v in sorted(self.exports.items()):
+            cmd += ["-x", f"{k}={v}"]
+        cmd += ["-x", f"MASTER_ADDR={self.master_addr}",
+                "-x", f"MASTER_PORT={self.master_port}"]
+        return cmd + self.script_cmd
+
+
+class MPICHRunner(MultiNodeRunner):
+    """Reference: MPICHRunner (multinode_runner.py:191). Rendezvous via
+    PMI_SIZE/PMI_RANK (comm.init_distributed PMI discovery)."""
+    name = "mpich"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self) -> List[str]:
+        cmd = ["mpirun", "-n", str(self.total_procs),
+               "-hosts", ",".join(self.hosts)]
+        ppn = set(self.hosts.values())
+        if len(ppn) == 1:
+            cmd += ["-ppn", str(ppn.pop())]
+        for k, v in sorted(self.exports.items()):
+            cmd += ["-genv", k, str(v)]
+        cmd += ["-genv", "MASTER_ADDR", self.master_addr,
+                "-genv", "MASTER_PORT", str(self.master_port)]
+        return cmd + self.script_cmd
+
+
+class MVAPICHRunner(MPICHRunner):
+    """Reference: MVAPICHRunner (multinode_runner.py:222) — MPICH-style CLI
+    with the MVAPICH env knobs."""
+    name = "mvapich"
+
+    def get_cmd(self) -> List[str]:
+        base = super().get_cmd()
+        # insert the MVAPICH affinity/debug defaults the reference sets
+        extra = ["-genv", "MV2_SMP_USE_CMA", "0",
+                 "-genv", "MV2_DEBUG_SHOW_BACKTRACE", "1"]
+        return base[:3] + extra + base[3:]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """Reference: SlurmRunner (multinode_runner.py:253). Rendezvous via
+    SLURM_NTASKS/SLURM_PROCID (comm.init_distributed SLURM discovery)."""
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self) -> List[str]:
+        items = [("MASTER_ADDR", self.master_addr),
+                 ("MASTER_PORT", str(self.master_port))]
+        for k, v in sorted(self.exports.items()):
+            v = str(v)
+            if "," in v or " " in v:
+                # srun --export splits on commas; there is no portable
+                # escape — such values must ride the submitting shell's env
+                logger.warning(f"slurm runner: dropping {k!r} from --export "
+                               "(value has ',' or ' '; srun cannot carry "
+                               "it — rely on sbatch/env propagation)")
+                continue
+            items.append((k, v))
+        cmd = ["srun", "-n", str(self.total_procs),
+               "--ntasks-per-node", str(max(self.hosts.values())),
+               "--nodelist", ",".join(self.hosts),
+               "--export", "ALL," + ",".join(f"{k}={v}" for k, v in items)]
+        return cmd + self.script_cmd
+
+
+RUNNERS = {r.name: r for r in (PDSHRunner, OpenMPIRunner, MPICHRunner,
+                               MVAPICHRunner, SlurmRunner)}
+
+
+def get_runner(name: str, hosts, script_cmd, master_addr=None,
+               master_port: int = 29500, env=None,
+               extra_env=None) -> MultiNodeRunner:
+    if name not in RUNNERS:
+        raise ValueError(f"unknown launcher {name!r}; have "
+                         f"{sorted(RUNNERS)} (or 'ssh'/'gcloud' in dstpu)")
+    return RUNNERS[name](hosts, script_cmd, master_addr=master_addr,
+                         master_port=master_port,
+                         env=env if env is not None else dict(os.environ),
+                         extra_env=extra_env)

@@ -125,6 +125,11 @@ def main(argv=None):
     parser.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
     parser.add_argument("--tpu", default=None, help="TPU pod name (gcloud mode)")
     parser.add_argument("--zone", default=None, help="gcloud zone")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "pdsh", "openmpi", "mpich",
+                                 "mvapich", "slurm"],
+                        help="multinode backend (reference: "
+                             "multinode_runner.py); ssh = built-in agent")
     parser.add_argument("--dry_run", action="store_true",
                         help="print the launch commands without executing")
     parser.add_argument("--no_agent", action="store_true",
@@ -155,6 +160,24 @@ def main(argv=None):
             return 0
         return subprocess.call(script_cmd)
 
+    if args.launcher != "ssh":
+        from deepspeed_tpu.launcher.multinode_runner import get_runner
+        import os as _os
+        # .deepspeed_env entries bypass the export whitelist (same contract
+        # as the ssh path, which propagates all of them)
+        runner = get_runner(args.launcher, hosts, script_cmd,
+                            master_addr=args.master_addr,
+                            master_port=args.master_port,
+                            env=dict(_os.environ),
+                            extra_env=_read_ds_env())
+        if not runner.backend_exists():
+            logger.warning(f"{args.launcher} binary not found on PATH")
+        cmd = runner.get_cmd()
+        if args.dry_run:
+            print(" ".join(map(shlex.quote, cmd)))
+            return 0
+        return subprocess.call(cmd)
+
     cmds = build_ssh_commands(hosts, script_cmd, args.master_addr,
                               args.master_port, _read_ds_env(),
                               use_agent=not args.no_agent)
@@ -179,3 +202,27 @@ def main(argv=None):
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+def ssh_main(argv=None):
+    """``dstpu_ssh``: run a command on every hostfile host (reference:
+    ``bin/ds_ssh`` — pdsh convenience wrapper)."""
+    parser = argparse.ArgumentParser(
+        prog="dstpu_ssh", description="run a command on all hostfile hosts")
+    parser.add_argument("--hostfile", default="/job/hostfile")
+    parser.add_argument("--dry_run", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    hosts = fetch_hostfile(args.hostfile) or {"localhost": 1}
+    remote = " ".join(map(shlex.quote, args.command))
+    rc = 0
+    for host in hosts:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+        if args.dry_run:
+            print(" ".join(map(shlex.quote, cmd)))
+            continue
+        print(f"----- {host} -----", flush=True)
+        rc |= subprocess.call(cmd)
+    return rc

@@ -1,0 +1,106 @@
+"""NVMe AIO parameter sweep (``dstpu_aio_bench``).
+
+Reference: ``csrc/aio/py_test/aio_bench_perf_sweep.py`` — sweep block size x
+queue depth x thread count over O_DIRECT reads/writes and report the best
+configuration to feed ``aio`` config keys (here: the AIOHandle constructor
+args used by runtime/swap_tensor.py and runtime/infinity.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import List
+
+import numpy as np
+
+
+def _bench_one(handle, path: str, arr: np.ndarray, iters: int,
+               direct: bool) -> dict:
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        handle.pwrite(path, arr, direct=direct)
+    wt = (time.perf_counter() - t0) / iters
+    out = np.empty_like(arr)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        handle.pread(path, arr.shape, arr.dtype, direct=direct, out=out)
+    rt = (time.perf_counter() - t0) / iters
+    gb = arr.nbytes / 1e9
+    return {"write_gbps": round(gb / wt, 3), "read_gbps": round(gb / rt, 3)}
+
+
+def sweep(path: str, file_mb: int = 256, iters: int = 3,
+          block_sizes: List[int] = (1 << 18, 1 << 20, 1 << 22),
+          queue_depths: List[int] = (4, 16, 32, 64),
+          thread_counts: List[int] = (1, 4, 8),
+          direct: bool = False) -> List[dict]:
+    from deepspeed_tpu.ops.aio import AIOHandle, aio_available
+    if not aio_available():
+        raise RuntimeError("native aio library unavailable")
+    arr = np.random.default_rng(0).integers(
+        0, 255, file_mb * (1 << 20), dtype=np.uint8)
+    results = []
+    fname = os.path.join(path, "dstpu_aio_bench.bin")
+    for bs in block_sizes:
+        for qd in queue_depths:
+            for tc in thread_counts:
+                h = AIOHandle(block_size=bs, queue_depth=qd, thread_count=tc)
+                try:
+                    r = _bench_one(h, fname, arr, iters, direct)
+                except Exception as e:  # noqa: BLE001 — record and continue
+                    r = {"error": str(e)}
+                finally:
+                    h.close()
+                r.update({"block_size": bs, "queue_depth": qd,
+                          "thread_count": tc, "io_uring": None})
+                results.append(r)
+    try:
+        os.unlink(fname)
+    except OSError:
+        pass
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu_aio_bench",
+        description="NVMe AIO block-size/queue-depth/thread sweep")
+    p.add_argument("--path", default=None,
+                   help="directory on the target disk (default: tmpdir)")
+    p.add_argument("--file-mb", type=int, default=256)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--direct", action="store_true", help="O_DIRECT IO")
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+    path = args.path or tempfile.mkdtemp(prefix="dstpu-aio-")
+    rows = sweep(path, file_mb=args.file_mb, iters=args.iters,
+                 direct=args.direct)
+    ok = [r for r in rows if "error" not in r]
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(f"{'block':>10} {'depth':>6} {'threads':>8} "
+              f"{'write GB/s':>11} {'read GB/s':>10}")
+        for r in rows:
+            if "error" in r:
+                print(f"{r['block_size']:>10} {r['queue_depth']:>6} "
+                      f"{r['thread_count']:>8}  ERROR {r['error']}")
+            else:
+                print(f"{r['block_size']:>10} {r['queue_depth']:>6} "
+                      f"{r['thread_count']:>8} {r['write_gbps']:>11} "
+                      f"{r['read_gbps']:>10}")
+        if ok:
+            best = max(ok, key=lambda r: r["read_gbps"] + r["write_gbps"])
+            print(f"\nbest: block_size={best['block_size']} "
+                  f"queue_depth={best['queue_depth']} "
+                  f"thread_count={best['thread_count']} "
+                  f"(read {best['read_gbps']} GB/s, "
+                  f"write {best['write_gbps']} GB/s)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
