@@ -146,7 +146,8 @@ def init_distributed(dist_backend: str = "xla",
         num_processes = _int_env("PMI_SIZE")
         process_id = _int_env("PMI_RANK")
         logger.info("discovered PMI (MPICH) environment for rendezvous")
-    if num_processes is None and "SLURM_NTASKS" in env:
+    if num_processes is None and auto_mpi_discovery and \
+            "SLURM_NTASKS" in env:
         num_processes = _int_env("SLURM_NTASKS")
         process_id = _int_env("SLURM_PROCID")
         logger.info("discovered SLURM environment for rendezvous")

@@ -57,7 +57,10 @@ class MultiNodeRunner:
 
     @property
     def total_procs(self) -> int:
-        return sum(self.hosts.values())
+        # ONE process per host: a TPU host's chips are all addressed by a
+        # single jax client (launch.py docstring); hostfile slots document
+        # chip counts but do not multiply processes
+        return len(self.hosts)
 
     def backend_exists(self) -> bool:
         return True
@@ -102,7 +105,8 @@ class OpenMPIRunner(MultiNodeRunner):
 
     def get_cmd(self) -> List[str]:
         cmd = ["mpirun", "-n", str(self.total_procs),
-               "--host", ",".join(f"{h}:{n}" for h, n in self.hosts.items()),
+               "--host", ",".join(f"{h}:1" for h in self.hosts),
+               "--map-by", "ppr:1:node",
                "--mca", "btl", "^openib",
                "--mca", "btl_tcp_if_include", "eth0"]
         for k, v in sorted(self.exports.items()):
@@ -122,10 +126,7 @@ class MPICHRunner(MultiNodeRunner):
 
     def get_cmd(self) -> List[str]:
         cmd = ["mpirun", "-n", str(self.total_procs),
-               "-hosts", ",".join(self.hosts)]
-        ppn = set(self.hosts.values())
-        if len(ppn) == 1:
-            cmd += ["-ppn", str(ppn.pop())]
+               "-hosts", ",".join(self.hosts), "-ppn", "1"]
         for k, v in sorted(self.exports.items()):
             cmd += ["-genv", k, str(v)]
         cmd += ["-genv", "MASTER_ADDR", self.master_addr,
@@ -168,7 +169,7 @@ class SlurmRunner(MultiNodeRunner):
                 continue
             items.append((k, v))
         cmd = ["srun", "-n", str(self.total_procs),
-               "--ntasks-per-node", str(max(self.hosts.values())),
+               "--ntasks-per-node", "1",
                "--nodelist", ",".join(self.hosts),
                "--export", "ALL," + ",".join(f"{k}={v}" for k, v in items)]
         return cmd + self.script_cmd

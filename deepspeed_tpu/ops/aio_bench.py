@@ -36,7 +36,7 @@ def sweep(path: str, file_mb: int = 256, iters: int = 3,
           block_sizes: List[int] = (1 << 18, 1 << 20, 1 << 22),
           queue_depths: List[int] = (4, 16, 32, 64),
           thread_counts: List[int] = (1, 4, 8),
-          direct: bool = False) -> List[dict]:
+          direct: bool = True) -> List[dict]:
     from deepspeed_tpu.ops.aio import AIOHandle, aio_available
     if not aio_available():
         raise RuntimeError("native aio library unavailable")
@@ -53,9 +53,10 @@ def sweep(path: str, file_mb: int = 256, iters: int = 3,
                 except Exception as e:  # noqa: BLE001 — record and continue
                     r = {"error": str(e)}
                 finally:
+                    uring = h.uses_io_uring
                     h.close()
                 r.update({"block_size": bs, "queue_depth": qd,
-                          "thread_count": tc, "io_uring": None})
+                          "thread_count": tc, "io_uring": uring})
                 results.append(r)
     try:
         os.unlink(fname)
@@ -72,7 +73,9 @@ def main(argv=None) -> int:
                    help="directory on the target disk (default: tmpdir)")
     p.add_argument("--file-mb", type=int, default=256)
     p.add_argument("--iters", type=int, default=3)
-    p.add_argument("--direct", action="store_true", help="O_DIRECT IO")
+    p.add_argument("--no-direct", dest="direct", action="store_false",
+                   help="buffered IO (default is O_DIRECT: without it the "
+                        "sweep measures the page cache, not the device)")
     p.add_argument("--json", action="store_true", help="machine output")
     args = p.parse_args(argv)
     path = args.path or tempfile.mkdtemp(prefix="dstpu-aio-")

@@ -11,6 +11,9 @@ from deepspeed_tpu.elasticity import (
 from deepspeed_tpu.models import TransformerConfig, make_model
 from tests.conftest import make_batch
 
+# quick tier: `pytest -m 'not slow'` skips this module (rescale-resume paths rebuild engines)
+pytestmark = pytest.mark.slow
+
 
 def test_compatible_gpus():
     gpus = get_compatible_gpus(96, [2, 4], min_gpus=1, max_gpus=50)

@@ -17,6 +17,9 @@ import deepspeed_tpu
 from deepspeed_tpu.models import llama_config
 from deepspeed_tpu.models.transformer import make_model
 
+# quick tier: `pytest -m 'not slow'` skips this module (layer-streamed executor suites re-init multi-hundred-MB stores)
+pytestmark = pytest.mark.slow
+
 
 def _cfg_dict(tmp, gas=1, clip=0.0):
     return {

@@ -180,8 +180,11 @@ class TestMultinodeRunners:
     def test_openmpi_cmd(self):
         cmd = self._runner("openmpi").get_cmd()
         assert cmd[0] == "mpirun"
-        assert cmd[cmd.index("-n") + 1] == "8"
-        assert "worker-0:4,worker-1:4" in cmd
+        # ONE process per host (a jax client drives all local chips);
+        # hostfile slots document chip counts, not process counts
+        assert cmd[cmd.index("-n") + 1] == "2"
+        assert "worker-0:1,worker-1:1" in cmd
+        assert "ppr:1:node" in cmd
         assert "JAX_PLATFORMS=tpu" in cmd
         assert "MASTER_ADDR=worker-0" in cmd
         assert cmd[-3:] == ["python", "train.py", "--x"]
@@ -189,7 +192,7 @@ class TestMultinodeRunners:
     def test_mpich_cmd(self):
         cmd = self._runner("mpich").get_cmd()
         assert cmd[0] == "mpirun"
-        assert cmd[cmd.index("-ppn") + 1] == "4"
+        assert cmd[cmd.index("-ppn") + 1] == "1"
         i = cmd.index("MASTER_PORT")
         assert cmd[i + 1] == "29501"
 
@@ -200,7 +203,8 @@ class TestMultinodeRunners:
     def test_slurm_cmd(self):
         cmd = self._runner("slurm").get_cmd()
         assert cmd[0] == "srun"
-        assert cmd[cmd.index("-n") + 1] == "8"
+        assert cmd[cmd.index("-n") + 1] == "2"
+        assert cmd[cmd.index("--ntasks-per-node") + 1] == "1"
         exp = cmd[cmd.index("--export") + 1]
         assert exp.startswith("ALL,") and "MASTER_ADDR=worker-0" in exp
         # srun --export splits on commas: space/comma values must be dropped
@@ -237,8 +241,10 @@ def test_aio_bench_sweep(tmp_path):
         import pytest as _pytest
         _pytest.skip("native aio unavailable")
     from deepspeed_tpu.ops.aio_bench import sweep
+    # buffered IO: the CI tmpdir may not support O_DIRECT; the sweep
+    # MACHINERY is under test here, not the device
     rows = sweep(str(tmp_path), file_mb=2, iters=1,
                  block_sizes=[1 << 20], queue_depths=[4, 16],
-                 thread_counts=[2])
+                 thread_counts=[2], direct=False)
     assert len(rows) == 2
     assert all(r.get("read_gbps", 0) > 0 for r in rows), rows

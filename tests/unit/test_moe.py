@@ -11,6 +11,9 @@ from deepspeed_tpu.models import TransformerConfig, make_model, mixtral_config
 from deepspeed_tpu.moe.sharded_moe import top_k_gating, _capacity
 from tests.conftest import make_batch
 
+# quick tier: `pytest -m 'not slow'` skips this module (EP mesh matrices compile many programs)
+pytestmark = pytest.mark.slow
+
 
 def moe_cfg(**kw):
     base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,

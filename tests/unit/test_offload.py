@@ -13,6 +13,9 @@ import deepspeed_tpu
 from deepspeed_tpu.models import TransformerConfig, make_model
 from tests.conftest import make_batch
 
+# quick tier: `pytest -m 'not slow'` skips this module (swapper round trips rebuild engines)
+pytestmark = pytest.mark.slow
+
 
 def tiny_model():
     return make_model(TransformerConfig(

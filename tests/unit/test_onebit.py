@@ -19,6 +19,9 @@ from deepspeed_tpu.comm.compressed import (
 from deepspeed_tpu.models import TransformerConfig, make_model
 from tests.conftest import make_batch
 
+# quick tier: `pytest -m 'not slow'` skips this module (phased shard_map steps compile per phase)
+pytestmark = pytest.mark.slow
+
 
 def test_pack_unpack_roundtrip():
     x = np.random.default_rng(0).normal(size=(1000,)).astype(np.float32)

@@ -11,6 +11,9 @@ from deepspeed_tpu.models import TransformerConfig, make_model
 from deepspeed_tpu.parallel.pipeline import bubble_fraction
 from tests.conftest import make_batch
 
+# quick tier: `pytest -m 'not slow'` skips this module (1F1B shard_map programs are compile-heavy)
+pytestmark = pytest.mark.slow
+
 
 def tiny_cfg(**kw):
     base = dict(vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,

@@ -11,6 +11,9 @@ from deepspeed_tpu.models import TransformerConfig, make_model
 from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
 from tests.conftest import make_batch
 
+# quick tier: `pytest -m 'not slow'` skips this module (HVP power iteration + engine rebuilds)
+pytestmark = pytest.mark.slow
+
 
 class TestPLD:
     def test_engine_pld_trains_and_theta_decays(self, devices8):

@@ -20,6 +20,9 @@ from deepspeed_tpu.models.transformer import _gold_logit, cross_entropy_loss
 from deepspeed_tpu.utils.hlo_check import (assert_no_spmd_replication,
                                            capture_spmd_warnings)
 
+# quick tier: `pytest -m 'not slow'` skips this module (8-device SPMD compiles)
+pytestmark = pytest.mark.slow
+
 
 def test_gold_logit_matches_gather():
     # the one-hot contraction must be numerically identical to the gather

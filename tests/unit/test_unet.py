@@ -13,6 +13,9 @@ import pytest
 import deepspeed_tpu
 from deepspeed_tpu.models import UNetConfig, make_unet_model, unet_forward
 
+# quick tier: `pytest -m 'not slow'` skips this module (conv mesh compiles)
+pytestmark = pytest.mark.slow
+
 
 def _cfg():
     return UNetConfig(in_channels=3, out_channels=3, base_channels=16,

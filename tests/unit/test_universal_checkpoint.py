@@ -16,6 +16,9 @@ import pytest
 import deepspeed_tpu
 from deepspeed_tpu.models import TransformerConfig, make_model
 
+# quick tier: `pytest -m 'not slow'` skips this module (cross-mesh save/restore matrix compiles many mesh programs)
+pytestmark = pytest.mark.slow
+
 
 def _model():
     return make_model(TransformerConfig(
