@@ -14,11 +14,34 @@ work, mirroring the reference's "restart from last checkpoint" contract.
 """
 
 import copy
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 
 from deepspeed_tpu.utils.logging import logger
+
+
+def probe_devices(devices=None) -> List:
+    """Health-check each device with a tiny compute + fetch; return the
+    healthy ones. The fetch is the real test: through some transports a
+    dead chip only surfaces on device->host reads (reference analogue:
+    torchelastic's worker liveness watch, elastic_agent.py:25 — there a
+    process heartbeat, here a per-chip probe since one process drives all
+    chips)."""
+    devices = list(devices if devices is not None else jax.devices())
+    healthy = []
+    for d in devices:
+        try:
+            x = jax.device_put(jnp.ones((8,), jnp.float32), d)
+            if float(jax.device_get(jnp.sum(x + 1.0))) == 16.0:
+                healthy.append(d)
+            else:  # pragma: no cover - wrong math = sick chip
+                logger.warning(f"elastic agent: device {d} failed the "
+                               "probe value check")
+        except Exception as e:  # noqa: BLE001 - any fault marks it dead
+            logger.warning(f"elastic agent: device {d} unhealthy: {e}")
+    return healthy
 
 
 class DSElasticAgent:
@@ -34,7 +57,15 @@ class DSElasticAgent:
 
     def __init__(self, model_factory: Callable, config: Dict, ckpt_dir: str,
                  *, checkpoint_interval: int = 10,
-                 device_count_fn: Optional[Callable[[], int]] = None):
+                 device_count_fn: Optional[Callable[[], int]] = None,
+                 probe_interval: Optional[int] = 100,
+                 health_fn: Optional[Callable[[], List]] = None):
+        """probe_interval: run the device-health probe every N steps
+        (default 100; the probe is ALSO the only path that scales the
+        world back UP after a recovery — None disables it and the agent
+        then only reacts to shrinks and failed steps). health_fn:
+        override for tests / fault injection; returns the healthy
+        devices."""
         if not config.get("elasticity", {}).get("enabled"):
             raise ValueError("DSElasticAgent requires an enabled "
                              "'elasticity' config section")
@@ -43,16 +74,42 @@ class DSElasticAgent:
         self._ckpt_dir = ckpt_dir
         self._interval = max(1, checkpoint_interval)
         self._device_fn = device_count_fn or (lambda: jax.device_count())
+        self._health_fn = health_fn
+        self._probe_interval = probe_interval
+        self._steps_since_probe = 0
         self.engine = None
         self.world = 0
         self.scale_events = 0
+        self.failure_events = 0
         self._ensure_engine()
 
     # ------------------------------------------------------------------
-    def _ensure_engine(self) -> bool:
+    def _healthy_devices(self) -> List:
+        if self._health_fn is not None:
+            return list(self._health_fn())
+        return probe_devices(jax.devices()[:int(self._device_fn())])
+
+    # ------------------------------------------------------------------
+    def _ensure_engine(self, probe: bool = False) -> bool:
         """(Re)build the engine if the device world changed. Returns True
-        when a rescale happened."""
-        world = int(self._device_fn())
+        when a rescale happened. probe=False uses the cheap device-count
+        check (per step); probe=True runs the per-chip health probe (on
+        the probe_interval cadence and after a failed step — probing every
+        step would cost a host round trip per chip)."""
+        if probe or self.engine is None:
+            devices = self._healthy_devices()
+        else:
+            # cheap per-step check: only a SHRINK of the visible device
+            # world forces a rebuild here; growth (or a recovered chip)
+            # waits for the next probe — otherwise a step after a probed
+            # cull would immediately scale back onto the sick chips
+            avail = list(jax.devices()[:int(self._device_fn())])
+            if len(avail) >= self.world:
+                return False
+            devices = avail
+        world = len(devices)
+        if world == 0:
+            raise RuntimeError("elastic agent: no healthy devices remain")
         if self.engine is not None and world == self.world:
             return False
         rescaled = self.engine is not None
@@ -69,7 +126,7 @@ class DSElasticAgent:
         # derives the train/micro/gas triad itself
         engine, *_ = deepspeed_tpu.initialize(
             model=self._factory(), config=copy.deepcopy(self._base_config),
-            devices=jax.devices()[:world])
+            devices=devices)
         try:
             engine.load_checkpoint(self._ckpt_dir)
             logger.info(f"elastic agent: resumed at step "
@@ -86,13 +143,39 @@ class DSElasticAgent:
         return self.engine.config.train_batch_size
 
     def train_batch(self, batch) -> Dict:
-        """One global step; transparently rescales between steps. `batch`
-        may be a callable(batch_size) -> batch so the agent can request the
-        right global batch after a rescale."""
-        self._ensure_engine()
-        if callable(batch):
-            batch = batch(self.batch_size)
-        metrics = self.engine.train_batch(batch)
+        """One global step; transparently rescales between steps and
+        recovers from a step that faults (dead chip mid-run): the probe
+        culls unhealthy devices, the engine rebuilds over the survivors
+        from the latest checkpoint, and the step is retried ONCE. `batch`
+        may be a callable(batch_size) -> batch so the agent can request
+        the right global batch after a rescale."""
+        probe_due = (self._probe_interval is not None
+                     and self._steps_since_probe >= self._probe_interval)
+        if probe_due:
+            self._steps_since_probe = 0
+        self._ensure_engine(probe=probe_due)
+        for attempt in (0, 1):
+            b = batch(self.batch_size) if callable(batch) else batch
+            try:
+                metrics = self.engine.train_batch(b)
+                break
+            except Exception as e:  # noqa: BLE001 - chip faults surface
+                if attempt:          # as runtime errors from the step
+                    raise
+                self.failure_events += 1
+                logger.warning(f"elastic agent: step failed ({e}); probing "
+                               "devices and rebuilding from the latest "
+                               "checkpoint")
+                try:
+                    # quiesce any in-flight async save BEFORE the rebuilt
+                    # engine reads 'latest' (same race the rescale path
+                    # guards against)
+                    self.engine.wait_checkpoint()
+                except Exception:  # noqa: BLE001 - the engine may be dead
+                    pass
+                self.engine = None   # force a probed rebuild over survivors
+                self._ensure_engine(probe=True)
+        self._steps_since_probe += 1
         if self.engine.global_steps % self._interval == 0:
             self.engine.save_checkpoint(self._ckpt_dir)
         return metrics

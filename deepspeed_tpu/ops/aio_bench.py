@@ -48,12 +48,12 @@ def sweep(path: str, file_mb: int = 256, iters: int = 3,
         for qd in queue_depths:
             for tc in thread_counts:
                 h = AIOHandle(block_size=bs, queue_depth=qd, thread_count=tc)
-                try:
+                uring = h.uses_io_uring   # before the bench: a failed run
+                try:                      # can leave the handle unreadable
                     r = _bench_one(h, fname, arr, iters, direct)
                 except Exception as e:  # noqa: BLE001 — record and continue
                     r = {"error": str(e)}
                 finally:
-                    uring = h.uses_io_uring
                     h.close()
                 r.update({"block_size": bs, "queue_depth": qd,
                           "thread_count": tc, "io_uring": uring})
