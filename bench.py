@@ -223,15 +223,15 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 print(f"bench: decode bench failed: {e}", file=sys.stderr)
             gc.collect()
             try:
+                result.update(_capacity_bench())
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: capacity bench failed: {e}", file=sys.stderr)
+            gc.collect()
+            try:
                 result.update(_offload_bench(size, S, B,
                                              result["step_ms"] / 1000.0))
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: offload bench failed: {e}", file=sys.stderr)
-            gc.collect()
-            try:
-                result.update(_capacity_bench())
-            except Exception as e:  # noqa: BLE001 — secondary metric
-                print(f"bench: capacity bench failed: {e}", file=sys.stderr)
         return result
     raise RuntimeError(f"every bench rung OOM'd; last error: {last_err}")
 
@@ -364,6 +364,8 @@ def _offload_bench(size: str, S: int, B: int, hbm_step_s: float,
         m = engine.train_batch(b)
     float(np.asarray(m["loss"]))
     dt = (time.perf_counter() - t0) / nsteps
+    if engine._swapper is not None:
+        engine._swapper.close()   # release the pinned host buffers promptly
     del engine
     gc.collect()
     return {"offload_step_s": round(dt, 3),

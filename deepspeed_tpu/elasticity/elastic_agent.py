@@ -121,6 +121,9 @@ class DSElasticAgent:
             # partially-written save
             self.engine.wait_checkpoint()
             self.scale_events += 1
+            # drop the old engine BEFORE building the new one: both alive
+            # at once would double device-memory residency mid-recovery
+            self.engine = None
         import deepspeed_tpu
         # initialize() re-runs compute_elastic_config for THIS world and
         # derives the train/micro/gas triad itself
@@ -162,9 +165,17 @@ class DSElasticAgent:
             except Exception as e:  # noqa: BLE001 - chip faults surface
                 if attempt:          # as runtime errors from the step
                     raise
+                survivors = self._healthy_devices()
+                if len(survivors) >= self.world:
+                    # every device is healthy: this is a software error
+                    # (bad batch, NaN guard, bug), not a chip fault —
+                    # silently replaying from the checkpoint would hide it
+                    raise
                 self.failure_events += 1
-                logger.warning(f"elastic agent: step failed ({e}); probing "
-                               "devices and rebuilding from the latest "
+                prev_world = self.world
+                logger.warning(f"elastic agent: step failed ({e}); "
+                               f"{len(survivors)}/{prev_world} devices "
+                               "healthy — rebuilding from the latest "
                                "checkpoint")
                 try:
                     # quiesce any in-flight async save BEFORE the rebuilt
@@ -173,8 +184,10 @@ class DSElasticAgent:
                     self.engine.wait_checkpoint()
                 except Exception:  # noqa: BLE001 - the engine may be dead
                     pass
-                self.engine = None   # force a probed rebuild over survivors
+                self.engine = None   # free it before the rebuild
                 self._ensure_engine(probe=True)
+                if self.world != prev_world:
+                    self.scale_events += 1  # fault-driven shrink counts too
         self._steps_since_probe += 1
         if self.engine.global_steps % self._interval == 0:
             self.engine.save_checkpoint(self._ckpt_dir)

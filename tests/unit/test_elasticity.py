@@ -186,7 +186,9 @@ class TestFailureRecovery:
         assert np.isfinite(l1) and l1 < l0
 
     def test_failed_step_rebuilds_and_retries(self, tmp_path, devices8):
-        agent = self._agent(tmp_path, health_fn=lambda: devices8[:8],
+        healthy = {"n": 8}
+        agent = self._agent(tmp_path,
+                            health_fn=lambda: devices8[:healthy["n"]],
                             checkpoint_interval=1)
 
         def batch(bs):
@@ -197,19 +199,44 @@ class TestFailureRecovery:
         agent.train_batch(batch)               # step 1 + checkpoint
         step_before = agent.engine.global_steps
 
-        # inject a one-shot chip fault into the engine's step
+        # inject a one-shot chip fault: the step raises AND the probe
+        # afterwards finds a dead chip (a software error with all chips
+        # healthy re-raises instead — tested below)
         real = agent.engine.train_batch
         state = {"fired": False}
 
         def faulty(b):
             if not state["fired"]:
                 state["fired"] = True
+                healthy["n"] = 4
                 raise RuntimeError("TPU worker process crashed (injected)")
             return real(b)
 
         agent.engine.train_batch = faulty
-        m = agent.train_batch(batch)           # fails once, recovers
+        m = agent.train_batch(batch)           # fails once, recovers at 4
         assert agent.failure_events == 1
+        assert agent.scale_events == 1         # fault-driven shrink counted
+        assert agent.world == 4
         assert np.isfinite(float(m["loss"]))
         # the rebuilt engine resumed from the step-1 checkpoint
         assert agent.engine.global_steps == step_before + 1
+
+    def test_software_error_with_healthy_devices_reraises(self, tmp_path,
+                                                          devices8):
+        agent = self._agent(tmp_path, health_fn=lambda: devices8,
+                            checkpoint_interval=1)
+
+        def batch(bs):
+            rng = np.random.default_rng(2)
+            return {"input_ids": rng.integers(0, 64, (bs, 32),
+                                              dtype=np.int32)}
+
+        agent.train_batch(batch)
+
+        def buggy(b):
+            raise ValueError("bad batch (injected)")
+
+        agent.engine.train_batch = buggy
+        with pytest.raises(ValueError, match="bad batch"):
+            agent.train_batch(batch)
+        assert agent.failure_events == 0       # not recorded as a chip fault
