@@ -66,18 +66,20 @@ def estimate_resident_bytes(cfg, n_params: int, batch: int, seq: int,
     return state + logits + acts + workspace
 
 
-def _mfu(cfg, n_params: int, B: int, S: int, nsteps: int, dt: float) -> float:
+def _mfu(cfg, n_params: int, B: int, S: int, nsteps: int, dt: float,
+         n_devices: int = None) -> float:
     """MFU from wall time vs chip peak, PaLM-convention model FLOPs:
     6N + 12*L*H*S per token, with NO causal discount (the standard MFU
     definition — PaLM App. B / nanoGPT — counts full-S attention even though
     a causal kernel executes ~half; every rung here uses the same convention,
-    so rungs are comparable to each other and to published MFU numbers)."""
+    so rungs are comparable to each other and to published MFU numbers).
+    n_devices: override for deliberately single-chip rungs (capacity)."""
     import jax
     from deepspeed_tpu.accelerator import get_accelerator
     tok_per_sec = B * S * nsteps / dt
     flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size * S
     peak = (get_accelerator().peak_flops_per_device("bf16")
-            * max(1, jax.device_count()))
+            * (n_devices if n_devices else max(1, jax.device_count())))
     return tok_per_sec * flops_per_token / peak
 
 
@@ -416,10 +418,7 @@ def _capacity_bench(size: str = "3b", S: int = 1024, nsteps: int = 2) -> dict:
     # TPU-VM) bounds this: the metric tracks the TREND, the note carries
     # the caveat.
     tok_per_sec = S / dt
-    from deepspeed_tpu.accelerator import get_accelerator as _ga
-    flops_per_token = 6.0 * n + 12.0 * cfg.num_layers * cfg.hidden_size * S
-    cap_mfu = tok_per_sec * flops_per_token / _ga().peak_flops_per_device(
-        "bf16")
+    cap_mfu = _mfu(cfg, n, 1, S, 1, dt, n_devices=1)
     return {"max_params_per_chip": int(n),
             "capacity_step_s": round(dt, 1),
             "capacity_tokens_per_sec": round(tok_per_sec, 1),
