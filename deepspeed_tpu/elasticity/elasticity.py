@@ -102,3 +102,38 @@ def compute_elastic_config(elastic_cfg: Dict, world_size: int = 0
                 + (f", micro={micro_for_ws} at world={world_size}"
                    if world_size else ""))
     return final_batch, best_gpus, micro_for_ws
+
+
+def cli_main(argv=None) -> int:
+    """``dstpu_elastic``: show the elastic batch plan for a config file
+    (reference: ``bin/ds_elastic`` over compute_elastic_config)."""
+    import argparse
+    import json as _json
+
+    p = argparse.ArgumentParser(
+        prog="dstpu_elastic",
+        description="elastic batch plan for a deepspeed_tpu config")
+    import sys as _sys
+
+    p.add_argument("config", help="JSON config file with an "
+                                  "'elasticity' section")
+    p.add_argument("-w", "--world-size", type=int, default=0,
+                   help="also resolve the micro batch for this world size")
+    a = p.parse_args(argv)
+    if a.world_size < 0:
+        print(f"error: invalid world size {a.world_size}", file=_sys.stderr)
+        return 1
+    try:
+        with open(a.config) as f:
+            cfg = _json.load(f)
+        section = cfg.get("elasticity", cfg)
+        batch, valid, micro = compute_elastic_config(section, a.world_size)
+    except (ElasticityError, OSError, ValueError, TypeError,
+            _json.JSONDecodeError) as e:
+        print(f"error: {e}", file=_sys.stderr)
+        return 1
+    print(f"final train_batch_size: {batch}")
+    print(f"compatible device counts: {valid}")
+    if a.world_size:
+        print(f"micro batch at world={a.world_size}: {micro}")
+    return 0

@@ -240,3 +240,21 @@ class TestFailureRecovery:
         with pytest.raises(ValueError, match="bad batch"):
             agent.train_batch(batch)
         assert agent.failure_events == 0       # not recorded as a chip fault
+
+
+def test_elastic_cli(tmp_path, capsys):
+    """dstpu_elastic (reference: bin/ds_elastic over compute_elastic_config)."""
+    import json
+    from deepspeed_tpu.elasticity.elasticity import cli_main
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(json.dumps({"elasticity": {
+        "enabled": True, "max_train_batch_size": 64,
+        "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8,
+        "version": 0.2}}))
+    rc = cli_main([str(cfg), "-w", "4"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "final train_batch_size" in out
+    assert "micro batch at world=4" in out
+    cfg2 = tmp_path / "bad.json"
+    cfg2.write_text(json.dumps({"elasticity": {"enabled": False}}))
+    assert cli_main([str(cfg2)]) == 1
