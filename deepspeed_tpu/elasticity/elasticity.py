@@ -126,6 +126,10 @@ def cli_main(argv=None) -> int:
     try:
         with open(a.config) as f:
             cfg = _json.load(f)
+        if not isinstance(cfg, dict):
+            raise ElasticityError(
+                f"config top level must be a JSON object, got "
+                f"{type(cfg).__name__}")
         section = cfg.get("elasticity", cfg)
         batch, valid, micro = compute_elastic_config(section, a.world_size)
     except (ElasticityError, OSError, ValueError, TypeError,

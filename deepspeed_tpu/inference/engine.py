@@ -176,6 +176,7 @@ class InferenceEngine:
 
         self._forward = jax.jit(lambda p, ids: model.apply(p, ids))
         self._rules = rules
+        self._encode_fn = None     # encoder-model hidden-state path
         self._prefill_cache = {}   # (B, pad_prompt, max_len); prompt_len
         # is a traced argument, NOT part of the compile key
         self._decode_loop_cache = {}  # (B, pad_prompt, max_len, n_steps, temp)
@@ -258,6 +259,31 @@ class InferenceEngine:
             return self._forward(self.params, input_ids)
 
     __call__ = forward
+
+    def encode(self, input_ids, attention_mask=None, token_type_ids=None):
+        """Encoder-model hidden states [B, S, H] (BERT/RoBERTa; reference:
+        the encoder task pipelines init_inference serves in
+        tests/unit/inference/test_inference.py — fill-mask / classification
+        heads consume these)."""
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      forward as _fwd)
+        cfg = getattr(self.model, "config", None)
+        if not isinstance(cfg, TransformerConfig):
+            raise ValueError("encode() requires a transformer ModelSpec")
+        from deepspeed_tpu.parallel.context import set_parallel_context
+        set_parallel_context(self.mesh, self._plan)
+        if self._encode_fn is None:
+            self._encode_fn = jax.jit(
+                lambda p, ids, mask, tt: _fwd(
+                    p, ids, cfg, attention_mask=mask, token_type_ids=tt,
+                    return_hidden=True)[0])
+        B = jnp.asarray(input_ids).shape[0]
+        sh = NamedSharding(self.mesh, self._batch_spec(B))
+        put = lambda x: (jax.device_put(jnp.asarray(x), sh)  # noqa: E731
+                         if x is not None else None)
+        with self.mesh:
+            return self._encode_fn(self.params, put(input_ids),
+                                   put(attention_mask), put(token_type_ids))
 
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
                  rng=None):

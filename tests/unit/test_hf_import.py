@@ -468,3 +468,37 @@ def test_roberta_import_hidden_parity():
     with torch.no_grad():
         ref = hf(torch.from_numpy(ids).long()).last_hidden_state.float().numpy()
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_inference_engine_encode():
+    """init_inference serves encoder models: engine.encode() hidden states
+    match HF (the fill-mask/classification entry point)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import make_model
+    cfg_hf = transformers.BertConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2)
+    torch.manual_seed(9)
+    hf = transformers.BertModel(cfg_hf).eval()
+    cfg = hf_config_to_transformer(cfg_hf, dtype=jnp.float32,
+                                   attention_impl="xla")
+    params = load_hf_params(hf, cfg)
+    eng = deepspeed_tpu.init_inference(make_model(cfg), params=params,
+                                       dtype=jnp.float32)
+    ids = np.random.default_rng(8).integers(0, 96, size=(2, 10)).astype(np.int32)
+    tt = np.zeros((2, 10), np.int32)
+    tt[:, 6:] = 1
+    ours = np.asarray(eng.encode(ids, token_type_ids=tt))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long(),
+                 token_type_ids=torch.from_numpy(tt).long()
+                 ).last_hidden_state.float().numpy()
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+    # decoder models refuse (hidden states there come from generate/forward)
+    from deepspeed_tpu.models.unet import UNetConfig, make_unet_model
+    eng2 = deepspeed_tpu.init_inference(
+        make_unet_model(UNetConfig(base_channels=16, norm_groups=4)),
+        dtype=jnp.float32)
+    with pytest.raises(ValueError, match="transformer"):
+        eng2.encode(ids)
