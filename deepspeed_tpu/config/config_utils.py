@@ -45,8 +45,15 @@ class ConfigModel:
             hint = hints.get(name)
             kwargs[name] = _coerce(hint, value, f"{path}{key}.")
         obj = cls(**kwargs)  # type: ignore[call-arg]
+        object.__setattr__(obj, "_explicit_keys", frozenset(kwargs))
         obj.validate()
         return obj
+
+    def was_set(self, field_name: str) -> bool:
+        """True when the user's dict explicitly provided this field (a
+        default-constructed section reports False for everything). Lets
+        callers distinguish 'reference default' from 'user asked for it'."""
+        return field_name in getattr(self, "_explicit_keys", ())
 
     def validate(self) -> None:
         """Override for cross-field checks."""

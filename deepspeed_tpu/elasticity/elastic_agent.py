@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -31,9 +32,14 @@ def probe_devices(devices=None) -> List:
     chips)."""
     devices = list(devices if devices is not None else jax.devices())
     healthy = []
+    # Build the probe host-side: jnp.ones would materialize on the DEFAULT
+    # device first, so if the default device is the dead chip every probe
+    # would fail during array creation and the survivors would be reported
+    # unhealthy too.
+    probe = np.ones((8,), np.float32)
     for d in devices:
         try:
-            x = jax.device_put(jnp.ones((8,), jnp.float32), d)
+            x = jax.device_put(probe, d)
             if float(jax.device_get(jnp.sum(x + 1.0))) == 16.0:
                 healthy.append(d)
             else:  # pragma: no cover - wrong math = sick chip

@@ -69,6 +69,11 @@ class InferenceConfig:
     # fused_gemm_gelu); tp=1 only. None -> on for float weights, off for
     # int8 (measured: fusion hurts the dequant-in-scan path ~20% on v5e)
     fuse_gemms: Optional[bool] = None
+    # int8 KV cache for decode: at long context the cache read is the
+    # decode bound, and int8 halves it (per-position scales keep the
+    # softmax exact to ~1e-2 rel). None -> ON for transformer decode
+    # (pass 0 to opt out and keep the compute-dtype cache).
+    kv_cache_bits: Optional[int] = None
 
 
 class InferenceEngine:
@@ -96,6 +101,29 @@ class InferenceEngine:
         self._quantized = bool(config.quantize_bits)
         from deepspeed_tpu.models.transformer import TransformerConfig
         is_tf = isinstance(getattr(model, "config", None), TransformerConfig)
+
+        # int8 KV cache (default ON for transformer decode): the ModelSpec
+        # closures capture the config, so flip the flag by REBUILDING the
+        # spec before the quantize/fuse branches below read model.config.
+        # A model that explicitly asked for the Pallas decode kernel
+        # (attention_impl="pallas") keeps its float cache by default — the
+        # kernel reads float buffers, and silently bypassing it would
+        # change the path the user selected.
+        if is_tf:
+            kvb = config.kv_cache_bits
+            if kvb is None:
+                kvb = (model.config.kv_cache_bits
+                       if model.config.attention_impl == "pallas" else 8)
+            kvb = int(kvb)
+            if kvb not in (0, 8):
+                raise ValueError(f"kv_cache_bits={kvb} unsupported "
+                                 "(0 = float cache, 8 = int8)")
+            if model.config.kv_cache_bits != kvb:
+                import dataclasses as _dc
+                from deepspeed_tpu.models import make_model as _mk
+                model = _mk(_dc.replace(model.config, kv_cache_bits=kvb),
+                            name=model.name)
+                self.model = model
         # decode GEMV fusion (wqkv, w_in_gate): tp=1 only — the concat dim
         # would interleave head shards under tensor parallelism
         fuse = (config.fuse_gemms if config.fuse_gemms is not None

@@ -291,10 +291,24 @@ def _bert_table(cfg):
     pre = r"^(?:bert\.|roberta\.)?"
     lyr = pre + r"encoder\.layer\.(\d+)\."
     att = lyr + r"attention\."
+
+    def pos_check(w):
+        # A bare RoBERTa encoder dict (no 'roberta.' prefix) detects as
+        # BERT; its position table has exactly max_seq_len+2 rows (HF's
+        # padding_idx offset). Loading it unsliced would shift every
+        # position embedding by two rows — refuse instead of drifting.
+        if w.shape[0] == cfg.max_seq_len + 2:
+            raise ValueError(
+                f"position-embedding table has {w.shape[0]} rows = "
+                f"max_seq_len+2 — this looks like a bare RoBERTa state "
+                "dict whose rows carry the padding_idx+1=2 offset; pass "
+                "family='roberta' so the offset slice is applied")
+        return w
+
     return [
         (pre + r"embeddings\.word_embeddings\.weight$", ("tok_embed",), None),
         (pre + r"embeddings\.position_embeddings\.weight$",
-         ("pos_embed",), None),
+         ("pos_embed",), pos_check),
         (pre + r"embeddings\.token_type_embeddings\.weight$",
          ("tok_type_embed",), None),
         (pre + r"embeddings\.LayerNorm\.weight$", ("embed_norm_scale",), None),

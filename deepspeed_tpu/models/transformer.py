@@ -79,6 +79,12 @@ class TransformerConfig:
     # scan body dequantizes ONE layer's slice — peak bf16 weight residency is
     # a single layer. Convert with models.quantize_layer_stack.
     quantized_weights: bool = False
+    # int8 KV cache for decode (additive over the reference's fp16 decode
+    # workspace, inference_context.h): ring buffers live in HBM as int8
+    # with per-(batch, head, position) f32 scales. The scale factors out of
+    # the d-contraction, so attention reads HALF the cache bytes — at long
+    # context the KV read is the decode bound. 0 = off, 8 = int8.
+    kv_cache_bits: int = 0
     # MoE (reference: deepspeed/moe/*; config keys from MoEConfig)
     num_experts: int = 1
     top_k: int = 2
@@ -529,7 +535,7 @@ def _activation(x, gate, cfg: TransformerConfig):
 
 
 def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
-                      kv_row=None):
+                      kv_row=None, kv_scale=None):
     """Single-token GQA attention against a KV ring buffer, with NO repeat of
     the kv heads in memory (reference's decode kernels repeat in registers:
     ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``).
@@ -558,12 +564,29 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
     use_pallas = (cfg is not None and cfg.attention_impl == "pallas"
                   and cfg.position_type != "alibi"
                   and q.dtype != jnp.float16  # Mosaic has no f16
+                  and kv_scale is None        # kernel reads float caches
                   and jax.default_backend() in ("tpu", "axon") and D >= 64)
     if use_pallas:
         from deepspeed_tpu.ops.decode_attention import decode_attention
         return decode_attention(q, ck, cv, index, kv_row=kv_row)
     qg = q.reshape(B, Nkv, rep, D)
-    scores = jnp.einsum("bgrd,bgtd->bgrt", qg, ck).astype(jnp.float32)
+    if kv_scale is not None:
+        # int8 cache, int8 MATH: a dequantize-then-bf16-dot would
+        # materialize the converted cache and read MORE bytes than the
+        # bf16 path. Instead the single-token q is quantized per row
+        # (cheap, O(B*Nq*D)) and the contraction runs on the int8 MXU
+        # (int8 x int8 -> int32); the q/k scales multiply the SCORES.
+        q32 = qg.astype(jnp.float32)
+        qs = jnp.maximum(jnp.max(jnp.abs(q32), axis=-1) / 127.0, 1e-8)
+        qi = jnp.clip(jnp.round(q32 / qs[..., None]), -127, 127
+                      ).astype(jnp.int8)
+        scores = jnp.einsum("bgrd,bgtd->bgrt", qi, ck,
+                            preferred_element_type=jnp.int32
+                            ).astype(jnp.float32)
+        scores = scores * qs[..., None] * kv_scale[0][:, :, None, :]
+    else:
+        scores = jnp.einsum("bgrd,bgtd->bgrt", qg, ck
+                            ).astype(jnp.float32)
     scores = scores / math.sqrt(D)
     if cfg is not None and cfg.position_type == "alibi":
         rel = (jnp.arange(T) - index).astype(jnp.float32)        # k - q
@@ -579,15 +602,29 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
                             k_row.astype(qg.dtype)).astype(jnp.float32)
         s_self = s_self / math.sqrt(D)
         scores = jnp.concatenate([scores, s_self], axis=-1)
-        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bgrt,bgtd->bgrd", probs[..., :T], cv)
-        out = out + probs[..., T:] * v_row.astype(q.dtype)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
+        out = out + probs[..., T:].astype(q.dtype) * v_row.astype(q.dtype)
         return out.reshape(B, 1, Nq, D)
     valid = (jnp.arange(T) <= index)[None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bgrt,bgtd->bgrd", probs, cv)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = _decode_pv(probs, cv, kv_scale, q.dtype)
     return out.reshape(B, 1, Nq, D)
+
+
+def _decode_pv(probs, cv, kv_scale, dtype):
+    """probs @ V. int8 cache: fold the per-position V scale into the probs,
+    re-quantize them per row, and keep the contraction on the int8 MXU —
+    the V bytes stay int8 end to end."""
+    if kv_scale is None:
+        return jnp.einsum("bgrt,bgtd->bgrd", probs.astype(dtype), cv)
+    pv = probs * kv_scale[1][:, :, None, :]
+    ps = jnp.maximum(jnp.max(pv, axis=-1) / 127.0, 1e-20)
+    pvi = jnp.clip(jnp.round(pv / ps[..., None]), 0, 127).astype(jnp.int8)
+    out = jnp.einsum("bgrt,bgtd->bgrd", pvi, cv,
+                     preferred_element_type=jnp.int32).astype(jnp.float32)
+    return (out * ps[..., None]).astype(dtype)
 
 
 def _maybe_dequant(p, cfg: TransformerConfig):
@@ -766,8 +803,15 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     if cache is not None:
         ck, cv, index = cache[:3]           # [B, nkv, T, hd]
         read_len = cache[3] if len(cache) > 3 else None
-        k_row = jnp.swapaxes(k, 1, 2).astype(ck.dtype)   # [B, nkv, 1, hd]
-        v_row = jnp.swapaxes(v, 1, 2).astype(cv.dtype)
+        kv_scale = cache[4] if len(cache) > 4 else None   # int8 cache
+        # the fresh row stays FLOAT (exact): its logit joins the softmax
+        # separately. int8 caches carry rows in compute dtype (the decode
+        # loop quantizes before the write); float caches keep the cache's
+        # own dtype so a non-cfg.dtype cache (e.g. f32 cache under a bf16
+        # model) still writes without a dtype mismatch.
+        row_dtype = cfg.dtype if kv_scale is not None else ck.dtype
+        k_row = jnp.swapaxes(k, 1, 2).astype(row_dtype)   # [B, nkv, 1, hd]
+        v_row = jnp.swapaxes(v, 1, 2).astype(row_dtype)
         # the buffer is NOT modified here: the fresh row joins the softmax
         # separately and the decode loop writes all layers' rows with one
         # O(L*B*nkv*hd) update — rewriting the ring buffer per layer would
@@ -776,12 +820,16 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         # buffer (the decode loop guarantees index < read_len), so XLA only
         # touches O(read_len) bytes instead of max_len
         if read_len is not None and read_len < ck.shape[2]:
+            sc = (tuple(s[:, :, :read_len] for s in kv_scale)
+                  if kv_scale is not None else None)
             attn_out = _decode_attention(q, ck[:, :, :read_len],
                                          cv[:, :, :read_len], index, cfg,
-                                         kv_row=(k_row, v_row))
+                                         kv_row=(k_row, v_row),
+                                         kv_scale=sc)
         else:
             attn_out = _decode_attention(q, ck, cv, index, cfg,
-                                         kv_row=(k_row, v_row))
+                                         kv_row=(k_row, v_row),
+                                         kv_scale=kv_scale)
         new_kv = (k_row, v_row)
     else:
         if return_kv:
@@ -1049,11 +1097,14 @@ def _gold_logit(logits, safe_labels):
     The one-hot masked reduction keeps the contraction local to each vocab
     shard (each chip sums its chunk, SPMD inserts one psum of [B,S]), and its
     transpose is a broadcast-multiply, which shards cleanly. Exact for f32:
-    the mask selects a single element, no summation error.
+    the mask selects a single element, no summation error. where() rather
+    than a one-hot multiply: 0 * inf = NaN, so -inf-masked vocab entries
+    would silently NaN the loss under a multiply-by-mask.
     """
     iota = lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
-    onehot = (iota == safe_labels[..., None]).astype(logits.dtype)
-    return jnp.sum(logits * onehot, axis=-1)
+    picked = jnp.where(iota == safe_labels[..., None], logits,
+                       jnp.zeros((), logits.dtype))
+    return jnp.sum(picked, axis=-1)
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
@@ -1083,20 +1134,47 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     carries the "heads" logical axis so TP shards the cache like the weights.
     Sequence-major last two dims ([T, hd]) give the decode kernel legal
     (sublane, lane) tiles without a transpose.
+
+    kv_cache_bits=8: buffers are int8 with per-(b, head, t) f32 scales —
+    attention reads half the bytes (see _quant_kv / _decode_attention).
     """
     dtype = dtype or cfg.dtype
     L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
-    return {
-        "k": jnp.zeros((L, batch_size, nkv, max_len, hd), dtype),
-        "v": jnp.zeros((L, batch_size, nkv, max_len, hd), dtype),
-        "index": jnp.zeros((), jnp.int32),
-    }
+    out = {"index": jnp.zeros((), jnp.int32)}
+    if cfg.kv_cache_bits == 8:
+        out["k"] = jnp.zeros((L, batch_size, nkv, max_len, hd), jnp.int8)
+        out["v"] = jnp.zeros((L, batch_size, nkv, max_len, hd), jnp.int8)
+        out["k_scale"] = jnp.zeros((L, batch_size, nkv, max_len),
+                                   jnp.float32)
+        out["v_scale"] = jnp.zeros((L, batch_size, nkv, max_len),
+                                   jnp.float32)
+    else:
+        out["k"] = jnp.zeros((L, batch_size, nkv, max_len, hd), dtype)
+        out["v"] = jnp.zeros((L, batch_size, nkv, max_len, hd), dtype)
+    return out
 
 
-def cache_logical_axes() -> Params:
-    return {"k": ("layers", "batch", "heads", None, None),
-            "v": ("layers", "batch", "heads", None, None),
-            "index": None}
+def cache_logical_axes(cfg: Optional[TransformerConfig] = None) -> Params:
+    out = {"k": ("layers", "batch", "heads", None, None),
+           "v": ("layers", "batch", "heads", None, None),
+           "index": None}
+    if cfg is not None and cfg.kv_cache_bits == 8:
+        out["k_scale"] = ("layers", "batch", "heads", None)
+        out["v_scale"] = ("layers", "batch", "heads", None)
+    return out
+
+
+def _quant_kv(x):
+    """Per-(…, position) symmetric int8: x [..., T, D] float ->
+    (int8 [..., T, D], f32 scale [..., T]). The scale multiplies OUT of the
+    d-contraction, so both attention einsums consume the int8 bytes
+    directly."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127
+                 ).astype(jnp.int8)
+    return q, scale
 
 
 def prefill(params: Params, input_ids, cfg: TransformerConfig, cache: Params,
@@ -1118,15 +1196,27 @@ def prefill(params: Params, input_ids, cfg: TransformerConfig, cache: Params,
     # serves every prompt length in the same padded-shape bucket
     true_len = jnp.asarray(S if length is None else length, jnp.int32)
     k, v = kv  # [L, B, S, nkv, hd] -> cache layout [L, B, nkv, S, hd]
-    new_cache = {
-        "k": lax.dynamic_update_slice(
-            cache["k"], jnp.swapaxes(k, 2, 3).astype(cache["k"].dtype),
-            (0, 0, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(
-            cache["v"], jnp.swapaxes(v, 2, 3).astype(cache["v"].dtype),
-            (0, 0, 0, 0, 0)),
-        "index": true_len,
-    }
+    k, v = jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3)
+    if cfg.kv_cache_bits == 8:
+        kq, ks = _quant_kv(k)
+        vq, vs = _quant_kv(v)
+        new_cache = {
+            "k": lax.dynamic_update_slice(cache["k"], kq, (0, 0, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(cache["v"], vq, (0, 0, 0, 0, 0)),
+            "k_scale": lax.dynamic_update_slice(cache["k_scale"], ks,
+                                                (0, 0, 0, 0)),
+            "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs,
+                                                (0, 0, 0, 0)),
+            "index": true_len,
+        }
+    else:
+        new_cache = {
+            "k": lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
+            "index": true_len,
+        }
     last = lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                     keepdims=False)
     return last, new_cache
@@ -1154,22 +1244,47 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
                   params.get("embed_norm_bias"), cfg)
     positions = jnp.broadcast_to(index[None, None], (B, 1))
 
+    int8_kv = cfg.kv_cache_bits == 8
+
     def body(x_c, xs):
-        layer_p, ck, cv = xs
+        if int8_kv:
+            layer_p, ck, cv, ks, vs = xs
+            c = (ck, cv, index, read_len, (ks, vs))
+        else:
+            layer_p, ck, cv = xs
+            c = (ck, cv, index, read_len)
         if cfg.offload_params:
             layer_p = _fetch_layer(layer_p, cfg)
         y, _, (k_row, v_row) = transformer_layer(
             x_c, layer_p, cfg, positions=positions, deterministic=True,
-            cache=(ck, cv, index, read_len), return_kv=False)
+            cache=c, return_kv=False)
         return y, (k_row, v_row)
 
-    x, (k_rows, v_rows) = lax.scan(body, x, (params["layers"], cache["k"],
-                                             cache["v"]))
+    xs = ((params["layers"], cache["k"], cache["v"], cache["k_scale"],
+           cache["v_scale"]) if int8_kv
+          else (params["layers"], cache["k"], cache["v"]))
+    x, (k_rows, v_rows) = lax.scan(body, x, xs)
     # one tiny [L, B, nkv, 1, hd] column write — the ring buffers update
     # in place (XLA aliases the dus when the cache is a loop carry /
     # donated input), instead of the scan re-stacking full buffers
-    new_k = lax.dynamic_update_slice(cache["k"], k_rows, (0, 0, 0, index, 0))
-    new_v = lax.dynamic_update_slice(cache["v"], v_rows, (0, 0, 0, index, 0))
+    if int8_kv:
+        kq, ks_ = _quant_kv(k_rows)
+        vq, vs_ = _quant_kv(v_rows)
+        new_k = lax.dynamic_update_slice(cache["k"], kq,
+                                         (0, 0, 0, index, 0))
+        new_v = lax.dynamic_update_slice(cache["v"], vq,
+                                         (0, 0, 0, index, 0))
+        new_scales = {
+            "k_scale": lax.dynamic_update_slice(cache["k_scale"], ks_,
+                                                (0, 0, 0, index)),
+            "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs_,
+                                                (0, 0, 0, index)),
+        }
+    else:
+        new_k = lax.dynamic_update_slice(cache["k"], k_rows,
+                                         (0, 0, 0, index, 0))
+        new_v = lax.dynamic_update_slice(cache["v"], v_rows,
+                                         (0, 0, 0, index, 0))
     if cfg.final_norm:
         x = _norm(x, params["final_norm_scale"],
                   params.get("final_norm_bias"), cfg)
@@ -1179,7 +1294,10 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
     if "lm_head_bias" in params:
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
-    return logits[:, 0, :], {"k": new_k, "v": new_v, "index": index + 1}
+    new_cache = {"k": new_k, "v": new_v, "index": index + 1}
+    if int8_kv:
+        new_cache.update(new_scales)
+    return logits[:, 0, :], new_cache
 
 
 def chunked_cross_entropy(x, head, labels, chunk: int,
@@ -1293,5 +1411,5 @@ def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
             prefill(params, input_ids, cfg, cache, **kw),
         decode_step=lambda params, token, cache, **kw:
             decode_step(params, token, cfg, cache, **kw),
-        cache_axes=cache_logical_axes,
+        cache_axes=lambda: cache_logical_axes(cfg),
     )

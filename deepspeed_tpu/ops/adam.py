@@ -22,6 +22,47 @@ from deepspeed_tpu.ops.optimizers import (
 )
 
 
+def fused_adam_update(master, m, v, g, lr_t, step, *, b1, b2, eps,
+                      wd, awm, bc):
+    """The one flat AdamW core shared by every host/device offload variant
+    (reference: the Step kernel of ``csrc/adam/cpu_adam.cpp`` /
+    ``fused_adam.py``). ``g`` arrives already scaled (clip/loss-scale/gas
+    folded in by the caller); returns (master', m', v')."""
+    if wd and not awm:
+        g = g + wd * master
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    if bc:
+        c1 = 1 - b1 ** step.astype(jnp.float32)
+        c2 = 1 - b2 ** step.astype(jnp.float32)
+    else:
+        c1 = c2 = jnp.float32(1.0)
+    upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+    if awm and wd:
+        upd = upd + wd * master
+    return master - lr_t * upd, m, v
+
+
+def adam_tree_update(opt, grads, lr_t, step, coef, *, b1, b2, eps, wd,
+                     awm, bc, out_dtype):
+    """AdamW over a {master, m, v}-leaf tree: returns (new_opt tree,
+    new params tree cast to ``out_dtype``). The shared wrapper for every
+    host-offload flavor that keeps its state as a pytree (the
+    layer-streamed executor's embed/head update, XlaHostAdamSwapper)."""
+    is_opt = lambda x: isinstance(x, dict) and "master" in x  # noqa: E731
+
+    def upd(o, g):
+        master, m, v = fused_adam_update(
+            o["master"], o["m"], o["v"], g.astype(jnp.float32) * coef,
+            lr_t, step, b1=b1, b2=b2, eps=eps, wd=wd, awm=awm, bc=bc)
+        return {"master": master, "m": m, "v": v}
+
+    new_opt = jax.tree.map(upd, opt, grads, is_leaf=is_opt)
+    new_params = jax.tree.map(lambda o: o["master"].astype(out_dtype),
+                              new_opt, is_leaf=is_opt)
+    return new_opt, new_params
+
+
 def adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
          weight_decay: float = 0.0, adam_w_mode: bool = False,
          bias_correction: bool = True, use_master_weights: bool = True,

@@ -83,6 +83,69 @@ def cpu_adam_available() -> bool:
     return _load() is not None
 
 
+def adam_step_flat(master: np.ndarray, m: np.ndarray, v: np.ndarray,
+                   grads: np.ndarray, *, step_num: int, lr: float,
+                   betas=(0.9, 0.999), eps: float = 1e-8,
+                   weight_decay: float = 0.0, adamw_mode: bool = True,
+                   bias_correction: bool = True, grad_scale: float = 1.0,
+                   out: Optional[np.ndarray] = None):
+    """One fused AdamW step over caller-owned flat fp32 state buffers
+    (updated in place). grads: float32, or bf16 bits as uint16. If ``out``
+    is given the updated params are also written there (uint16 bf16 bits
+    for bf16 grads, float32 otherwise); pass None to only advance state.
+    The chunk-granular entry the layer-streamed executor uses — state
+    layout belongs to the caller, unlike the CPUAdam class which owns it."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native cpu_adam library unavailable")
+    b1, b2 = betas
+    if bias_correction:
+        c1 = 1.0 - b1 ** step_num
+        c2 = 1.0 - b2 ** step_num
+    else:
+        c1 = c2 = 1.0
+    g = np.ascontiguousarray(grads).reshape(-1)
+    n = g.size
+    # validate every buffer handed to the C kernel as a raw pointer — a
+    # short/misdtyped array would be silent native memory corruption
+    for name, arr in (("master", master), ("m", m), ("v", v)):
+        if arr.size != n or arr.dtype != np.float32 \
+                or not arr.flags.c_contiguous:
+            raise ValueError(
+                f"{name}: need contiguous float32[{n}], got "
+                f"{arr.dtype}[{arr.size}]"
+                f"{'' if arr.flags.c_contiguous else ' (non-contiguous)'}")
+    if out is not None:
+        want = np.uint16 if g.dtype == np.uint16 else np.float32
+        if out.size != n or out.dtype != want \
+                or not out.flags.c_contiguous:
+            raise ValueError(f"out: need contiguous {np.dtype(want).name}"
+                             f"[{n}], got {out.dtype}[{out.size}]")
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+
+    def p(arr, ct):
+        return arr.ctypes.data_as(ctypes.POINTER(ct))
+
+    if g.dtype == np.uint16:
+        lib.dstpu_adam_step_bf16(
+            p(master, ctypes.c_float), p(m, ctypes.c_float),
+            p(v, ctypes.c_float), p(g, ctypes.c_uint16),
+            p(out, ctypes.c_uint16) if out is not None
+            else ctypes.cast(None, u16p),
+            n, float(lr), b1, b2, eps, weight_decay, int(adamw_mode),
+            c1, c2, float(grad_scale))
+    else:
+        g = g.astype(np.float32, copy=False)
+        lib.dstpu_adam_step_f32(
+            p(master, ctypes.c_float), p(m, ctypes.c_float),
+            p(v, ctypes.c_float), p(g, ctypes.c_float),
+            p(out, ctypes.c_float) if out is not None
+            else ctypes.cast(None, f32p),
+            n, float(lr), b1, b2, eps, weight_decay, int(adamw_mode),
+            c1, c2, float(grad_scale))
+
+
 class CPUAdam:
     """Fused host AdamW over flat fp32 state buffers (master, m, v).
 
@@ -131,37 +194,15 @@ class CPUAdam:
         """grads: uint16 (bf16 bits) or float32, length n. Returns updated
         params (uint16 bf16 bits for bf16 grads, else float32)."""
         g = np.ascontiguousarray(grads).reshape(-1)
-        if g.size != self.n:
-            raise ValueError(f"grad size {g.size} != state size {self.n}")
-        if self.bc:
-            c1 = 1.0 - self.b1 ** step_num
-            c2 = 1.0 - self.b2 ** step_num
-        else:
-            c1 = c2 = 1.0
-        lr_t = float(self.lr if lr is None else lr)
-        if g.dtype == np.uint16:
-            if out is None:
-                out = np.empty(self.n, np.uint16)
-            self._lib.dstpu_adam_step_bf16(
-                self._p(self.master, ctypes.c_float),
-                self._p(self.m, ctypes.c_float),
-                self._p(self.v, ctypes.c_float),
-                self._p(g, ctypes.c_uint16),
-                self._p(out, ctypes.c_uint16),
-                self.n, lr_t, self.b1, self.b2, self.eps, self.wd,
-                int(self.awm), c1, c2, float(grad_scale))
-            return out
-        g = g.astype(np.float32, copy=False)
         if out is None:
-            out = np.empty(self.n, np.float32)
-        self._lib.dstpu_adam_step_f32(
-            self._p(self.master, ctypes.c_float),
-            self._p(self.m, ctypes.c_float),
-            self._p(self.v, ctypes.c_float),
-            self._p(g, ctypes.c_float),
-            self._p(out, ctypes.c_float),
-            self.n, lr_t, self.b1, self.b2, self.eps, self.wd,
-            int(self.awm), c1, c2, float(grad_scale))
+            out = np.empty(self.n,
+                           np.uint16 if g.dtype == np.uint16 else np.float32)
+        adam_step_flat(self.master, self.m, self.v, g, step_num=step_num,
+                       lr=float(self.lr if lr is None else lr),
+                       betas=(self.b1, self.b2), eps=self.eps,
+                       weight_decay=self.wd, adamw_mode=self.awm,
+                       bias_correction=self.bc, grad_scale=grad_scale,
+                       out=out)
         return out
 
     def clip_coef(self, sq_total: float, clip: float,

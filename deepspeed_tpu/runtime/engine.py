@@ -713,8 +713,8 @@ class Engine:
         p = dict(cfg.optimizer.params) if cfg.optimizer else {}
         name = _opt_name(cfg)
         if self._swap_storage == "cpu_adam":
-            return HostAdamSwapper(
-                param_shapes, mesh=self.mesh,
+            kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+            kw = dict(
                 betas=tuple(p.get("betas", (0.9, 0.999))),
                 eps=p.get("eps", 1e-8),
                 weight_decay=p.get("weight_decay",
@@ -723,6 +723,16 @@ class Engine:
                 bias_correction=p.get("bias_correction", True),
                 param_shardings=self.param_shardings,
                 compute_dtype=self.compute_dtype)
+            if (get_accelerator().platform != "cpu"
+                    and "pinned_host" in kinds):
+                # TPU-native flavor: Adam runs on the TPU host INSIDE the
+                # XLA program (compute_on) over pinned-resident state — no
+                # process-side grad fetch, so it's fast even when this
+                # process is remote from the TPU host
+                from deepspeed_tpu.runtime.swap_tensor import \
+                    XlaHostAdamSwapper
+                return XlaHostAdamSwapper(param_shapes, mesh=self.mesh, **kw)
+            return HostAdamSwapper(param_shapes, mesh=self.mesh, **kw)
         grad_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self.grad_specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -776,7 +786,15 @@ class Engine:
             gas=cfg.gradient_accumulation_steps,
             mesh=self.mesh if self._infinity_multi else None,
             fp16=(dataclasses.asdict(cfg.fp16) if cfg.fp16.enabled else None),
-            compression=self._compression)
+            compression=self._compression,
+            use_cpu_adam=off_o.use_cpu_adam,
+            # live cache only when the user set the knob: the reference
+            # default (1e9) silently pinning ~2GB of bits in HBM could OOM
+            # workloads sized without it
+            max_live_params=(
+                cfg.zero_optimization.stage3_max_live_parameters
+                if cfg.zero_optimization.was_set("stage3_max_live_parameters")
+                else 0))
 
     def _state_shardings_from(self, state_shapes):
         """Build shardings for the full train-state pytree: params use

@@ -196,7 +196,8 @@ class InfinityExecutor:
                  bias_correction: bool = True, grad_clip: float = 0.0,
                  backend: str = "nvme", param_cache_bytes: int = 0,
                  gas: int = 1, mesh=None, fp16: Optional[Dict[str, Any]] = None,
-                 compression=None):
+                 compression=None, use_cpu_adam: bool = False,
+                 max_live_params: int = 0):
         if model_cfg.num_experts > 1:
             raise ValueError("offload_param.device=nvme supports dense "
                              "transformers (MoE experts not yet streamed)")
@@ -243,6 +244,30 @@ class InfinityExecutor:
         self._sizes = [int(np.prod(s)) for s in self._shapes]
         numel = sum(self._sizes)
         self._pinned = backend == "pinned"
+
+        # --- host-resident optimizer (ZeRO-Offload's compute design: the
+        # fp32 master/m/v never cross the host<->HBM bus; reference:
+        # csrc/adam/cpu_adam.cpp:21). Two TPU-native flavors:
+        #   "xla_host" (pinned backend): the Adam sweep runs ON the TPU
+        #     host's CPUs inside the XLA program via
+        #     jax.experimental.compute_on("device_host") — opt chunks stay
+        #     in pinned_host memory end to end, and per step only bf16
+        #     grads cross down (params were already streaming for fwd/bwd).
+        #   "native" (host/nvme backends, i.e. this process IS the TPU
+        #     host): the fused C++ AdamW (csrc/adam/dstpu_cpu_adam.cpp)
+        #     updates the store's chunks in place.
+        self._host_adam = None
+        if use_cpu_adam:
+            if self._pinned:
+                self._host_adam = "xla_host"
+            else:
+                from deepspeed_tpu.ops.cpu_adam import cpu_adam_available
+                if cpu_adam_available():
+                    self._host_adam = "native"
+                else:  # pragma: no cover - toolchain missing
+                    logger.warning("use_cpu_adam requested but the native "
+                                   "library failed to build; optimizer "
+                                   "chunks will round-trip through HBM")
 
         # --- mesh: offload composes with data/fsdp parallelism (reference:
         # ZeRO-3 + NVMe at 512 GPUs, stage3.py:65 + partitioned_param_
@@ -310,14 +335,41 @@ class InfinityExecutor:
             self._cache_layers = param_cache_bytes // (2 * self.chunk) \
                 if param_cache_bytes else L
         self._param_cache: Dict[int, np.ndarray] = {}
+        # HBM-resident bits cache (reference: stage3_max_live_parameters —
+        # params kept live in device memory, stage3.py's max_live knob).
+        # Layers whose bf16 bits fit under the budget skip the fwd/bwd
+        # re-fetch DMA entirely; the update refreshes cached entries.
+        self._hbm_cache: Dict[int, Any] = {}
+        self._hbm_cache_layers = (int(max_live_params) // max(1, numel)
+                                  if max_live_params else 0)
+        if self._hbm_cache_layers:
+            logger.info(
+                f"param live-cache: up to {min(self._hbm_cache_layers, L)} "
+                f"of {L} layers resident in device memory "
+                f"({max_live_params/1e9:.2f}B param budget)")
 
         self._build_jits()
         self._init_params(rng)
+        tier = {"xla_host": ", Adam on the TPU host (compute_on; opt state "
+                            "never crosses the host<->HBM bus)",
+                "native": ", Adam in the native host kernel (opt state "
+                          "never touches the device)"}.get(self._host_adam, "")
         logger.info(
             f"ZeRO-Infinity layer streaming: {L} layers x "
             f"{numel/1e6:.1f}M params on {backend} "
             f"({self.num_params/1e9:.2f}B layer params total, chunk "
-            f"{self.chunk*2/1e6:.0f}MB bf16 + {self.chunk*12/1e6:.0f}MB opt)")
+            f"{self.chunk*2/1e6:.0f}MB bf16 + {self.chunk*12/1e6:.0f}MB opt)"
+            f"{tier}")
+
+    # ------------------------------------------------------------------
+    def _adam_math(self, master, m, v, g, lr_t, step):
+        """The one AdamW core every variant (device chunk, host chunk,
+        embed/head device, embed/head host) traces: returns (master', m',
+        v'). ``g`` arrives already scaled by the clip/scale coefficient."""
+        from deepspeed_tpu.ops.adam import fused_adam_update
+        return fused_adam_update(master, m, v, g, lr_t, step,
+                                 b1=self.b1, b2=self.b2, eps=self.eps,
+                                 wd=self.wd, awm=self.awm, bc=self.bc)
 
     # ------------------------------------------------------------------
     def _build_jits(self):
@@ -447,29 +499,22 @@ class InfinityExecutor:
             lambda t, inv: sum(jnp.sum((l.astype(jnp.float32) * inv) ** 2)
                                for l in jax.tree.leaves(t)))
 
+        adam_math = self._adam_math
+
         def adam_chunk(opt_buf, grad, param_bits, have_opt, lr_t, step,
                       coef):
             """Fused flat AdamW on one layer chunk. have_opt=False -> lazy
-            init (master from the bf16 params, m = v = 0)."""
+            init (master from the bf16 params, m = v = 0). grad: fp32, or
+            bf16 bits as uint16 (the host-Adam wire dtype)."""
             p32 = jax.lax.bitcast_convert_type(
                 param_bits, jnp.bfloat16).astype(jnp.float32)
             master = jnp.where(have_opt, opt_buf[0], p32)
             m = jnp.where(have_opt, opt_buf[1], 0.0)
             v = jnp.where(have_opt, opt_buf[2], 0.0)
-            g = grad * coef
-            if wd and not awm:
-                g = g + wd * master
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * g * g
-            if bc:
-                c1 = 1 - b1 ** step.astype(jnp.float32)
-                c2 = 1 - b2 ** step.astype(jnp.float32)
-            else:
-                c1 = c2 = jnp.float32(1.0)
-            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
-            if awm and wd:
-                upd = upd + wd * master
-            master = master - lr_t * upd
+            if grad.dtype == jnp.uint16:
+                grad = jax.lax.bitcast_convert_type(
+                    grad, jnp.bfloat16).astype(jnp.float32)
+            master, m, v = adam_math(master, m, v, grad * coef, lr_t, step)
             new_bits = jax.lax.bitcast_convert_type(
                 master.astype(jnp.bfloat16), jnp.uint16)
             return jnp.stack([master, m, v]), new_bits
@@ -479,6 +524,57 @@ class InfinityExecutor:
         self._zeros_opt = jax.jit(
             lambda: jnp.zeros((_PLANES, chunk), jnp.float32),
             out_shardings=self._opt_dev_sh)
+
+        if self._host_adam == "xla_host":
+            # the same math compiled INTO the host memory space: opt chunks
+            # live (and stay) in pinned_host; the sweep runs on the TPU
+            # host's cores; only the fence scalar lands in device memory.
+            # `have` is STATIC (two compiled variants): a traced
+            # jnp.where(have, ...) would select between host-space planes
+            # and default-space constants, which XLA rejects inside a
+            # compute_on region.
+            from jax.experimental.compute_on import compute_on
+
+            def adam_chunk_host(opt_buf, grad_bits, param_bits, lr_t, step,
+                                coef, have):
+                @compute_on("device_host")
+                @jax.jit
+                def upd(opt_buf, grad_bits, param_bits, lr_t, step, coef):
+                    flat = jax.lax.bitcast_convert_type(grad_bits,
+                                                        jnp.bfloat16)
+                    g = flat.astype(jnp.float32) * coef
+                    if have:
+                        master, m, v = opt_buf[0], opt_buf[1], opt_buf[2]
+                    else:
+                        master = jax.lax.bitcast_convert_type(
+                            param_bits, jnp.bfloat16).astype(jnp.float32)
+                        # derive zeros from the host array: a fresh
+                        # jnp.zeros constant would be default-space
+                        m = master * 0.0
+                        v = master * 0.0
+                    master, m, v = adam_math(master, m, v, g, lr_t, step)
+                    new_bits = jax.lax.bitcast_convert_type(
+                        master.astype(jnp.bfloat16), jnp.uint16)
+                    return jnp.stack([master, m, v]), new_bits, master[0]
+                return upd(opt_buf, grad_bits, param_bits, lr_t, step, coef)
+
+            # scalars must enter host space too — a device-space scalar
+            # poisons every elementwise op it touches with the default space
+            scalar = (self._repl_host_sh,) * 3
+            self._adam_chunk_host = jax.jit(
+                adam_chunk_host,
+                in_shardings=(self._opt_host_sh, self._bits_host_sh,
+                              self._bits_host_sh) + scalar,
+                out_shardings=(self._opt_host_sh, self._bits_host_sh,
+                               self._repl_dev_sh),
+                donate_argnums=(0,), static_argnums=(6,))
+            self._zeros_opt_host = jax.jit(
+                lambda: jnp.zeros((_PLANES, chunk), jnp.float32),
+                out_shardings=self._opt_host_sh)
+            # device-side grad -> bf16-bits cast (halves the staging DMA)
+            self._grad_bits = jax.jit(
+                lambda g: jax.lax.bitcast_convert_type(
+                    g.astype(jnp.bfloat16), jnp.uint16))
 
     # ------------------------------------------------------------------
     def _init_params(self, rng):
@@ -533,37 +629,47 @@ class InfinityExecutor:
         elif self.mesh.size > 1:
             self.nl_opt = jax.device_put(self.nl_opt, self._repl_dev_sh)
 
+        from deepspeed_tpu.ops.adam import adam_tree_update
+
+        def nl_update_tree(opt, grads, lr_t, step, coef):
+            """Shared embed/head update over the {master,m,v}-leaf tree."""
+            return adam_tree_update(
+                opt, grads, lr_t, step, coef, b1=self.b1, b2=self.b2,
+                eps=self.eps, wd=self.wd, awm=self.awm, bc=self.bc,
+                out_dtype=self.cfg.dtype)
+
         def nl_adam(opt, grads, params, lr_t, step, coef):
-            b1, b2, eps = self.b1, self.b2, self.eps
-            wd, awm, bc = self.wd, self.awm, self.bc
-
-            def upd(o, g):
-                g = g.astype(jnp.float32) * coef
-                master = o["master"]
-                if wd and not awm:
-                    g = g + wd * master
-                m = b1 * o["m"] + (1 - b1) * g
-                v = b2 * o["v"] + (1 - b2) * g * g
-                if bc:
-                    c1 = 1 - b1 ** step.astype(jnp.float32)
-                    c2 = 1 - b2 ** step.astype(jnp.float32)
-                else:
-                    c1 = c2 = jnp.float32(1.0)
-                u = (m / c1) / (jnp.sqrt(v / c2) + eps)
-                if awm and wd:
-                    u = u + wd * master
-                master = master - lr_t * u
-                return {"master": master, "m": m, "v": v}
-
-            new_opt = jax.tree.map(
-                upd, opt, grads,
-                is_leaf=lambda x: isinstance(x, dict) and "master" in x)
-            new_params = jax.tree.map(
-                lambda o: o["master"].astype(self.cfg.dtype), new_opt,
-                is_leaf=lambda x: isinstance(x, dict) and "master" in x)
-            return new_opt, new_params
+            return nl_update_tree(opt, grads, lr_t, step, coef)
 
         self._nl_adam = jax.jit(nl_adam, donate_argnums=(0,))
+
+        if self._host_adam == "xla_host":
+            # embed/head update on the TPU host too: its fp32 state
+            # (12 bytes/param — GBs at 7B vocab+width) stops round-tripping
+            # host<->HBM; per step only compute-dtype grads go down and
+            # compute-dtype params come back up.
+            from jax.experimental.compute_on import compute_on
+
+            def nl_adam_host(opt, grads, lr_t, step, coef):
+                @compute_on("device_host")
+                @jax.jit
+                def upd_all(opt, grads, lr_t, step, coef):
+                    return nl_update_tree(opt, grads, lr_t, step, coef)
+                return upd_all(opt, grads, lr_t, step, coef)
+
+            host_of = lambda t: jax.tree.map(  # noqa: E731
+                lambda _: self._repl_host_sh, t)
+            grads_shape = jax.tree.map(
+                lambda o: o["master"], self.nl_opt,
+                is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+            self._nl_adam_host = jax.jit(
+                nl_adam_host,
+                in_shardings=(host_of(self.nl_opt), host_of(grads_shape),
+                              self._repl_host_sh, self._repl_host_sh,
+                              self._repl_host_sh),
+                out_shardings=(host_of(self.nl_opt), host_of(grads_shape)),
+                donate_argnums=(0,))
+            self._nl_grads_host_sh = host_of(grads_shape)
 
     # ------------------------------------------------------------------
     # IO helpers (prefetched)
@@ -579,17 +685,38 @@ class InfinityExecutor:
         return got
 
     def _param_dev(self, i: int):
-        """Device handle for layer i's param bits. Pinned backend: eager
-        pinned_host->HBM DMA (async dispatch — issuing it a layer ahead IS
-        the prefetch). File backends: host numpy (the jit call uploads;
-        multi-device meshes shard the upload so each chip receives only its
-        fsdp slice)."""
+        """Device handle for layer i's param bits. Live-cached layers skip
+        IO entirely. Pinned backend: eager pinned_host->HBM DMA (async
+        dispatch — issuing it a layer ahead IS the prefetch). File
+        backends: host numpy (the jit call uploads; multi-device meshes
+        shard the upload so each chip receives only its fsdp slice)."""
+        got = self._hbm_cache.get(i)
+        if got is not None:
+            return got
         h = self._get_param(i)
         if self._pinned or self.mesh.size > 1:
-            return jax.device_put(h, self._bits_dev_sh)
+            h = jax.device_put(h, self._bits_dev_sh)
+        if self._hbm_cache_layers and \
+                len(self._hbm_cache) < self._hbm_cache_layers:
+            if not (self._pinned or self.mesh.size > 1):
+                h = jnp.asarray(h)   # materialize on device for the cache
+            self._hbm_cache[i] = h
         return h
 
+    def _refresh_live_cache(self, i: int, bits, *, from_host: bool = False):
+        """After an update, keep layer i's NEW bits live in device memory
+        (within budget) so the next fwd/bwd skips the fetch."""
+        if not self._hbm_cache_layers:
+            return
+        if i in self._hbm_cache or \
+                len(self._hbm_cache) < self._hbm_cache_layers:
+            self._hbm_cache[i] = (jax.device_put(bits, self._bits_dev_sh)
+                                  if from_host else bits)
+
     def _fetch_param_async(self, i: int):
+        got = self._hbm_cache.get(i)
+        if got is not None:
+            return got
         if self._pinned:
             return self._param_dev(i)  # async dispatch, returns a handle
         if i in self._param_cache:
@@ -597,6 +724,8 @@ class InfinityExecutor:
         return self._pool.submit(self._get_param, i)
 
     def _resolve_param(self, fut, i: int):
+        if fut is not None and not hasattr(fut, "result"):
+            return fut   # already a device handle (live cache / pinned)
         if self._pinned:
             return fut if fut is not None else self._param_dev(i)
         h = fut.result() if fut is not None else self._get_param(i)
@@ -689,6 +818,27 @@ class InfinityExecutor:
         scale = self._scale if self.fp16 else 1.0
         scale_t = jnp.float32(scale)
         step_t = jnp.int32(self.applied_steps)
+
+        # ---- update/backward overlap (xla_host Adam only) ----
+        # With no clip, no fp16 overflow gate, and gas=1, the Adam update
+        # for layer i depends only on layer i's grads (coef = 1 is known
+        # up front) — so it can dispatch the moment layer i's grads are
+        # staged, and the TPU-host cores run the Adam sweep CONCURRENTLY
+        # with the device's backward of the remaining layers. (The generic
+        # path must wait for the global grad norm.)
+        overlap = (self._host_adam == "xla_host" and gas == 1
+                   and not self.fp16
+                   and not (self.clip and self.clip > 0))
+        overlap_fence = None
+        pending_refresh = []
+        if overlap:
+            step_next = self.applied_steps + 1
+            lr_val = (self.lr if not callable(self.lr)
+                      else self.lr(step_next))
+            ov_lr, ov_step, ov_coef = jax.device_put(
+                (jnp.float32(lr_val), jnp.float32(step_next),
+                 jnp.float32(1.0)), self._repl_host_sh)
+
         for g in range(gas):
             sl = slice(g * mb, (g + 1) * mb) if gas > 1 else slice(None)
             ids, labels = ids_all[sl], labels_all[sl]
@@ -723,7 +873,33 @@ class InfinityExecutor:
                         dp = self._scalar_add(self._to_dev(grad_stage[i]), dp)
                         if last_mb:
                             sq = self._sq(dp)
-                    grad_stage[i] = self._to_host(dp)
+                    if overlap:
+                        # stage bf16 grad bits and dispatch the host Adam
+                        # for this layer right now — it runs on the TPU
+                        # host while the device keeps doing backward
+                        gbits = self._to_host(self._grad_bits(dp))
+                        opt_h = self.store.read_opt(i)
+                        have = opt_h is not None
+                        if not have:
+                            opt_h = self._zeros_opt_host()
+                        new_opt, new_bits, overlap_fence = \
+                            self._adam_chunk_host(
+                                opt_h, gbits, self.store.read_param(i),
+                                ov_lr, ov_step, ov_coef, have)
+                        self.store.write_opt(i, new_opt)
+                        self.store.write_param(i, new_bits)
+                        # cache refresh is DEFERRED to after the backward:
+                        # an eager pinned->HBM device_put here would make
+                        # the device stream wait on this layer's host Adam
+                        # before running the next backward layer
+                        pending_refresh.append((i, new_bits))
+                    elif last_mb and self._host_adam == "xla_host":
+                        # final stage in bf16 bits — the host-Adam wire
+                        # dtype (halves the grad DMA; reference ships f16
+                        # grads to its CPU-Adam the same way)
+                        grad_stage[i] = self._to_host(self._grad_bits(dp))
+                    else:
+                        grad_stage[i] = self._to_host(dp)
                     sq_layer[i] = sq
                 else:
                     dp_host = np.asarray(jax.device_get(dp))
@@ -777,37 +953,93 @@ class InfinityExecutor:
 
         # non-layer (embed/head) update first: frees its fp32 grads before
         # the layer sweep's chunk buffers arrive
-        nl_opt_dev = (jax.device_put(self.nl_opt, self._repl_dev_sh)
-                      if self._pinned else self.nl_opt)
-        new_nl_opt, self.nl_params = self._nl_adam(
-            nl_opt_dev, nl_grads, self.nl_params, lr_t, stepc, coef_t)
-        self.nl_opt = (jax.device_put(new_nl_opt, self._repl_host_sh)
-                       if self._pinned else new_nl_opt)
+        if self._host_adam == "xla_host":
+            # embed/head Adam on the TPU host: stage 2-byte grads down,
+            # bring compute-dtype params up — the fp32 state stays
+            # pinned-resident. Wire is bf16 even under fp16: scaled fp32
+            # embed grads can exceed f16's 65504 max, which would silently
+            # become inf AFTER the overflow check already passed
+            wire = (jnp.bfloat16 if self.cfg.dtype == jnp.float16
+                    else self.cfg.dtype)
+            nl_g_host = jax.device_put(
+                jax.tree.map(lambda g: g.astype(wire), nl_grads),
+                self._nl_grads_host_sh)
+            lr_h, step_h, coef_h = jax.device_put(
+                (lr_t, stepc, coef_t), self._repl_host_sh)
+            self.nl_opt, nl_params_host = self._nl_adam_host(
+                self.nl_opt, nl_g_host, lr_h, step_h, coef_h)
+            self.nl_params = jax.device_put(nl_params_host,
+                                            self._repl_dev_sh)
+        else:
+            nl_opt_dev = (jax.device_put(self.nl_opt, self._repl_dev_sh)
+                          if self._pinned else self.nl_opt)
+            new_nl_opt, self.nl_params = self._nl_adam(
+                nl_opt_dev, nl_grads, self.nl_params, lr_t, stepc, coef_t)
+            self.nl_opt = (jax.device_put(new_nl_opt, self._repl_host_sh)
+                           if self._pinned else new_nl_opt)
         del nl_grads
 
-        opt_fut = (self.store.read_opt(0) if self._pinned
-                   else self._pool.submit(self.store.read_opt, 0))
-        for i in range(L):
-            opt_host = opt_fut if self._pinned else opt_fut.result()
-            if i + 1 < L:
-                opt_fut = (self.store.read_opt(i + 1) if self._pinned
-                           else self._pool.submit(self.store.read_opt, i + 1))
-            have = opt_host is not None
-            opt_dev = (self._to_dev(opt_host, self._opt_dev_sh) if have
-                       else self._zeros_opt())
-            new_buf, new_bits = self._adam_chunk(
-                opt_dev, self._to_dev(grad_stage[i]), self._param_dev(i),
-                jnp.asarray(have), lr_t, stepc, coef_t)
-            grad_stage[i] = None
-            self._write_layer_async(i, new_buf, new_bits)
-            if self._pinned:
-                # bound in-flight chunk buffers to one layer: at 7B a layer's
-                # (3, C) fp32 opt buffer is 2.4 GB, and letting the async
-                # dispatch run ahead piles up donated+new buffers past HBM.
-                # (block_until_ready is a no-op through the relay transport;
-                # a scalar fetch is the reliable fence.)
-                np.asarray(jax.device_get(new_buf[0, 0]))
-            del opt_dev, new_buf, new_bits
+        if overlap:
+            # layer updates were dispatched during backward; one tail fence
+            # orders them before the step returns. Cache refreshes go out
+            # now — each pinned->HBM transfer depends only on its own
+            # layer's host Adam, so they pipeline with the sweep's tail.
+            for i_r, bits_r in pending_refresh:
+                self._refresh_live_cache(i_r, bits_r, from_host=True)
+            pending_refresh.clear()
+            if overlap_fence is not None:
+                np.asarray(jax.device_get(overlap_fence))
+        elif self._host_adam == "xla_host":
+            # opt chunks never leave pinned_host: the Adam sweep runs on the
+            # TPU host's cores (compute_on). No per-layer fence needed — the
+            # chunks stay host-side, so nothing piles up in HBM; one tail
+            # fence orders the sweep before the step returns.
+            fence = None
+            lr_h, step_h, coef_h = jax.device_put(
+                (lr_t, stepc, coef_t), self._repl_host_sh)
+            for i in range(L):
+                opt_h = self.store.read_opt(i)
+                have = opt_h is not None
+                if not have:
+                    opt_h = self._zeros_opt_host()
+                new_opt, new_bits, fence = self._adam_chunk_host(
+                    opt_h, grad_stage[i], self.store.read_param(i),
+                    lr_h, step_h, coef_h, have)
+                grad_stage[i] = None
+                self.store.write_opt(i, new_opt)
+                self.store.write_param(i, new_bits)
+                self._refresh_live_cache(i, new_bits, from_host=True)
+            if fence is not None:
+                np.asarray(jax.device_get(fence))
+        elif self._host_adam == "native":
+            self._native_update_sweep(grad_stage, float(lr_t), coef)
+        else:
+            opt_fut = (self.store.read_opt(0) if self._pinned
+                       else self._pool.submit(self.store.read_opt, 0))
+            for i in range(L):
+                opt_host = opt_fut if self._pinned else opt_fut.result()
+                if i + 1 < L:
+                    opt_fut = (self.store.read_opt(i + 1) if self._pinned
+                               else self._pool.submit(self.store.read_opt,
+                                                      i + 1))
+                have = opt_host is not None
+                opt_dev = (self._to_dev(opt_host, self._opt_dev_sh) if have
+                           else self._zeros_opt())
+                new_buf, new_bits = self._adam_chunk(
+                    opt_dev, self._to_dev(grad_stage[i]), self._param_dev(i),
+                    jnp.asarray(have), lr_t, stepc, coef_t)
+                grad_stage[i] = None
+                self._write_layer_async(i, new_buf, new_bits)
+                self._refresh_live_cache(i, new_bits)
+                if self._pinned:
+                    # bound in-flight chunk buffers to one layer: at 7B a
+                    # layer's (3, C) fp32 opt buffer is 2.4 GB, and letting
+                    # the async dispatch run ahead piles up donated+new
+                    # buffers past HBM. (block_until_ready is a no-op through
+                    # the relay transport; a scalar fetch is the reliable
+                    # fence.)
+                    np.asarray(jax.device_get(new_buf[0, 0]))
+                del opt_dev, new_buf, new_bits
         self._drain_write()
 
         out = {"loss": jnp.float32(loss_sum / gas),
@@ -816,6 +1048,41 @@ class InfinityExecutor:
         if self.fp16:
             out["loss_scale"] = jnp.float32(scale)
         return out
+
+    def _native_update_sweep(self, grad_stage, lr: float, coef: float):
+        """Fused C++ AdamW (csrc/adam/dstpu_cpu_adam.cpp) over the store's
+        chunks — this process IS the TPU host, so the fp32 state never
+        touches the device; updated bf16 param bits are derived host-side.
+        Reference: stage_1_and_2.py's cpu_offload step over DeepSpeedCPUAdam."""
+        import ml_dtypes
+        from deepspeed_tpu.ops.cpu_adam import adam_step_flat
+        L = self.cfg.num_layers
+        step = self.applied_steps
+        opt_fut = self._pool.submit(self.store.read_opt, 0)
+        for i in range(L):
+            opt = opt_fut.result()
+            if i + 1 < L:
+                opt_fut = self._pool.submit(self.store.read_opt, i + 1)
+            if opt is None:   # lazy init: master from the bf16 params
+                opt = np.zeros((_PLANES, self.chunk), np.float32)
+                np.copyto(opt[0],
+                          self._get_param(i).view(ml_dtypes.bfloat16))
+            else:
+                opt = np.ascontiguousarray(opt)
+            adam_step_flat(opt[0], opt[1], opt[2], grad_stage[i],
+                           step_num=step, lr=lr, betas=(self.b1, self.b2),
+                           eps=self.eps, weight_decay=self.wd,
+                           adamw_mode=self.awm, bias_correction=self.bc,
+                           grad_scale=coef)
+            grad_stage[i] = None
+            bits = np.ascontiguousarray(
+                opt[0].astype(ml_dtypes.bfloat16).view(np.uint16))
+            self.store.write_opt(i, opt)
+            self.store.write_param(i, bits)
+            if i in self._param_cache or \
+                    len(self._param_cache) < self._cache_layers:
+                self._param_cache[i] = bits
+            self._refresh_live_cache(i, bits, from_host=True)
 
     def _on_overflow(self):
         if not self._dynamic_scale:
@@ -890,6 +1157,7 @@ class InfinityExecutor:
         self.store.load_from(os.path.join(path, "infinity_chunks"),
                              saved_chunk=saved_chunk)
         self._param_cache.clear()
+        self._hbm_cache.clear()
         self.nl_params = jax.tree.map(jnp.asarray, small_state["nl_params"])
         self.nl_opt = jax.tree.map(jnp.asarray, small_state["nl_opt"])
         if self._pinned:

@@ -404,6 +404,148 @@ class NVMeOptimizerSwapper:
             pass
 
 
+class XlaHostAdamSwapper:
+    """ZeRO-Offload optimizer-on-host, TPU-native flavor: the fp32
+    master/m/v tree lives in TPU-host pinned memory and the fused Adam
+    sweep runs on the host's cores INSIDE the XLA program
+    (``compute_on("device_host")``) — the reference DeepSpeedCPUAdam
+    contract (optimizer state never crosses the host<->device bus;
+    ``csrc/adam/cpu_adam.cpp:21``) expressed in the compiled graph rather
+    than a separate process-side kernel. Per step only 2-byte grads DMA
+    down and compute-dtype params DMA up (~4 bytes/param vs the 24+ the
+    chunk-streamed tier moves).
+
+    Same interface as HostAdamSwapper (initialize/step/export/import);
+    export flattens to the same {master, m, v} flat-f32 layout so the two
+    flavors' checkpoints are interchangeable."""
+
+    def __init__(self, param_template, *, mesh, lr=1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adam_w_mode: bool = True,
+                 bias_correction: bool = True, param_shardings=None,
+                 compute_dtype=jnp.bfloat16, **_ignored):
+        from jax.experimental.compute_on import compute_on
+        from deepspeed_tpu.ops.adam import adam_tree_update
+        self.mesh = mesh
+        self.compute_dtype = compute_dtype
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps, self.wd = eps, weight_decay
+        self.awm, self.bc = adam_w_mode, bias_correction
+        leaves, self._treedef = jax.tree.flatten(param_template)
+        self._shapes = [l.shape for l in leaves]
+        self._sizes = [int(np.prod(s)) for s in self._shapes]
+        self.n = sum(self._sizes)
+        self._param_sh = (jax.tree.flatten(param_shardings)[0]
+                          if param_shardings is not None
+                          else [None] * len(leaves))
+        self._host_sh = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        host_tree = lambda t: jax.tree.map(  # noqa: E731
+            lambda _: self._host_sh, t)
+        # fp16's 65504 max can overflow on scaled grads, so the wire is
+        # bf16 for every non-f32 compute dtype
+        self._wire = (jnp.float32 if compute_dtype == jnp.float32
+                      else jnp.bfloat16)
+        b1, b2, eps_, wd = self.b1, self.b2, eps, weight_decay
+        awm, bc = adam_w_mode, bias_correction
+        tmpl = jax.tree.unflatten(self._treedef, leaves)
+
+        def host_step(opt, grads, lr_t, step, coef):
+            @compute_on("device_host")
+            @jax.jit
+            def upd_all(opt, grads, lr_t, step, coef):
+                return adam_tree_update(
+                    opt, grads, lr_t, step, coef, b1=b1, b2=b2, eps=eps_,
+                    wd=wd, awm=awm, bc=bc, out_dtype=compute_dtype)
+            return upd_all(opt, grads, lr_t, step, coef)
+
+        opt_tmpl = jax.tree.map(lambda p: {"master": p, "m": p, "v": p},
+                                tmpl)
+        # params come OUT on the host tier too; the eager device_put in
+        # step() moves them up with the engine's shardings (host-region
+        # outputs direct to device shardings trip the memory-space checks)
+        self._param_sh_tree = jax.tree.unflatten(self._treedef,
+                                                 self._param_sh)
+        self._host_step = jax.jit(
+            host_step,
+            in_shardings=(host_tree(opt_tmpl), host_tree(tmpl),
+                          self._host_sh, self._host_sh, self._host_sh),
+            out_shardings=(host_tree(opt_tmpl), host_tree(tmpl)),
+            donate_argnums=(0,))
+        self._stage_grads = jax.jit(
+            lambda g: jax.tree.map(lambda a: a.astype(self._wire), g),
+            out_shardings=host_tree(tmpl))
+        self._sq_norm = jax.jit(
+            lambda g: sum(jnp.sum(l.astype(jnp.float32) ** 2)
+                          for l in jax.tree.leaves(g)))
+        self.opt = None
+        logger.info(f"host Adam (compute_on): {self.n / 1e6:.1f}M params, "
+                    "fp32 state pinned-host-resident, wire dtype "
+                    f"{jnp.dtype(self._wire).name}")
+
+    def initialize(self, params):
+        init = jax.jit(
+            lambda t: jax.tree.map(
+                lambda p: {"master": p.astype(jnp.float32),
+                           "m": jnp.zeros(p.shape, jnp.float32),
+                           "v": jnp.zeros(p.shape, jnp.float32)}, t),
+            out_shardings=jax.tree.map(lambda _: self._host_sh, params))
+        with self.mesh:
+            self.opt = init(params)
+
+    def step(self, grads, *, lr: float, step_num: int,
+             clip: Optional[float] = None, grad_scale: float = 1.0):
+        with self.mesh:
+            sq = float(np.asarray(jax.device_get(self._sq_norm(grads))))
+            if not np.isfinite(sq):
+                return None, float("nan"), True
+            gnorm = math.sqrt(sq) / grad_scale
+            coef = 1.0 / grad_scale
+            if clip and clip > 0 and gnorm > clip:
+                coef *= clip / (gnorm + 1e-6)
+            g_host = self._stage_grads(grads)
+            lr_h, step_h, coef_h = jax.device_put(
+                (jnp.float32(lr), jnp.float32(step_num),
+                 jnp.float32(coef)), self._host_sh)
+            self.opt, params_host = self._host_step(self.opt, g_host,
+                                                    lr_h, step_h, coef_h)
+            new_params = jax.tree.map(
+                lambda a, s: jax.device_put(a, s) if s is not None
+                else jnp.asarray(a), params_host, self._param_sh_tree)
+        return new_params, gnorm, False
+
+    def export_state(self) -> Dict[str, np.ndarray]:
+        """Flatten to HostAdamSwapper's {master, m, v} flat-f32 layout
+        (checkpoints interchangeable across the two flavors). Fetches the
+        pinned tree — a checkpoint-path cost, not a step cost."""
+        out = {}
+        for plane in ("master", "m", "v"):
+            host = jax.tree.map(
+                lambda o: np.asarray(jax.device_get(o[plane])).reshape(-1),
+                self.opt,
+                is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+            out[plane] = np.concatenate(jax.tree.leaves(host))
+        return out
+
+    def import_state(self, state: Dict[str, np.ndarray]):
+        planes = {}
+        for plane in ("master", "m", "v"):
+            flat = state[plane]
+            leaves, off = [], 0
+            for size, shape in zip(self._sizes, self._shapes):
+                leaves.append(flat[off:off + size].reshape(shape)
+                              .astype(np.float32))
+                off += size
+            planes[plane] = leaves
+        opt_leaves = [{"master": m_, "m": a, "v": b} for m_, a, b in
+                      zip(planes["master"], planes["m"], planes["v"])]
+        tree = jax.tree.unflatten(self._treedef, opt_leaves)
+        self.opt = jax.device_put(tree, self._host_sh)
+
+    def close(self):
+        self.opt = None
+
+
 class HostAdamSwapper:
     """ZeRO-Offload with the optimizer ON the host: fp32 master/m/v live in
     host RAM and the native fused CPU-Adam (ops/cpu_adam.py, reference:
@@ -437,17 +579,28 @@ class HostAdamSwapper:
                            weight_decay=weight_decay, adamw_mode=adam_w_mode,
                            bias_correction=bias_correction)
         self._bf16 = compute_dtype == jnp.bfloat16
-        self._gbuf = np.empty(self.n, np.uint16 if self._bf16 else np.float32)
-        self._pbuf = np.empty_like(self._gbuf)
+        self._f16 = compute_dtype == jnp.float16
+        wire_np = (np.uint16 if self._bf16
+                   else np.float16 if self._f16 else np.float32)
+        self._gbuf = np.empty(self.n, wire_np)
+        self._pbuf = np.empty(self.n, np.uint16 if self._bf16 else np.float32)
+        if self._f16:
+            # f16 wire: widen grads to f32 for the native Adam, narrow the
+            # updated params back to f16 — keeps transfers at 2 bytes/param
+            # and the returned leaf dtype stable (no f32 drift under fp16).
+            self._g32 = np.empty(self.n, np.float32)
+            self._p16 = np.empty(self.n, np.float16)
         # per-leaf device-side cast to the wire dtype (bits for bf16)
         if self._bf16:
             self._cast = jax.jit(lambda g: jax.lax.bitcast_convert_type(
                 g.astype(jnp.bfloat16), jnp.uint16))
+        elif self._f16:
+            self._cast = jax.jit(lambda g: g.astype(jnp.float16))
         else:
             self._cast = jax.jit(lambda g: g.astype(jnp.float32))
         logger.info(f"host CPU-Adam: {self.n / 1e6:.1f}M params, fp32 state "
                     "host-resident, wire dtype "
-                    f"{'bf16' if self._bf16 else 'f32'}")
+                    f"{'bf16' if self._bf16 else 'f16' if self._f16 else 'f32'}")
 
     def initialize(self, params):
         off = 0
@@ -464,19 +617,29 @@ class HostAdamSwapper:
         for fut, off, size in zip(futs, self._offsets, self._sizes):
             np.copyto(self._gbuf[off:off + size],
                       np.asarray(jax.device_get(fut)).reshape(-1))
-        sq = self.cpu.sq_norm(self._gbuf)
+        if self._f16:
+            np.copyto(self._g32, self._gbuf)   # widen on host
+            gflat = self._g32
+        else:
+            gflat = self._gbuf
+        sq = self.cpu.sq_norm(gflat)
         if not np.isfinite(sq):
             return None, float("nan"), True
         gnorm = math.sqrt(sq) / grad_scale
         coef = 1.0 / grad_scale
         if clip and clip > 0 and gnorm > clip:
             coef *= clip / (gnorm + 1e-6)
-        self.cpu.step(self._gbuf, step_num, lr=lr, grad_scale=coef,
+        self.cpu.step(gflat, step_num, lr=lr, grad_scale=coef,
                       out=self._pbuf)
+        if self._f16:
+            np.copyto(self._p16, self._pbuf)   # narrow for the wire
         out_leaves = []
         for off, size, shape, sh in zip(self._offsets, self._sizes,
                                         self._shapes, self._param_sh):
-            seg = self._pbuf[off:off + size].reshape(shape)
+            if self._f16:
+                seg = self._p16[off:off + size].reshape(shape)
+            else:
+                seg = self._pbuf[off:off + size].reshape(shape)
             if self._bf16:
                 seg = seg.view(ml_dtypes.bfloat16)
             arr = (jax.device_put(seg, sh) if sh is not None

@@ -109,3 +109,57 @@ def test_decode_row_mode_parity(index, rep):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
     assert float(jnp.max(jnp.abs(out))) < 100.0
+
+
+class TestInt8KVCache:
+    """int8 KV ring buffers (models/transformer kv_cache_bits=8): the
+    per-position scales factor out of the d-contraction so both attention
+    einsums run on int8 bytes (int8 MXU path on TPU). Parity vs the
+    float-cache XLA decode attention."""
+
+    def test_decode_attention_int8_parity(self):
+        from deepspeed_tpu.models.transformer import (_decode_attention,
+                                                      _quant_kv)
+        B, Nkv, rep, T, D = 2, 4, 2, 128, 64
+        ks = jax.random.split(jax.random.PRNGKey(3), 5)
+        q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.float32)
+        ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
+        cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
+        k_row = jax.random.normal(ks[3], (B, Nkv, 1, D), jnp.float32)
+        v_row = jax.random.normal(ks[4], (B, Nkv, 1, D), jnp.float32)
+        index = jnp.int32(100)
+        ref = _decode_attention(q, ck, cv, index, kv_row=(k_row, v_row))
+        kq, ksc = _quant_kv(ck)
+        vq, vsc = _quant_kv(cv)
+        got = _decode_attention(q, kq, vq, index, kv_row=(k_row, v_row),
+                                kv_scale=(ksc, vsc))
+        rel = (np.linalg.norm(np.asarray(got - ref).ravel())
+               / np.linalg.norm(np.asarray(ref).ravel()))
+        assert rel < 2e-2, rel
+
+    def test_generate_int8_vs_float_first_logits(self):
+        """Engine-level: prefill logits are exact (cache unused); the first
+        decode step's logits (read through the quantized cache) stay close
+        to the float-cache path."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models import TransformerConfig, make_model
+
+        cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                                num_layers=2, num_heads=4, max_seq_len=256,
+                                dtype=jnp.float32, attention_impl="xla")
+        ids = np.random.default_rng(0).integers(0, 128, (2, 40),
+                                                dtype=np.int32)
+        outs = {}
+        for kvb in (0, 8):
+            model = make_model(cfg, name="tiny")
+            eng = deepspeed_tpu.init_inference(
+                model, config={"kv_cache_bits": kvb}, dtype=jnp.float32)
+            assert eng.model.config.kv_cache_bits == kvb
+            outs[kvb] = np.asarray(jax.device_get(
+                eng.generate(ids, max_new_tokens=8)))
+        # prompt region identical by construction; the check is on the
+        # GENERATED region: greedy argmax through a ~1% attention
+        # perturbation on this fixed seed keeps the first tokens equal
+        assert (outs[0][:, :40] == outs[8][:, :40]).all()
+        gen0, gen8 = outs[0][:, 40:], outs[8][:, 40:]
+        assert (gen0[:, :4] == gen8[:, :4]).all(), (gen0, gen8)
