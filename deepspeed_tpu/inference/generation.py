@@ -104,6 +104,42 @@ def make_decode_loop(model, n_steps: int, temperature: float,
             return _step
 
         keys = jax.random.split(jax.random.fold_in(rng, 1), n_steps)
+        # two-level pays a suffix-attention overhead per token; the carry
+        # copies it avoids only dominate once the ring buffer is large
+        two_level = (supports_window and max_len >= 4 * SEGMENT
+                     and model.decode_step_suffix is not None
+                     and model.init_suffix is not None
+                     and model.merge_suffix is not None)
+        if two_level:
+            # two-level decode: the ring buffer is a scan INVARIANT per
+            # segment (XLA double-buffers scan carries — carrying the full
+            # cache copied O(T) bytes/token); only the small suffix rides
+            # the carry, merged into the prefix once per segment.
+            B = tok0.shape[0]
+            toks_parts = []
+            tok = tok0
+            done = 0
+            while done < n_steps:
+                seg = min(SEGMENT, n_steps - done)
+                # the prefix window only needs the rows written BEFORE
+                # this segment (the segment's own rows sit in the suffix)
+                read_len = min(max_len,
+                               -(-(start_len + done) // SEGMENT) * SEGMENT)
+                suffix = model.init_suffix(B, seg, cache=cache)
+
+                def _step(carry, key, _rl=read_len):
+                    tok, suffix = carry
+                    logits, suffix = model.decode_step_suffix(
+                        params, tok, cache, suffix, read_len=_rl)
+                    nxt = sample(logits, key)
+                    return (nxt, suffix), tok
+
+                (tok, suffix), toks = jax.lax.scan(
+                    _step, (tok, suffix), keys[done:done + seg])
+                cache = model.merge_suffix(cache, suffix)
+                toks_parts.append(toks)
+                done += seg
+            return jnp.concatenate(toks_parts, axis=0).T
         if not supports_window:
             (_, _), toks = jax.lax.scan(step(None), (tok0, cache), keys)
             return toks.T

@@ -535,7 +535,7 @@ def _activation(x, gate, cfg: TransformerConfig):
 
 
 def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
-                      kv_row=None, kv_scale=None):
+                      kv_row=None, kv_scale=None, kv_suffix=None):
     """Single-token GQA attention against a KV ring buffer, with NO repeat of
     the kv heads in memory (reference's decode kernels repeat in registers:
     ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``).
@@ -565,6 +565,7 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
                   and cfg.position_type != "alibi"
                   and q.dtype != jnp.float16  # Mosaic has no f16
                   and kv_scale is None        # kernel reads float caches
+                  and kv_suffix is None       # kernel knows no suffix rows
                   and jax.default_backend() in ("tpu", "axon") and D >= 64)
     if use_pallas:
         from deepspeed_tpu.ops.decode_attention import decode_attention
@@ -594,13 +595,45 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
         scores = scores + slopes[None, :, :, None] * rel[None, None, None, :]
     if kv_row is not None:
         k_row, v_row = kv_row                    # [B, Nkv, 1, D]
-        # buffer rows at >= index are stale; the current token's logit is
-        # computed from the fresh row (its rel distance is 0 — no alibi term)
-        valid = (jnp.arange(T) < index)[None, None, None, :]
+        if kv_suffix is not None:
+            # two-level cache: the big buffer is a FROZEN prefix (scan
+            # invariant, read in place) and the tokens of the current
+            # segment live in the small suffix carry — XLA double-buffers
+            # scan carries, so carrying the full ring buffer copied O(T)
+            # bytes per token (the ctx-2048 decode cliff, round 5 form)
+            sk, sv, count = kv_suffix            # [B, Nkv, Ssuf, D]
+            prefix_len = index - count
+        else:
+            prefix_len = index
+        # buffer rows at >= prefix_len are stale; the current token's logit
+        # comes from the fresh row (rel distance 0 — no alibi term)
+        valid = (jnp.arange(T) < prefix_len)[None, None, None, :]
         scores = jnp.where(valid, scores, -1e30)
         s_self = jnp.einsum("bgrd,bgtd->bgrt", qg,
                             k_row.astype(qg.dtype)).astype(jnp.float32)
         s_self = s_self / math.sqrt(D)
+        if kv_suffix is not None:
+            Ssuf = sk.shape[2]
+            s_suf = jnp.einsum("bgrd,bgtd->bgrt", qg,
+                               sk.astype(qg.dtype)).astype(jnp.float32)
+            s_suf = s_suf / math.sqrt(D)
+            if cfg is not None and cfg.position_type == "alibi":
+                rel_suf = (prefix_len + jnp.arange(Ssuf) - index
+                           ).astype(jnp.float32)
+                slopes = alibi_slopes(Nq).reshape(Nkv, rep)
+                s_suf = s_suf + slopes[None, :, :, None] * \
+                    rel_suf[None, None, None, :]
+            sval = (jnp.arange(Ssuf) < count)[None, None, None, :]
+            s_suf = jnp.where(sval, s_suf, -1e30)
+            scores = jnp.concatenate([scores, s_suf, s_self], axis=-1)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
+            out = out + jnp.einsum(
+                "bgrt,bgtd->bgrd", probs[..., T:T + Ssuf].astype(q.dtype),
+                sv.astype(q.dtype))
+            out = out + probs[..., T + Ssuf:].astype(q.dtype) * \
+                v_row.astype(q.dtype)
+            return out.reshape(B, 1, Nq, D)
         scores = jnp.concatenate([scores, s_self], axis=-1)
         probs = jax.nn.softmax(scores, axis=-1)
         out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
@@ -804,12 +837,18 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         ck, cv, index = cache[:3]           # [B, nkv, T, hd]
         read_len = cache[3] if len(cache) > 3 else None
         kv_scale = cache[4] if len(cache) > 4 else None   # int8 cache
+        kv_suffix = cache[5] if len(cache) > 5 else None  # two-level decode
         # the fresh row stays FLOAT (exact): its logit joins the softmax
         # separately. int8 caches carry rows in compute dtype (the decode
         # loop quantizes before the write); float caches keep the cache's
         # own dtype so a non-cfg.dtype cache (e.g. f32 cache under a bf16
         # model) still writes without a dtype mismatch.
-        row_dtype = cfg.dtype if kv_scale is not None else ck.dtype
+        if kv_suffix is not None:
+            row_dtype = kv_suffix[0].dtype   # rows land in the suffix
+        elif kv_scale is not None:
+            row_dtype = cfg.dtype            # int8 cache: loop quantizes
+        else:
+            row_dtype = ck.dtype
         k_row = jnp.swapaxes(k, 1, 2).astype(row_dtype)   # [B, nkv, 1, hd]
         v_row = jnp.swapaxes(v, 1, 2).astype(row_dtype)
         # the buffer is NOT modified here: the fresh row joins the softmax
@@ -825,11 +864,12 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
             attn_out = _decode_attention(q, ck[:, :, :read_len],
                                          cv[:, :, :read_len], index, cfg,
                                          kv_row=(k_row, v_row),
-                                         kv_scale=sc)
+                                         kv_scale=sc, kv_suffix=kv_suffix)
         else:
             attn_out = _decode_attention(q, ck, cv, index, cfg,
                                          kv_row=(k_row, v_row),
-                                         kv_scale=kv_scale)
+                                         kv_scale=kv_scale,
+                                         kv_suffix=kv_suffix)
         new_kv = (k_row, v_row)
     else:
         if return_kv:
@@ -1246,12 +1286,28 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
 
     int8_kv = cfg.kv_cache_bits == 8
 
-    def body(x_c, xs):
+    # The cache and the weight stack are CAPTURED and dynamically indexed
+    # by the layer counter, NOT threaded through scan xs: scan operands get
+    # staged into the loop's buffers, which copied the ENTIRE cache (and
+    # weight stack) every token — measured as per-token cost scaling with
+    # cache SIZE even when read_len was tiny. Captured arrays are read
+    # in place via fused dynamic-slices.
+    def at_layer(tree, i):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tree)
+
+    def body(x_c, i):
+        layer_p = at_layer(params["layers"], i)
+        ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
+        cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
         if int8_kv:
-            layer_p, ck, cv, ks, vs = xs
-            c = (ck, cv, index, read_len, (ks, vs))
+            sc = (lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
+                                           keepdims=False),
+                  lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
+                                           keepdims=False))
+            c = (ck, cv, index, read_len, sc)
         else:
-            layer_p, ck, cv = xs
             c = (ck, cv, index, read_len)
         if cfg.offload_params:
             layer_p = _fetch_layer(layer_p, cfg)
@@ -1260,10 +1316,8 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
             cache=c, return_kv=False)
         return y, (k_row, v_row)
 
-    xs = ((params["layers"], cache["k"], cache["v"], cache["k_scale"],
-           cache["v_scale"]) if int8_kv
-          else (params["layers"], cache["k"], cache["v"]))
-    x, (k_rows, v_rows) = lax.scan(body, x, xs)
+    x, (k_rows, v_rows) = lax.scan(body, x,
+                                   jnp.arange(cfg.num_layers))
     # one tiny [L, B, nkv, 1, hd] column write — the ring buffers update
     # in place (XLA aliases the dus when the cache is a loop carry /
     # donated input), instead of the scan re-stacking full buffers
@@ -1298,6 +1352,126 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
     if int8_kv:
         new_cache.update(new_scales)
     return logits[:, 0, :], new_cache
+
+
+def init_suffix(cfg: TransformerConfig, batch_size: int, seg_len: int,
+                cache: Optional[Params] = None) -> Params:
+    """Per-segment suffix buffers for two-level decode: the current
+    segment's K/V rows + a written-row count. Small enough
+    ([L, B, nkv, seg, hd]) that carrying it through the token scan costs
+    O(seg) per token instead of the ring buffer's O(T). Float caches keep
+    the suffix in the CACHE's dtype (merge is a plain cast-free write);
+    int8 caches keep it in compute dtype (merge quantizes)."""
+    L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
+    dtype = cfg.dtype
+    if cache is not None and cache["k"].dtype != jnp.int8:
+        dtype = cache["k"].dtype
+    return {"k": jnp.zeros((L, batch_size, nkv, seg_len, hd), dtype),
+            "v": jnp.zeros((L, batch_size, nkv, seg_len, hd), dtype),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def decode_step_suffix(params: Params, token, cfg: TransformerConfig,
+                       cache: Params, suffix: Params,
+                       read_len: Optional[int] = None
+                       ) -> Tuple[jnp.ndarray, Params]:
+    """One decode step against a FROZEN prefix cache + the segment suffix.
+
+    ``cache`` is read-only here (a scan invariant — XLA double-buffers
+    scan carries, so threading the full ring buffer through the token
+    scan copied O(T) bytes per token; see BENCH r4's ctx-2048 cliff).
+    Writes go to the small ``suffix`` carry; ``merge_suffix`` folds a
+    finished segment into the prefix. Reference analogue: the fixed
+    decode workspace of inference_context.h, which likewise never
+    reallocates the big buffer inside the token loop.
+    """
+    if token.ndim == 1:
+        token = token[:, None]
+    B = token.shape[0]
+    index = cache["index"] + suffix["count"]     # absolute position
+    x = params["tok_embed"][token].astype(cfg.dtype)
+    if cfg.position_type == "learned":
+        x = x + params["pos_embed"][index[None, None]].astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"), cfg)
+    positions = jnp.broadcast_to(index[None, None], (B, 1))
+    int8_kv = cfg.kv_cache_bits == 8
+    count = suffix["count"]
+
+    # STATIC python-unrolled layer loop: on this XLA stack dynamic-sliced
+    # loop reads (scan xs, dynamic_index of captures) MATERIALIZE the full
+    # per-layer cache slice every iteration — per-token cost scaled with
+    # the BUFFER size, not the read window. Static slices fuse into the
+    # attention einsums, so only the window bytes actually move.
+    T_full = cache["k"].shape[3]
+    W = read_len if read_len and read_len < T_full else T_full
+
+    k_rows_l, v_rows_l = [], []
+    for i in range(cfg.num_layers):
+        layer_p = jax.tree.map(lambda a: a[i], params["layers"])
+        ck = cache["k"][i, :, :, :W]
+        cv = cache["v"][i, :, :, :W]
+        sk = suffix["k"][i]
+        sv = suffix["v"][i]
+        sc = ((cache["k_scale"][i, :, :, :W],
+               cache["v_scale"][i, :, :, :W]) if int8_kv else None)
+        c = (ck, cv, index, None, sc, (sk, sv, count))
+        if cfg.offload_params:
+            layer_p = _fetch_layer(layer_p, cfg)
+        x, _, (k_row, v_row) = transformer_layer(
+            x, layer_p, cfg, positions=positions, deterministic=True,
+            cache=c, return_kv=False)
+        k_rows_l.append(k_row)
+        v_rows_l.append(v_row)
+    k_rows = jnp.stack(k_rows_l)
+    v_rows = jnp.stack(v_rows_l)
+    new_suffix = {
+        "k": lax.dynamic_update_slice(suffix["k"], k_rows,
+                                      (0, 0, 0, count, 0)),
+        "v": lax.dynamic_update_slice(suffix["v"], v_rows,
+                                      (0, 0, 0, count, 0)),
+        "count": count + 1,
+    }
+    if cfg.final_norm:
+        x = _norm(x, params["final_norm_scale"],
+                  params.get("final_norm_bias"), cfg)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["tok_embed"].T
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    return logits[:, 0, :], new_suffix
+
+
+def merge_suffix(cfg: TransformerConfig, cache: Params,
+                 suffix: Params) -> Params:
+    """Fold a finished segment's suffix rows into the prefix cache (one
+    O(seg) write per SEGMENT, outside the token scan) and advance the
+    cursor. int8 caches quantize the rows here."""
+    index = cache["index"]
+    new_cache = dict(cache)
+    if cfg.kv_cache_bits == 8:
+        kq, ks = _quant_kv(suffix["k"])
+        vq, vs = _quant_kv(suffix["v"])
+        new_cache["k"] = lax.dynamic_update_slice(cache["k"], kq,
+                                                  (0, 0, 0, index, 0))
+        new_cache["v"] = lax.dynamic_update_slice(cache["v"], vq,
+                                                  (0, 0, 0, index, 0))
+        new_cache["k_scale"] = lax.dynamic_update_slice(
+            cache["k_scale"], ks, (0, 0, 0, index))
+        new_cache["v_scale"] = lax.dynamic_update_slice(
+            cache["v_scale"], vs, (0, 0, 0, index))
+    else:
+        new_cache["k"] = lax.dynamic_update_slice(
+            cache["k"], suffix["k"].astype(cache["k"].dtype),
+            (0, 0, 0, index, 0))
+        new_cache["v"] = lax.dynamic_update_slice(
+            cache["v"], suffix["v"].astype(cache["v"].dtype),
+            (0, 0, 0, index, 0))
+    new_cache["index"] = index + suffix["count"]
+    return new_cache
 
 
 def chunked_cross_entropy(x, head, labels, chunk: int,
@@ -1381,6 +1555,13 @@ class ModelSpec:
     prefill: Optional[Callable[..., Tuple[jnp.ndarray, Params]]] = None
     decode_step: Optional[Callable[..., Tuple[jnp.ndarray, Params]]] = None
     cache_axes: Optional[Callable[[], Params]] = None
+    # two-level decode (frozen prefix + per-segment suffix carry); the
+    # decode loop prefers these when present — carrying the full ring
+    # buffer through the token scan copies O(T) bytes per token
+    init_suffix: Optional[Callable[..., Params]] = None
+    decode_step_suffix: Optional[Callable[..., Tuple[jnp.ndarray,
+                                                     Params]]] = None
+    merge_suffix: Optional[Callable[..., Params]] = None
 
     def flops_per_token(self) -> float:
         """Approximate train FLOPs/token (6N rule + attention)."""
@@ -1412,4 +1593,9 @@ def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
         decode_step=lambda params, token, cache, **kw:
             decode_step(params, token, cfg, cache, **kw),
         cache_axes=lambda: cache_logical_axes(cfg),
+        init_suffix=lambda batch_size, seg_len, cache=None:
+            init_suffix(cfg, batch_size, seg_len, cache=cache),
+        decode_step_suffix=lambda params, token, cache, suffix, **kw:
+            decode_step_suffix(params, token, cfg, cache, suffix, **kw),
+        merge_suffix=lambda cache, suffix: merge_suffix(cfg, cache, suffix),
     )
