@@ -312,14 +312,17 @@ class Engine:
                         "offload_optimizer.device=nvme (the executor streams "
                         "param AND optimizer chunks per layer)")
             if self._infinity_multi:
-                # offload composed with data/fsdp parallelism (reference:
-                # ZeRO-3 + NVMe at 512 GPUs, stage3.py:65): layer chunks
-                # shard over fsdp, batch over (data, fsdp)
-                if (self.plan.tensor > 1 or self.plan.pipe > 1
-                        or self.plan.seq > 1 or self.plan.expert > 1):
+                # offload composed with data/fsdp/tensor parallelism
+                # (reference: ZeRO-3 + NVMe under a Megatron TP mpu,
+                # engine.py:1088-1100 + stage3.py:65): layer chunks shard
+                # over fsdp x tensor, batch over (data, fsdp), and the
+                # per-layer jits re-shard the unflattened weights to
+                # Megatron col/row specs
+                if (self.plan.pipe > 1 or self.plan.seq > 1
+                        or self.plan.expert > 1):
                     raise ValueError(
-                        "layer-streamed offload shards over data/fsdp only "
-                        "(tensor/pipe/seq/expert must be 1)")
+                        "layer-streamed offload shards over "
+                        "data/fsdp/tensor (pipe/seq/expert must be 1)")
             elif self.plan.world_size > 1:
                 if get_accelerator().platform == "cpu":
                     # CPU test harness: single-device executor is fine

@@ -283,21 +283,45 @@ class InfinityExecutor:
             self.mesh = Mesh(np.asarray([dev]).reshape(1, 1),
                              ("data", "fsdp"))
         mesh_shape = dict(self.mesh.shape)
-        for ax in ("tensor", "pipe", "seq", "expert"):
+        for ax in ("pipe", "seq", "expert"):
             if mesh_shape.get(ax, 1) > 1:
                 raise ValueError(f"layer-streamed offload shards over "
-                                 f"data/fsdp only; mesh axis '{ax}' > 1")
+                                 f"data/fsdp/tensor; mesh axis '{ax}' > 1")
         self._F = mesh_shape.get("fsdp", 1)
+        self._TP = mesh_shape.get("tensor", 1)
         self.dp = self._F * mesh_shape.get("data", 1)
         self._batch_axes = tuple(a for a in ("data", "fsdp")
                                  if a in mesh_shape)
         single = self.mesh.size == 1
+        # flat chunks shard over fsdp AND tensor (pure storage
+        # distribution); the TP leaf constraints in the layer jits are
+        # what turn the tensor axis into Megatron-style compute sharding
+        # (reference: ZeRO-3+NVMe under a Megatron mpu,
+        # runtime/engine.py:1088-1100 + zero/stage3.py:65)
+        chunk_axes = (("fsdp", "tensor") if self._TP > 1 and self._F > 1
+                      else ("tensor",) if self._TP > 1 else ("fsdp",))
         # on a 1-device mesh trivially-sharded specs are semantically P(),
         # but the sharded annotation routes pinned<->HBM device_put through
         # a slower path (measured 2.5x on the capacity rung) — use plain P()
         self._x_spec = P() if single else P(self._batch_axes)
-        self._bits_spec = P() if single else P("fsdp")
-        self._opt_spec = P() if single else P(None, "fsdp")
+        self._bits_spec = P() if single else P(chunk_axes)
+        self._opt_spec = P() if single else P(None, chunk_axes)
+        # per-leaf tensor-parallel specs for the unflattened layer tree
+        # (col/row rules from parallel/partitioning; the leading "layers"
+        # logical dim is dropped — the per-layer tree has no L axis)
+        self._tp_leaf_specs = None
+        if self._TP > 1:
+            from deepspeed_tpu.models.transformer import (
+                logical_axes as _logical_axes)
+            from deepspeed_tpu.parallel.partitioning import (
+                make_rules as _make_rules, spec_tree as _spec_tree)
+            lay_axes = _logical_axes(self.cfg)["layers"]
+            per_layer = jax.tree.map(
+                lambda a: a[1:] if isinstance(a, tuple) else a, lay_axes,
+                is_leaf=lambda x: x is None or isinstance(x, tuple))
+            tp_tree = _spec_tree(per_layer, _make_rules(0, tp=True))
+            self._tp_leaf_specs = jax.tree.flatten(
+                tp_tree, is_leaf=lambda x: isinstance(x, P))[0]
         # memory_kind="device" is load-bearing: a device_put from a
         # pinned_host source with no explicit kind can keep the array on the
         # host tier, and every downstream jit then reads over PCIe
@@ -316,8 +340,8 @@ class InfinityExecutor:
         self._repl_host_sh = NamedSharding(self.mesh, P(),
                                            memory_kind="pinned_host")
 
-        # chunk rounded so every fsdp shard is lane-aligned
-        align = 128 * self._F
+        # chunk rounded so every fsdp x tensor shard is lane-aligned
+        align = 128 * self._F * self._TP
         self.chunk = ((numel + align - 1) // align) * align
         self.layer_params = numel
         self.num_params = L * numel
@@ -394,17 +418,23 @@ class InfinityExecutor:
 
         compression = self.compression
 
-        def unflatten(flat_bits, step=None):
-            """uint16 bf16-bits (C,) -> layer param pytree (compute dtype)."""
-            flat = jax.lax.bitcast_convert_type(flat_bits, jnp.bfloat16)
-            # one explicit all-gather of the bf16 chunk (the ZeRO-3 fetch);
-            # without it every dynamic_slice below would gather separately
-            flat = wsc(flat, P())
-            flat = flat.astype(cfg.dtype)
+        tp_specs = self._tp_leaf_specs
+
+        def leaves_from_flat(flat, step=None):
+            """Gathered flat vector -> layer param pytree (compute dtype).
+            The ONE place that slices/reshapes/TP-constrains leaves — used
+            by both the forward unflatten and the backward fp32 view."""
             out, off = [], 0
-            for size, shape in zip(sizes, shapes):
-                out.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
-                           .reshape(shape))
+            for j, (size, shape) in enumerate(zip(sizes, shapes)):
+                leaf = jax.lax.dynamic_slice_in_dim(flat, off, size) \
+                    .reshape(shape).astype(cfg.dtype)
+                if tp_specs is not None:
+                    # Megatron col/row sharding of the reshaped weight —
+                    # this is what makes the tensor axis COMPUTE, not just
+                    # storage: GSPMD partitions each matmul and inserts
+                    # the psum on the row-parallel outputs
+                    leaf = wsc(leaf, tp_specs[j])
+                out.append(leaf)
                 off += size
             tree = jax.tree.unflatten(treedef, out)
             if compression is not None:
@@ -414,6 +444,14 @@ class InfinityExecutor:
                     {"layers": tree},
                     step if step is not None else 0)["layers"]
             return tree
+
+        def unflatten(flat_bits, step=None):
+            """uint16 bf16-bits (C,) -> layer param pytree (compute dtype)."""
+            flat = jax.lax.bitcast_convert_type(flat_bits, jnp.bfloat16)
+            # one explicit all-gather of the bf16 chunk (the ZeRO-3 fetch);
+            # without it every dynamic_slice below would gather separately
+            flat = wsc(flat, P())
+            return leaves_from_flat(flat, step)
 
         def layer_fwd(flat_bits, x, mask, positions, step):
             p = unflatten(flat_bits, step)
@@ -430,13 +468,7 @@ class InfinityExecutor:
             def f(bits_f32, x):
                 # differentiate w.r.t. a fp32 VIEW of the params so the
                 # cotangent comes back fp32 (bitcast isn't differentiable)
-                p = jax.tree.unflatten(treedef, [
-                    jax.lax.dynamic_slice_in_dim(bits_f32, off, size)
-                    .reshape(shape).astype(cfg.dtype)
-                    for off, size, shape in zip(
-                        np.cumsum([0] + sizes[:-1]).tolist(), sizes, shapes)])
-                if compression is not None:
-                    p = compression.apply({"layers": p}, step)["layers"]
+                p = leaves_from_flat(bits_f32, step)
                 y, _aux = transformer_layer(x, p, cfg, mask=mask,
                                             positions=positions,
                                             deterministic=True)

@@ -238,11 +238,34 @@ class TestInfinityMultiChip:
                               devices8[:4], steps=4, gas=2)
         assert losses[-1] < losses[0], losses
 
-    def test_tensor_axis_rejected(self, tmp_path, devices8):
+    def test_fsdp2_tensor2_parity_vs_single_device(self, tmp_path,
+                                                   devices8):
+        """Offload composed with the TENSOR axis (r4 verdict missing #1:
+        the reference runs ZeRO-3+NVMe under a Megatron TP mpu,
+        engine.py:1088-1100 + stage3.py:65). Chunks shard over
+        fsdp x tensor; the per-layer jits re-shard weights to col/row
+        specs, so the tensor axis carries compute, and the loss must
+        match the single-device executor."""
+        ref = self._losses(tmp_path / "ref", None, [devices8[0]])
+        cfg = _cfg_dict(tmp_path / "tp")
+        cfg["train_batch_size"] = 16
+        cfg["train_micro_batch_size_per_gpu"] = 8   # dp = data*fsdp = 2
+        cfg["mesh"] = {"axes": {"fsdp": 2, "tensor": 2}}
+        engine, *_ = deepspeed_tpu.initialize(model=_model(), config=cfg,
+                                              devices=devices8[:4])
+        assert engine._infinity_multi
+        assert engine._infinity_exec._TP == 2
+        assert engine._infinity_exec.dp == 2
+        batch = _batch(B=16)
+        got = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+        engine._infinity_exec.close()
+        np.testing.assert_allclose(got, ref, rtol=3e-3)
+
+    def test_pipe_axis_rejected(self, tmp_path, devices8):
         cfg = _cfg_dict(tmp_path)
         cfg["train_batch_size"] = 8
-        cfg["mesh"] = {"axes": {"fsdp": 2, "tensor": 2}}
-        with pytest.raises(Exception, match="data/fsdp"):
+        cfg["mesh"] = {"axes": {"pipe": 2, "fsdp": 2}}
+        with pytest.raises(Exception, match="pipe"):
             deepspeed_tpu.initialize(model=_model(), config=cfg,
                                      devices=devices8[:4])
 
