@@ -205,6 +205,9 @@ class InferenceEngine:
         self._forward = jax.jit(lambda p, ids: model.apply(p, ids))
         self._rules = rules
         self._encode_fn = None     # encoder-model hidden-state path
+        self._forward_kw = None    # kwarg-carrying forward (UNet context)
+        self._vae_encode_fn = None
+        self._vae_decode_fn = None
         self._prefill_cache = {}   # (B, pad_prompt, max_len); prompt_len
         # is a traced argument, NOT part of the compile key
         self._decode_loop_cache = {}  # (B, pad_prompt, max_len, n_steps, temp)
@@ -275,8 +278,10 @@ class InferenceEngine:
             self._decode_loop_cache[dkey] = decode_fn
         return prefill_fn, decode_fn
 
-    def forward(self, input_ids):
-        """Full-sequence logits (prefill path)."""
+    def forward(self, input_ids, **kwargs):
+        """Full-sequence logits (prefill path). Extra array kwargs (e.g.
+        the conditioned UNet's ``t``/``context``) pass through to the
+        spec's apply inside the jit."""
         from deepspeed_tpu.parallel.context import set_parallel_context
         set_parallel_context(self.mesh, self._plan)
         input_ids = jnp.asarray(input_ids)
@@ -284,9 +289,46 @@ class InferenceEngine:
             input_ids,
             NamedSharding(self.mesh, self._batch_spec(input_ids.shape[0])))
         with self.mesh:
+            if kwargs:
+                if self._forward_kw is None:
+                    self._forward_kw = jax.jit(
+                        lambda p, ids, kw: self.model.apply(p, ids, **kw))
+                return self._forward_kw(
+                    self.params, input_ids,
+                    {k: jnp.asarray(v) for k, v in kwargs.items()})
             return self._forward(self.params, input_ids)
 
     __call__ = forward
+
+    def vae_encode(self, x, sample: bool = False, rng=None):
+        """DSVAE.encode (reference: diffusers/vae.py:96): latent mean (or
+        a reparameterized sample) for image batch x [B, H, W, C]."""
+        from deepspeed_tpu.models.vae import VAEConfig, vae_encode as _enc
+        cfg = getattr(self.model, "config", None)
+        if not isinstance(cfg, VAEConfig):
+            raise ValueError("vae_encode() requires a VAE ModelSpec")
+        if self._vae_encode_fn is None:
+            self._vae_encode_fn = jax.jit(
+                lambda p, x: _enc(p, x, cfg))
+        with self.mesh:
+            mean, logvar = self._vae_encode_fn(self.params,
+                                               jnp.asarray(x))
+        if sample:
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+            return mean + jnp.exp(0.5 * logvar) * jax.random.normal(
+                rng, mean.shape)
+        return mean
+
+    def vae_decode(self, z):
+        """DSVAE.decode: latent [B, h, w, latent] -> image."""
+        from deepspeed_tpu.models.vae import VAEConfig, vae_decode as _dec
+        cfg = getattr(self.model, "config", None)
+        if not isinstance(cfg, VAEConfig):
+            raise ValueError("vae_decode() requires a VAE ModelSpec")
+        if self._vae_decode_fn is None:
+            self._vae_decode_fn = jax.jit(lambda p, z: _dec(p, z, cfg))
+        with self.mesh:
+            return self._vae_decode_fn(self.params, jnp.asarray(z))
 
     def encode(self, input_ids, attention_mask=None, token_type_ids=None):
         """Encoder-model hidden states [B, S, H] (BERT/RoBERTa; reference:

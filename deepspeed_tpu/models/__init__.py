@@ -9,3 +9,11 @@ from deepspeed_tpu.models.hf_import import (
 from deepspeed_tpu.models.unet import (
     UNetConfig, make_unet_model, unet_forward, denoise_loss,
 )
+from deepspeed_tpu.models.vae import (
+    VAEConfig, make_vae_model, vae_encode, vae_decode, vae_loss,
+)
+from deepspeed_tpu.models.clip_vision import (
+    CLIPVisionSpec, make_clip_vision_model, clip_vision_encode,
+    clip_vision_pooled,
+    load_clip_vision_params, vision_transformer_config,
+)

@@ -1,0 +1,191 @@
+"""CLIP vision tower — the image half of CLIP, on the shared encoder path.
+
+Reference coverage: ``deepspeed/module_inject/containers/clip.py``
+(HFCLIPLayerPolicy — one policy serves BOTH towers, since CLIPEncoderLayer
+is shared) used by the Stable-Diffusion pipeline injection. TPU-native
+re-design: the encoder layers ARE models/transformer.py layers (pre-LN,
+quick_gelu, learned positions via the standard table); only the front-end
+is vision-specific — a patch-embedding conv, a class token, and HF's
+``pre_layrnorm`` (expressed as the transformer's embed_norm). The tower is
+a ModelSpec, so init_inference serves it like any encoder.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.transformer import (
+    ModelSpec, TransformerConfig, forward as _tf_forward,
+    init_params as _tf_init, logical_axes as _tf_axes)
+
+Params = Dict[str, Any]
+
+
+def vision_transformer_config(*, image_size: int = 224,
+                              patch_size: int = 32,
+                              hidden_size: int = 768,
+                              num_layers: int = 12, num_heads: int = 12,
+                              intermediate_size: Optional[int] = None,
+                              norm_eps: float = 1e-5,
+                              activation: str = "quick_gelu",
+                              dtype=jnp.float32) -> TransformerConfig:
+    """The encoder half of the tower as a TransformerConfig: non-causal,
+    pre-LN, learned positions over (patches + class token), embed_norm =
+    HF's pre_layrnorm, final_norm = post_layernorm."""
+    n_pos = (image_size // patch_size) ** 2 + 1
+    return TransformerConfig(
+        vocab_size=8,   # no token lookup — inputs_embeds path only
+        hidden_size=hidden_size, num_layers=num_layers,
+        num_heads=num_heads,
+        intermediate_size=intermediate_size or 4 * hidden_size,
+        max_seq_len=n_pos, norm_eps=norm_eps,
+        position_type="learned", activation=activation,
+        norm_type="layernorm", causal=False, qkv_bias=True,
+        # post_layernorm applies only to the POOLED class token in HF's
+        # vision tower — last_hidden_state is pre-norm
+        embed_norm=True, final_norm=False, tie_embeddings=True,
+        dtype=dtype, attention_impl="xla")
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionSpec:
+    image_size: int = 224
+    patch_size: int = 32
+    tcfg: TransformerConfig = None
+
+
+def clip_vision_encode(params: Params, pixel_values,
+                       spec: CLIPVisionSpec):
+    """pixel_values [B, H, W, 3] (NHWC) -> hidden states
+    [B, 1 + patches, hidden] (fp32). The class token is row 0 (HF's
+    pooled path takes post_layernorm of it)."""
+    cfg = spec.tcfg
+    x = jnp.asarray(pixel_values).astype(cfg.dtype)
+    if x.shape[1] != spec.image_size or x.shape[2] != spec.image_size:
+        # fail fast: an off-size image would silently CLAMP the learned
+        # position gather (JAX out-of-bounds gathers clamp, not raise)
+        raise ValueError(f"pixel_values {x.shape[1]}x{x.shape[2]} != "
+                         f"spec.image_size {spec.image_size}")
+    patches = jax.lax.conv_general_dilated(
+        x, params["patch_embed"].astype(cfg.dtype),
+        (spec.patch_size, spec.patch_size), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    B = patches.shape[0]
+    tok = patches.reshape(B, -1, cfg.hidden_size)
+    cls = jnp.broadcast_to(
+        params["class_embed"].astype(cfg.dtype)[None, None],
+        (B, 1, cfg.hidden_size))
+    embeds = jnp.concatenate([cls, tok], axis=1)
+    h, _ = _tf_forward(params, None, cfg, inputs_embeds=embeds,
+                       return_hidden=True)
+    return h
+
+
+def clip_vision_pooled(params: Params, hidden, spec: CLIPVisionSpec):
+    """HF's pooled output: post_layernorm of the class token."""
+    from deepspeed_tpu.models.transformer import _norm
+    cfg = dataclasses.replace(spec.tcfg, norm_type="layernorm")
+    return _norm(hidden[:, 0], params["post_ln_scale"],
+                 params["post_ln_bias"], cfg)
+
+
+def init_clip_vision_params(key, spec: CLIPVisionSpec) -> Params:
+    cfg = spec.tcfg
+    p = _tf_init(key, cfg)
+    p.pop("tok_embed", None)
+    p["post_ln_scale"] = jnp.ones((cfg.hidden_size,), jnp.float32)
+    p["post_ln_bias"] = jnp.zeros((cfg.hidden_size,), jnp.float32)
+    k1, k2 = jax.random.split(jax.random.fold_in(key, 31))
+    fan_in = spec.patch_size * spec.patch_size * 3
+    p["patch_embed"] = (jax.random.normal(
+        k1, (spec.patch_size, spec.patch_size, 3, cfg.hidden_size))
+        / math.sqrt(fan_in)).astype(jnp.float32)
+    p["class_embed"] = (jax.random.normal(k2, (cfg.hidden_size,))
+                        * 0.02).astype(jnp.float32)
+    return p
+
+
+def clip_vision_logical_axes(spec: CLIPVisionSpec) -> Params:
+    axes = dict(_tf_axes(spec.tcfg))
+    axes.pop("tok_embed", None)
+    axes["patch_embed"] = (None, None, None, "embed")
+    axes["class_embed"] = ("embed",)
+    axes["post_ln_scale"] = ("unmodeled",)
+    axes["post_ln_bias"] = ("unmodeled",)
+    return axes
+
+
+def make_clip_vision_model(spec: CLIPVisionSpec,
+                           name: str = "clip-vision") -> ModelSpec:
+    return ModelSpec(
+        init=lambda key: init_clip_vision_params(key, spec),
+        loss_fn=None,
+        apply=lambda params, pixel_values, **kw:
+            clip_vision_encode(params, pixel_values, spec),
+        logical_axes=clip_vision_logical_axes(spec),
+        config=spec,
+        name=name,
+    )
+
+
+def load_clip_vision_params(src, spec: CLIPVisionSpec,
+                            dtype=np.float32) -> Params:
+    """Convert an HF CLIPVisionModel / full CLIPModel state dict to the
+    tower's param tree. Reference analogue: HFCLIPLayerPolicy's weight
+    extraction (clip.py:40-68), plus the vision-only embedding front-end.
+    Small enough that a one-shot (non-streaming) conversion is fine."""
+    sd = src
+    if hasattr(src, "state_dict"):
+        sd = {k: v.detach().cpu().numpy() for k, v in
+              src.state_dict().items()}
+    cfg = spec.tcfg
+
+    def get(key):
+        for pre in ("vision_model.", ""):
+            if pre + key in sd:
+                return np.asarray(sd[pre + key], dtype)
+        raise KeyError(key)
+
+    L = cfg.num_layers
+    p: Params = {
+        # torch conv OIHW -> HWIO
+        "patch_embed": np.transpose(
+            get("embeddings.patch_embedding.weight"), (2, 3, 1, 0)),
+        "class_embed": get("embeddings.class_embedding"),
+        "pos_embed": get("embeddings.position_embedding.weight"),
+        "embed_norm_scale": get("pre_layrnorm.weight"),
+        "embed_norm_bias": get("pre_layrnorm.bias"),
+        "post_ln_scale": get("post_layernorm.weight"),
+        "post_ln_bias": get("post_layernorm.bias"),
+    }
+    names = {
+        "wq": ("self_attn.q_proj.weight", True),
+        "bq": ("self_attn.q_proj.bias", False),
+        "wk": ("self_attn.k_proj.weight", True),
+        "bk": ("self_attn.k_proj.bias", False),
+        "wv": ("self_attn.v_proj.weight", True),
+        "bv": ("self_attn.v_proj.bias", False),
+        "wo": ("self_attn.out_proj.weight", True),
+        "bo": ("self_attn.out_proj.bias", False),
+        "ln1_scale": ("layer_norm1.weight", False),
+        "ln1_bias": ("layer_norm1.bias", False),
+        "ln2_scale": ("layer_norm2.weight", False),
+        "ln2_bias": ("layer_norm2.bias", False),
+        "w_in": ("mlp.fc1.weight", True),
+        "b_in": ("mlp.fc1.bias", False),
+        "w_out": ("mlp.fc2.weight", True),
+        "b_out": ("mlp.fc2.bias", False),
+    }
+    layers: Params = {}
+    for ours, (theirs, transpose) in names.items():
+        rows = []
+        for i in range(L):
+            w = get(f"encoder.layers.{i}.{theirs}")
+            rows.append(w.T if transpose else w)
+        layers[ours] = np.stack(rows)
+    p["layers"] = layers
+    return jax.tree.map(jnp.asarray, p)

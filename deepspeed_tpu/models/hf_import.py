@@ -350,6 +350,41 @@ def _roberta_table(cfg):
     return table
 
 
+def _clip_table(cfg):
+    """CLIP text encoder (reference: module_inject/containers/clip.py —
+    HFCLIPLayerPolicy over CLIPEncoderLayer): pre-LN causal text tower,
+    quick_gelu MLP, learned positions, final layer norm, no LM head.
+    Accepts a bare CLIPTextModel dict or the text half of a full CLIPModel
+    (vision keys are skipped; models/clip_vision.py imports that tower)."""
+    pre = r"^(?:text_model\.)?"
+    lyr = pre + r"encoder\.layers\.(\d+)\."
+    att = lyr + r"self_attn\."
+    return [
+        (pre + r"embeddings\.token_embedding\.weight$", ("tok_embed",),
+         None),
+        (pre + r"embeddings\.position_embedding\.weight$", ("pos_embed",),
+         None),
+        (pre + r"final_layer_norm\.weight$", ("final_norm_scale",), None),
+        (pre + r"final_layer_norm\.bias$", ("final_norm_bias",), None),
+        (att + r"q_proj\.weight$", ("layers", "wq"), _t),
+        (att + r"q_proj\.bias$", ("layers", "bq"), None),
+        (att + r"k_proj\.weight$", ("layers", "wk"), _t),
+        (att + r"k_proj\.bias$", ("layers", "bk"), None),
+        (att + r"v_proj\.weight$", ("layers", "wv"), _t),
+        (att + r"v_proj\.bias$", ("layers", "bv"), None),
+        (att + r"out_proj\.weight$", ("layers", "wo"), _t),
+        (att + r"out_proj\.bias$", ("layers", "bo"), None),
+        (lyr + r"layer_norm1\.weight$", ("layers", "ln1_scale"), None),
+        (lyr + r"layer_norm1\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"layer_norm2\.weight$", ("layers", "ln2_scale"), None),
+        (lyr + r"layer_norm2\.bias$", ("layers", "ln2_bias"), None),
+        (lyr + r"mlp\.fc1\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"mlp\.fc1\.bias$", ("layers", "b_in"), None),
+        (lyr + r"mlp\.fc2\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"mlp\.fc2\.bias$", ("layers", "b_out"), None),
+    ]
+
+
 def _gptj_table(cfg):
     """GPT-J (reference: module_inject/containers/gptj.py): parallel
     attn+MLP block with ONE shared LN — ln_1 fills both our ln1 and ln2
@@ -419,13 +454,17 @@ def _gptneox_table(cfg):
 
 _SKIP = re.compile(r"(rotary_emb\.inv_freq|\.attn\.(bias|masked_bias)$"
                    r"|\.attention\.(bias|masked_bias|rotary_emb)"
-                   r"|pooler\.dense\.|cls\.|position_ids$)")
+                   r"|pooler\.dense\.|cls\.|position_ids$"
+                   # full-CLIP extras: the vision tower loads through
+                   # models/clip_vision.py; projections are out of scope
+                   r"|^vision_model\.|^visual_projection\."
+                   r"|^text_projection\.|^logit_scale$)")
 
 
 _TABLES = {"llama": _llama_table, "gpt2": _gpt2_table,
            "mixtral": _mixtral_table, "opt": _opt_table,
            "bloom": _bloom_table, "bert": _bert_table,
-           "roberta": _roberta_table,
+           "roberta": _roberta_table, "clip": _clip_table,
            "gptj": _gptj_table, "gpt_neox": _gptneox_table}
 
 
@@ -438,6 +477,8 @@ def _detect_family(keys) -> str:
             return "mixtral"
         if k.startswith("roberta."):
             return "roberta"
+        if "text_model." in k or "token_embedding" in k:
+            return "clip"
         if "encoder.layer." in k or "token_type_embeddings" in k:
             return "bert"
         if ("gpt_neox." in k or "embed_in." in k or "embed_out." in k
@@ -832,6 +873,25 @@ def hf_config_to_transformer(hf_cfg, **overrides):
             embed_norm=True, final_norm=False,
             type_vocab_size=get("type_vocab_size", 2) or 0,
             tie_embeddings=True)
+    elif mt in ("clip", "clip_text_model"):
+        # CLIP text tower (reference: module_inject/containers/clip.py).
+        # A full CLIPModel config nests it under text_config.
+        tc = get("text_config") if mt == "clip" else None
+        if tc is not None and not isinstance(tc, dict):
+            tc = getattr(tc, "to_dict", lambda: vars(tc))()
+        g2 = (lambda k, d=None: tc.get(k, d)) if tc else get
+        act = g2("hidden_act", "quick_gelu")
+        kw = dict(
+            vocab_size=g2("vocab_size"), hidden_size=g2("hidden_size"),
+            num_layers=g2("num_hidden_layers"),
+            num_heads=g2("num_attention_heads"),
+            intermediate_size=g2("intermediate_size"),
+            max_seq_len=g2("max_position_embeddings", 77),
+            norm_eps=g2("layer_norm_eps", 1e-5),
+            position_type="learned",
+            activation="quick_gelu" if act == "quick_gelu" else "gelu",
+            norm_type="layernorm", causal=True, qkv_bias=True,
+            final_norm=True, tie_embeddings=True)
     elif mt == "gptj":
         # reference: module_inject/containers/gptj.py — parallel attn+MLP
         # residual, single shared LN, partial interleaved rotary, head bias

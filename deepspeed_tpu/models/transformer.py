@@ -531,6 +531,8 @@ def _activation(x, gate, cfg: TransformerConfig):
         return jax.nn.gelu(gate) * x
     if cfg.activation == "relu":   # OPT family
         return jax.nn.relu(x)
+    if cfg.activation == "quick_gelu":   # CLIP text encoder
+        return x * jax.nn.sigmoid(1.702 * x)
     return jax.nn.gelu(x)
 
 
@@ -997,14 +999,21 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
             dropout_rng=None,
             deterministic: bool = True, layer_override=None,
             return_aux: bool = False, return_kv: bool = False,
-            return_hidden: bool = False, pld_theta=None):
+            return_hidden: bool = False, pld_theta=None,
+            inputs_embeds=None):
     """input_ids: [B, S] int32 -> logits [B, S, vocab] (in fp32).
 
     return_kv: also return the per-layer (post-rotary) K/V stacked on a
     leading layer dim — the prefill path's cache seed. token_type_ids:
-    segment ids for encoder models (type_vocab_size > 0); None -> zeros."""
-    B, S = input_ids.shape
-    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    segment ids for encoder models (type_vocab_size > 0); None -> zeros.
+    inputs_embeds: pre-computed [B, S, H] embeddings instead of a token
+    lookup (vision towers / soft prompts); positions still apply."""
+    if inputs_embeds is not None:
+        B, S = inputs_embeds.shape[:2]
+        x = inputs_embeds.astype(cfg.dtype)
+    else:
+        B, S = input_ids.shape
+        x = params["tok_embed"][input_ids].astype(cfg.dtype)
     if cfg.position_type == "learned":
         pos = positions if positions is not None else jnp.arange(S)[None]
         x = x + params["pos_embed"][pos].astype(cfg.dtype)

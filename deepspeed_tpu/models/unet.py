@@ -38,6 +38,9 @@ class UNetConfig:
     time_embed_dim: int = 256
     attn_heads: int = 4              # bottleneck self-attention
     norm_groups: int = 8
+    # cross-attention context width (e.g. the CLIP text hidden size) — the
+    # SD-style conditioning path; None = unconditioned UNet
+    context_dim: Optional[int] = None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -95,38 +98,46 @@ def _res_block_params(key, cin, cout, temb, dt):
     return p
 
 
-def _res_block(x, emb, p, cfg: UNetConfig):
+def _res_block(x, emb, p, cfg):
+    """GroupNorm-silu-conv residual block; ``emb=None`` (no temb_w in p)
+    serves the VAE, which has no timestep conditioning. cfg only needs
+    ``norm_groups``."""
     h = _group_norm(x, p["norm1_scale"], p["norm1_bias"], cfg.norm_groups)
     h = _conv(jax.nn.silu(h), p["conv1"], p["conv1_b"])
-    h = h + (jax.nn.silu(emb) @ p["temb_w"].astype(emb.dtype)
-             + p["temb_b"].astype(emb.dtype))[:, None, None, :]
+    if emb is not None:
+        h = h + (jax.nn.silu(emb) @ p["temb_w"].astype(emb.dtype)
+                 + p["temb_b"].astype(emb.dtype))[:, None, None, :]
     h = _group_norm(h, p["norm2_scale"], p["norm2_bias"], cfg.norm_groups)
     h = _conv(jax.nn.silu(h), p["conv2"], p["conv2_b"])
     skip = _conv(x, p["skip"]) if "skip" in p else x
     return skip + h
 
 
-def _attn_params(key, c, dt):
+def _attn_params(key, c, dt, kv_dim: Optional[int] = None):
     ks = jax.random.split(key, 4)
+    kv = kv_dim or c
     s = 1.0 / math.sqrt(c)
+    sk = 1.0 / math.sqrt(kv)
     return {"norm_scale": jnp.ones((c,), dt), "norm_bias": jnp.zeros((c,), dt),
             "wq": (jax.random.normal(ks[0], (c, c)) * s).astype(dt),
-            "wk": (jax.random.normal(ks[1], (c, c)) * s).astype(dt),
-            "wv": (jax.random.normal(ks[2], (c, c)) * s).astype(dt),
+            "wk": (jax.random.normal(ks[1], (kv, c)) * sk).astype(dt),
+            "wv": (jax.random.normal(ks[2], (kv, c)) * sk).astype(dt),
             "wo": (jax.random.normal(ks[3], (c, c)) * 1e-4).astype(dt)}
 
 
-def _spatial_attention(x, p, cfg: UNetConfig):
-    """Bottleneck self-attention over H*W tokens (the diffusers
-    AttentionBlock; reference wraps it with the CLIP/UNet policy)."""
+def _spatial_attention(x, p, cfg: UNetConfig, context=None):
+    """Bottleneck attention over H*W tokens (the diffusers AttentionBlock;
+    reference wraps it with the CLIP/UNet policy). context [B, T, ctx_dim]
+    switches K/V to the conditioning tokens (SD cross-attention)."""
     B, H, W, C = x.shape
     h = _group_norm(x, p["norm_scale"], p["norm_bias"], cfg.norm_groups)
     tok = h.reshape(B, H * W, C)
     nh = cfg.attn_heads
     hd = C // nh
+    kv_src = tok if context is None else context.astype(tok.dtype)
     q = (tok @ p["wq"].astype(tok.dtype)).reshape(B, H * W, nh, hd)
-    k = (tok @ p["wk"].astype(tok.dtype)).reshape(B, H * W, nh, hd)
-    v = (tok @ p["wv"].astype(tok.dtype)).reshape(B, H * W, nh, hd)
+    k = (kv_src @ p["wk"].astype(tok.dtype)).reshape(B, -1, nh, hd)
+    v = (kv_src @ p["wv"].astype(tok.dtype)).reshape(B, -1, nh, hd)
     s = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32)
     a = jax.nn.softmax(s / math.sqrt(hd), axis=-1).astype(tok.dtype)
     o = jnp.einsum("bnst,btnd->bsnd", a, v).reshape(B, H * W, C)
@@ -164,6 +175,11 @@ def init_unet_params(key, cfg: UNetConfig) -> Params:
             chans.append(c)
     p["mid_block1"] = _res_block_params(next(ks), c, c, temb, dt)
     p["mid_attn"] = _attn_params(next(ks), c, dt)
+    if cfg.context_dim:
+        # SD-style conditioning: cross-attention over the text-encoder
+        # tokens at the bottleneck
+        p["mid_xattn"] = _attn_params(next(ks), c, dt,
+                                      kv_dim=cfg.context_dim)
     p["mid_block2"] = _res_block_params(next(ks), c, c, temb, dt)
     for li, mult in reversed(list(enumerate(cfg.channel_mults))):
         cout = ch * mult
@@ -182,9 +198,10 @@ def init_unet_params(key, cfg: UNetConfig) -> Params:
     return p
 
 
-def unet_forward(params: Params, x, t, cfg: UNetConfig):
-    """x: [B, H, W, in_channels]; t: [B] diffusion timestep -> eps
-    prediction [B, H, W, out_channels]."""
+def unet_forward(params: Params, x, t, cfg: UNetConfig, context=None):
+    """x: [B, H, W, in_channels]; t: [B] diffusion timestep; context:
+    optional [B, T, context_dim] conditioning tokens (CLIP text hidden
+    states) -> eps prediction [B, H, W, out_channels]."""
     x = x.astype(cfg.dtype)
     emb = _timestep_embedding(t, cfg.time_embed_dim).astype(cfg.dtype)
     emb = jax.nn.silu(emb @ params["temb_w1"].astype(cfg.dtype)
@@ -202,8 +219,17 @@ def unet_forward(params: Params, x, t, cfg: UNetConfig):
             h = _conv(h, params[f"down_{li}_pool"],
                       params[f"down_{li}_pool_b"], stride=2)
             skips.append(h)
+    if (context is None) != ("mid_xattn" not in params):
+        raise ValueError(
+            "conditioned UNet mismatch: context_dim models REQUIRE a "
+            "context (pass null-text embeddings for the unconditional "
+            "branch, the SD convention); unconditioned models accept "
+            "none")
     h = _res_block(h, emb, params["mid_block1"], cfg)
     h = _spatial_attention(h, params["mid_attn"], cfg)
+    if context is not None:
+        h = _spatial_attention(h, params["mid_xattn"], cfg,
+                               context=context)
     h = _res_block(h, emb, params["mid_block2"], cfg)
     for li, mult in reversed(list(enumerate(cfg.channel_mults))):
         for bi in range(cfg.num_res_blocks + 1):
@@ -242,8 +268,11 @@ def denoise_loss(params: Params, batch: Dict[str, Any], cfg: UNetConfig,
                  rng=None, deterministic: bool = True):
     """Standard DDPM epsilon-prediction MSE. batch: {"x": noisy input,
     "t": timesteps, "target": the noise to predict}."""
+    ctx = batch.get("context")
     pred = unet_forward(params, jnp.asarray(batch["x"]),
-                        jnp.asarray(batch["t"]), cfg)
+                        jnp.asarray(batch["t"]), cfg,
+                        context=jnp.asarray(ctx) if ctx is not None
+                        else None)
     target = jnp.asarray(batch["target"], jnp.float32)
     return jnp.mean(jnp.square(pred - target))
 
@@ -257,9 +286,10 @@ def make_unet_model(cfg: UNetConfig, name: str = "unet"):
         init=lambda key: init_unet_params(key, cfg),
         loss_fn=lambda params, batch, rng=None, deterministic=True:
             denoise_loss(params, batch, cfg, rng, deterministic),
-        apply=lambda params, x, t=None, **kw: unet_forward(
+        apply=lambda params, x, t=None, context=None, **kw: unet_forward(
             params, x, t if t is not None else jnp.zeros(
-                (jnp.asarray(x).shape[0],), jnp.int32), cfg),
+                (jnp.asarray(x).shape[0],), jnp.int32), cfg,
+            context=context),
         logical_axes=unet_logical_axes(cfg),
         config=cfg,
         name=name,

@@ -1,0 +1,195 @@
+"""Convolutional KL autoencoder (VAE) — the latent half of a Stable-
+Diffusion-style pipeline.
+
+Reference coverage: ``deepspeed/model_implementations/diffusers/vae.py``
+(DSVAE — a CUDA-graphed wrapper exposing encode/decode around an HF
+AutoencoderKL) and the VAE policy of ``module_inject`` (SURVEY §2.9/§2.13
+diffusers corner). TPU-native re-design: CUDA-graph capture IS jit caching,
+so what remains real is the MODEL — a from-scratch NHWC conv encoder/decoder
+with a KL latent bottleneck, expressed as a ModelSpec so the training engine
+(any ZeRO stage) and init_inference accept it like any other model.
+
+Layout/axes conventions follow models/unet.py: NHWC, conv output channels on
+the "mlp" logical axis so AutoTP column-shards them.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.unet import (_conv, _group_norm, _init_conv,
+                                       _res_block)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4          # SD convention
+    base_channels: int = 64
+    channel_mults: Tuple[int, ...] = (1, 2)   # one downsample per extra mult
+    num_res_blocks: int = 1
+    norm_groups: int = 8
+    kl_weight: float = 1e-6           # SD's AutoencoderKL beta
+    scaling_factor: float = 0.18215   # SD latent scaling
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def downscale(self) -> int:
+        return 2 ** (len(self.channel_mults) - 1)
+
+
+def _res_params(key, cin, cout, dt):
+    ks = jax.random.split(key, 3)
+    p = {
+        "norm1_scale": jnp.ones((cin,), dt),
+        "norm1_bias": jnp.zeros((cin,), dt),
+        "conv1": _init_conv(ks[0], 3, 3, cin, cout, dt),
+        "conv1_b": jnp.zeros((cout,), dt),
+        "norm2_scale": jnp.ones((cout,), dt),
+        "norm2_bias": jnp.zeros((cout,), dt),
+        "conv2": _init_conv(ks[1], 3, 3, cout, cout, dt, scale=1e-4),
+        "conv2_b": jnp.zeros((cout,), dt),
+    }
+    if cin != cout:
+        p["skip"] = _init_conv(ks[2], 1, 1, cin, cout, dt)
+    return p
+
+
+def _res(x, p, cfg: VAEConfig):
+    # unet's residual block without timestep conditioning (emb=None)
+    return _res_block(x, None, p, cfg)
+
+
+def init_vae_params(key, cfg: VAEConfig) -> Params:
+    dt = cfg.param_dtype
+    ks = iter(jax.random.split(key, 64))
+    ch = cfg.base_channels
+    p: Params = {"enc": {}, "dec": {}}
+
+    # ---- encoder: conv_in -> res/downsample stack -> 2*latent (mean‖logvar)
+    e = p["enc"]
+    e["conv_in"] = _init_conv(next(ks), 3, 3, cfg.in_channels, ch, dt)
+    e["conv_in_b"] = jnp.zeros((ch,), dt)
+    c = ch
+    for li, mult in enumerate(cfg.channel_mults):
+        cout = ch * mult
+        for bi in range(cfg.num_res_blocks):
+            e[f"down_{li}_{bi}"] = _res_params(next(ks), c, cout, dt)
+            c = cout
+        if li != len(cfg.channel_mults) - 1:
+            e[f"down_{li}_pool"] = _init_conv(next(ks), 3, 3, c, c, dt)
+            e[f"down_{li}_pool_b"] = jnp.zeros((c,), dt)
+    e["norm_out_scale"] = jnp.ones((c,), dt)
+    e["norm_out_bias"] = jnp.zeros((c,), dt)
+    e["conv_out"] = _init_conv(next(ks), 3, 3, c, 2 * cfg.latent_channels,
+                               dt)
+    e["conv_out_b"] = jnp.zeros((2 * cfg.latent_channels,), dt)
+
+    # ---- decoder: conv_in -> res/upsample stack -> image
+    d = p["dec"]
+    d["conv_in"] = _init_conv(next(ks), 3, 3, cfg.latent_channels, c, dt)
+    d["conv_in_b"] = jnp.zeros((c,), dt)
+    for li, mult in reversed(list(enumerate(cfg.channel_mults))):
+        cout = ch * mult
+        for bi in range(cfg.num_res_blocks):
+            d[f"up_{li}_{bi}"] = _res_params(next(ks), c, cout, dt)
+            c = cout
+        if li != 0:
+            d[f"up_{li}_conv"] = _init_conv(next(ks), 3, 3, c, c, dt)
+            d[f"up_{li}_conv_b"] = jnp.zeros((c,), dt)
+    d["norm_out_scale"] = jnp.ones((c,), dt)
+    d["norm_out_bias"] = jnp.zeros((c,), dt)
+    d["conv_out"] = _init_conv(next(ks), 3, 3, c, cfg.in_channels, dt,
+                               scale=1e-4)
+    d["conv_out_b"] = jnp.zeros((cfg.in_channels,), dt)
+    return p
+
+
+def vae_encode(params: Params, x, cfg: VAEConfig):
+    """x [B, H, W, C] -> (mean, logvar) each [B, H/ds, W/ds, latent]."""
+    e = params["enc"]
+    h = _conv(x.astype(cfg.dtype), e["conv_in"], e["conv_in_b"])
+    for li, mult in enumerate(cfg.channel_mults):
+        for bi in range(cfg.num_res_blocks):
+            h = _res(h, e[f"down_{li}_{bi}"], cfg)
+        if li != len(cfg.channel_mults) - 1:
+            h = _conv(h, e[f"down_{li}_pool"], e[f"down_{li}_pool_b"],
+                      stride=2)
+    h = _group_norm(h, e["norm_out_scale"], e["norm_out_bias"],
+                    cfg.norm_groups)
+    h = _conv(jax.nn.silu(h), e["conv_out"], e["conv_out_b"])
+    mean, logvar = jnp.split(h.astype(jnp.float32), 2, axis=-1)
+    return mean, jnp.clip(logvar, -30.0, 20.0)
+
+
+def vae_decode(params: Params, z, cfg: VAEConfig):
+    """z [B, h, w, latent] -> image [B, H, W, C] (fp32)."""
+    d = params["dec"]
+    h = _conv(z.astype(cfg.dtype), d["conv_in"], d["conv_in_b"])
+    for li, mult in reversed(list(enumerate(cfg.channel_mults))):
+        for bi in range(cfg.num_res_blocks):
+            h = _res(h, d[f"up_{li}_{bi}"], cfg)
+        if li != 0:
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+            h = _conv(h, d[f"up_{li}_conv"], d[f"up_{li}_conv_b"])
+    h = _group_norm(h, d["norm_out_scale"], d["norm_out_bias"],
+                    cfg.norm_groups)
+    out = _conv(jax.nn.silu(h), d["conv_out"], d["conv_out_b"])
+    return out.astype(jnp.float32)
+
+
+def vae_loss(params: Params, batch: Dict[str, Any], cfg: VAEConfig,
+             rng=None, deterministic: bool = True):
+    """Reconstruction MSE + beta*KL (the AutoencoderKL training loss,
+    minus the adversarial term which is a separate model)."""
+    x = jnp.asarray(batch["x"])
+    mean, logvar = vae_encode(params, x, cfg)
+    if deterministic or rng is None:
+        z = mean
+    else:
+        z = mean + jnp.exp(0.5 * logvar) * jax.random.normal(
+            rng, mean.shape)
+    recon = vae_decode(params, z, cfg)
+    rec = jnp.mean(jnp.square(recon - jnp.asarray(x, jnp.float32)))
+    kl = 0.5 * jnp.mean(jnp.square(mean) + jnp.exp(logvar) - 1.0 - logvar)
+    return rec + cfg.kl_weight * kl
+
+
+def vae_logical_axes(cfg: VAEConfig) -> Params:
+    shapes = jax.eval_shape(lambda k: init_vae_params(k, cfg),
+                            jax.random.PRNGKey(0))
+
+    def one(leaf):
+        if leaf.ndim == 4:   # conv HWIO: shard output channels
+            return (None, None, None, "mlp")
+        if leaf.ndim == 2:
+            return ("embed", "mlp")
+        return ("unmodeled",)
+
+    return jax.tree.map(one, shapes)
+
+
+def make_vae_model(cfg: VAEConfig, name: str = "vae"):
+    """ModelSpec exposing encode/decode the way DSVAE does (vae.py:96:
+    `encode`/`decode` entry points): InferenceEngine grows jitted
+    vae_encode/vae_decode methods for specs whose config is a VAEConfig;
+    plain forward() runs encode(mode)->decode."""
+    from deepspeed_tpu.models.transformer import ModelSpec
+    spec = ModelSpec(
+        init=lambda key: init_vae_params(key, cfg),
+        loss_fn=lambda params, batch, rng=None, deterministic=True:
+            vae_loss(params, batch, cfg, rng, deterministic),
+        apply=lambda params, x, **kw: vae_decode(
+            params, vae_encode(params, x, cfg)[0], cfg),
+        logical_axes=vae_logical_axes(cfg),
+        config=cfg,
+        name=name,
+    )
+    return spec
