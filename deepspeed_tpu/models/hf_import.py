@@ -412,6 +412,68 @@ def _gptj_table(cfg):
     ]
 
 
+def _gptneo_table(cfg):
+    """GPT-Neo (reference: module_inject/containers/gptneo.py): GPT-2-shaped
+    block but with nn.Linear projections ([out, in] — transposed, unlike
+    GPT-2's Conv1D), un-fused q/k/v with NO biases, and alternating
+    global/local attention (handled by cfg.attn_windows, not weights)."""
+    pre = r"^(?:transformer\.)?"
+    lyr = pre + r"h\.(\d+)\."
+    att = lyr + r"attn\.attention\."
+    return [
+        (pre + r"wte\.weight$", ("tok_embed",), None),
+        (pre + r"wpe\.weight$", ("pos_embed",), None),
+        (r"^lm_head\.weight$", ("lm_head",), _t),
+        (pre + r"ln_f\.weight$", ("final_norm_scale",), None),
+        (pre + r"ln_f\.bias$", ("final_norm_bias",), None),
+        (lyr + r"ln_1\.weight$", ("layers", "ln1_scale"), None),
+        (lyr + r"ln_1\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"ln_2\.weight$", ("layers", "ln2_scale"), None),
+        (lyr + r"ln_2\.bias$", ("layers", "ln2_bias"), None),
+        (att + r"q_proj\.weight$", ("layers", "wq"), _t),
+        (att + r"k_proj\.weight$", ("layers", "wk"), _t),
+        (att + r"v_proj\.weight$", ("layers", "wv"), _t),
+        (att + r"out_proj\.weight$", ("layers", "wo"), _t),
+        (att + r"out_proj\.bias$", ("layers", "bo"), None),
+        (lyr + r"mlp\.c_fc\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"mlp\.c_fc\.bias$", ("layers", "b_in"), None),
+        (lyr + r"mlp\.c_proj\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"mlp\.c_proj\.bias$", ("layers", "b_out"), None),
+    ]
+
+
+def _distilbert_table(cfg):
+    """DistilBERT (reference: module_inject/containers/distil_bert.py):
+    BERT-shaped post-LN encoder, no token-type embeddings; sa_layer_norm is
+    our ln1 (after the attention residual), output_layer_norm our ln2."""
+    pre = r"^(?:distilbert\.)?"
+    lyr = pre + r"transformer\.layer\.(\d+)\."
+    att = lyr + r"attention\."
+    return [
+        (pre + r"embeddings\.word_embeddings\.weight$", ("tok_embed",), None),
+        (pre + r"embeddings\.position_embeddings\.weight$",
+         ("pos_embed",), None),
+        (pre + r"embeddings\.LayerNorm\.weight$", ("embed_norm_scale",), None),
+        (pre + r"embeddings\.LayerNorm\.bias$", ("embed_norm_bias",), None),
+        (att + r"q_lin\.weight$", ("layers", "wq"), _t),
+        (att + r"q_lin\.bias$", ("layers", "bq"), None),
+        (att + r"k_lin\.weight$", ("layers", "wk"), _t),
+        (att + r"k_lin\.bias$", ("layers", "bk"), None),
+        (att + r"v_lin\.weight$", ("layers", "wv"), _t),
+        (att + r"v_lin\.bias$", ("layers", "bv"), None),
+        (att + r"out_lin\.weight$", ("layers", "wo"), _t),
+        (att + r"out_lin\.bias$", ("layers", "bo"), None),
+        (lyr + r"sa_layer_norm\.weight$", ("layers", "ln1_scale"), None),
+        (lyr + r"sa_layer_norm\.bias$", ("layers", "ln1_bias"), None),
+        (lyr + r"ffn\.lin1\.weight$", ("layers", "w_in"), _t),
+        (lyr + r"ffn\.lin1\.bias$", ("layers", "b_in"), None),
+        (lyr + r"ffn\.lin2\.weight$", ("layers", "w_out"), _t),
+        (lyr + r"ffn\.lin2\.bias$", ("layers", "b_out"), None),
+        (lyr + r"output_layer_norm\.weight$", ("layers", "ln2_scale"), None),
+        (lyr + r"output_layer_norm\.bias$", ("layers", "ln2_bias"), None),
+    ]
+
+
 def _gptneox_table(cfg):
     """GPT-NeoX (reference: module_inject/containers/gptneox.py): parallel
     residual with two LNs, per-head-interleaved fused qkv like BLOOM."""
@@ -458,14 +520,19 @@ _SKIP = re.compile(r"(rotary_emb\.inv_freq|\.attn\.(bias|masked_bias)$"
                    # full-CLIP extras: the vision tower loads through
                    # models/clip_vision.py; projections are out of scope
                    r"|^vision_model\.|^visual_projection\."
-                   r"|^text_projection\.|^logit_scale$)")
+                   r"|^text_projection\.|^logit_scale$"
+                   # DistilBERT MLM/classification heads: hidden states +
+                   # tied-embedding logits, as with BERT's cls.* head
+                   r"|^vocab_(transform|layer_norm|projector)\."
+                   r"|^(pre_)?classifier\.|^qa_outputs\.)")
 
 
 _TABLES = {"llama": _llama_table, "gpt2": _gpt2_table,
            "mixtral": _mixtral_table, "opt": _opt_table,
            "bloom": _bloom_table, "bert": _bert_table,
            "roberta": _roberta_table, "clip": _clip_table,
-           "gptj": _gptj_table, "gpt_neox": _gptneox_table}
+           "gptj": _gptj_table, "gpt_neox": _gptneox_table,
+           "gpt_neo": _gptneo_table, "distilbert": _distilbert_table}
 
 
 def _detect_family(keys) -> str:
@@ -479,6 +546,10 @@ def _detect_family(keys) -> str:
             return "roberta"
         if "text_model." in k or "token_embedding" in k:
             return "clip"
+        if (k.startswith("distilbert.") or "sa_layer_norm" in k
+                or "output_layer_norm" in k or ".q_lin." in k
+                or ".ffn.lin1." in k):
+            return "distilbert"
         if "encoder.layer." in k or "token_type_embeddings" in k:
             return "bert"
         if ("gpt_neox." in k or "embed_in." in k or "embed_out." in k
@@ -504,13 +575,16 @@ def _detect_family(keys) -> str:
                 or ".attn.v_proj" in k or ".attn.out_proj" in k
                 or ".mlp.fc_in." in k or ".mlp.fc_out." in k):
             return "gptj"
+        # GPT-Neo: un-fused projections under .attn.attention. (GPT-2's are
+        # fused c_attn; shares wpe/ln_2/mlp.c_fc with GPT-2, so only this
+        # marker is distinctive)
+        if ".attn.attention." in k:
+            return "gpt_neo"
         # gpt2 needs a DISTINCTIVE marker, not just the h.* prefix (BLOOM
-        # also uses h.N., GPT-J shares wte/ln_1 — its keys must stay
-        # pending until a family-distinctive key streams by)
-        if (".attn.c_attn." in k or "wpe." in k
-                or ".ln_2." in k
-                or ".mlp.c_fc." in k or ".mlp.c_proj." in k
-                or ".attn.c_proj." in k):
+        # also uses h.N., GPT-J shares wte/ln_1, GPT-Neo shares
+        # wpe/ln_2/mlp.c_* — their keys must stay pending until a
+        # family-distinctive key streams by)
+        if ".attn.c_attn." in k or ".attn.c_proj." in k:
             return "gpt2"
     raise ValueError("unrecognized checkpoint family; expected Llama/Mixtral/"
                      "OPT/BLOOM/GPT-2/BERT/GPT-J/GPT-NeoX-style keys")
@@ -715,11 +789,11 @@ def export_hf_state_dict(params, cfg, *, family: Optional[str] = None
     import jax
     params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), params)
     if (family in ("opt", "bloom", "mixtral", "bert", "roberta", "gptj",
-                   "gpt_neox")
+                   "gpt_neox", "gpt_neo", "distilbert")
             or cfg.num_experts > 1
             or cfg.activation == "relu" or cfg.position_type == "alibi"
             or cfg.parallel_block or not cfg.causal or not cfg.qkv_bias
-            or cfg.type_vocab_size or cfg.head_bias):
+            or cfg.type_vocab_size or cfg.head_bias or cfg.attn_windows):
         raise NotImplementedError(
             "export_hf_state_dict covers the Llama and GPT-2 layouts; "
             "Mixtral/OPT/BLOOM/BERT/GPT-J/GPT-NeoX export is import-only "
@@ -873,6 +947,50 @@ def hf_config_to_transformer(hf_cfg, **overrides):
             embed_norm=True, final_norm=False,
             type_vocab_size=get("type_vocab_size", 2) or 0,
             tie_embeddings=True)
+    elif mt == "distilbert":
+        # reference: module_inject/containers/distil_bert.py — BERT-shaped
+        # post-LN encoder, no token-type embeddings
+        if get("sinusoidal_pos_embds", False):
+            raise ValueError("distilbert sinusoidal_pos_embds=True is not "
+                             "supported (learned-position table expected)")
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=get("dim"),
+            num_layers=get("n_layers"), num_heads=get("n_heads"),
+            intermediate_size=get("hidden_dim"),
+            max_seq_len=get("max_position_embeddings", 512),
+            norm_eps=1e-12,
+            position_type="learned", activation="gelu",
+            norm_type="layernorm", causal=False, norm_style="post",
+            embed_norm=True, final_norm=False, type_vocab_size=0,
+            tie_embeddings=True)
+    elif mt == "gpt_neo":
+        # reference: module_inject/containers/gptneo.py — GPT-2-shaped block
+        # with alternating global/local attention (attention_layers pattern;
+        # local layers see a window_size band)
+        H = get("hidden_size")
+        att_layers = get("attention_layers")
+        if not att_layers:
+            # raw config.json dicts carry the documented attention_types
+            # form [[[kinds...], repeat], ...]; HF derives attention_layers
+            att_layers = [a for kinds, rep in (get("attention_types") or [])
+                          for _ in range(rep) for a in kinds]
+        window = int(get("window_size", 256))
+        wins = tuple(window if a == "local" else 0
+                     for a in att_layers) or None
+        if wins is not None and all(w == 0 for w in wins):
+            wins = None
+        kw = dict(
+            vocab_size=get("vocab_size"), hidden_size=H,
+            num_layers=get("num_layers"),
+            num_heads=get("num_heads"),
+            intermediate_size=get("intermediate_size") or 4 * H,
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm_eps=get("layer_norm_epsilon", 1e-5),
+            position_type="learned", activation="gelu",
+            norm_type="layernorm", qkv_bias=False, attn_out_bias=True,
+            attn_windows=wins,
+            attn_scale=1.0,   # GPT-Neo trains UNSCALED (HF softmax_scale=1)
+            tie_embeddings=bool(get("tie_word_embeddings", True)))
     elif mt in ("clip", "clip_text_model"):
         # CLIP text tower (reference: module_inject/containers/clip.py).
         # A full CLIPModel config nests it under text_config.
@@ -938,12 +1056,18 @@ def hf_config_to_transformer(hf_cfg, **overrides):
         raise ValueError(f"unsupported model_type {mt!r}")
     kw.update(overrides)
     sw = get("sliding_window")
-    if mt == "mistral" and sw and kw["max_seq_len"] > sw:
-        raise ValueError(
-            f"mistral sliding_window={sw} < max_seq_len={kw['max_seq_len']}: "
-            "this framework's attention is fully causal, so logits diverge "
-            "from HF beyond the window. Pass max_seq_len<=sliding_window to "
-            "use the checkpoint within the window.")
+    if mt == "mistral" and sw and kw["max_seq_len"] > sw \
+            and "attn_windows" not in overrides:
+        # every layer slides: the per-layer band mask keeps logits
+        # HF-exact beyond the window
+        kw["attn_windows"] = (int(sw),) * kw["num_layers"]
+        logger.warning(
+            f"mistral sliding_window={sw} < max_seq_len="
+            f"{kw['max_seq_len']}: per-layer band masks keep logits "
+            "HF-exact, but windowed layers take the O(S^2) XLA attention "
+            "path (no flash/ring kernel band support yet) — pass "
+            "max_seq_len<=sliding_window to stay on the flash path "
+            "within the window")
     return TransformerConfig(**kw)
 
 

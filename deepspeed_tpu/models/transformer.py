@@ -62,6 +62,9 @@ class TransformerConfig:
     rotary_interleaved: bool = False
     head_bias: bool = False             # GPT-J lm_head carries a bias
     qkv_bias: bool = True               # layernorm models: attn proj biases
+    # attention out-projection bias when qkv biases are absent (GPT-Neo:
+    # bias-free q/k/v but out_proj.bias exists). None -> follows qkv_bias.
+    attn_out_bias: Optional[bool] = None
     final_norm: bool = True             # BERT has no final LN (post-LN covers)
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
@@ -85,6 +88,14 @@ class TransformerConfig:
     # the d-contraction, so attention reads HALF the cache bytes — at long
     # context the KV read is the decode bound. 0 = off, 8 = int8.
     kv_cache_bits: int = 0
+    # per-layer local-attention windows (reference families: GPT-Neo's
+    # alternating global/local pattern, module_inject/containers/gptneo.py;
+    # Mistral's sliding_window). Length num_layers; 0 = global. The band
+    # mask key j is visible to query i iff i - j < window.
+    attn_windows: Optional[Tuple[int, ...]] = None
+    # softmax scale override; None -> 1/sqrt(head_dim). GPT-Neo trains with
+    # NO scaling (HF softmax_scale=1.0).
+    attn_scale: Optional[float] = None
     # MoE (reference: deepspeed/moe/*; config keys from MoEConfig)
     num_experts: int = 1
     top_k: int = 2
@@ -256,6 +267,7 @@ def init_params(key, cfg: TransformerConfig) -> Params:
             layers["bq"] = jnp.zeros((L, nh * hd), dt)
             layers["bk"] = jnp.zeros((L, nkv * hd), dt)
             layers["bv"] = jnp.zeros((L, nkv * hd), dt)
+        if cfg.qkv_bias or cfg.attn_out_bias:
             layers["bo"] = jnp.zeros((L, H), dt)
         if "w_in" in layers:
             layers["b_in"] = jnp.zeros((L, F), dt)
@@ -317,8 +329,10 @@ def logical_axes(cfg: TransformerConfig) -> Params:
         if cfg.qkv_bias:
             layers.update({
                 "bq": ("layers", "qkv"), "bk": ("layers", "qkv"),
-                "bv": ("layers", "qkv"), "bo": ("layers", "unmodeled"),
+                "bv": ("layers", "qkv"),
             })
+        if cfg.qkv_bias or cfg.attn_out_bias:
+            layers["bo"] = ("layers", "unmodeled")
         if "w_in" in layers:
             layers["b_in"] = ("layers", "mlp")
             layers["b_out"] = ("layers", "unmodeled")
@@ -475,18 +489,23 @@ def _use_pallas(cfg: TransformerConfig, seq_len: int) -> bool:
 
 
 def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig,
-              segment_ids=None):
-    """q: [B,S,Nq,D], k/v: [B,S,Nkv,D] -> [B,S,Nq,D]."""
+              segment_ids=None, window=None):
+    """q: [B,S,Nq,D], k/v: [B,S,Nkv,D] -> [B,S,Nq,D].
+
+    window: local-attention band width (key j visible to query i iff
+    i - j < window); a traced scalar — <= 0 means global. Windowed layers
+    take the XLA path (the flash/ring/sparse kernels have no band mask)."""
     B, S, Nq, D = q.shape
     Nkv = k.shape[2]
+    sm = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / math.sqrt(D)
     # the Pallas flash kernel is GQA-native (K/V never repeated in HBM) and
     # handles key-padding masks in-kernel; other paths get the repeated view
-    if _use_pallas(cfg, S) and segment_ids is None \
+    if _use_pallas(cfg, S) and segment_ids is None and window is None \
             and not cfg.sparse_attention:
         from deepspeed_tpu.parallel.context import seq_parallel_degree
         if seq_parallel_degree() <= 1:
             from deepspeed_tpu.ops.flash_attention import flash_attention as fa
-            return fa(q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(D),
+            return fa(q, k, v, causal=causal, sm_scale=sm,
                       kv_mask=mask)
     if Nkv != Nq:  # GQA: repeat kv heads
         rep = Nq // Nkv
@@ -494,11 +513,13 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         v = jnp.repeat(v, rep, axis=2)
     # sequence parallelism: ring attention over the seq mesh axis
     from deepspeed_tpu.parallel.context import seq_parallel_degree, current_mesh
-    if seq_parallel_degree() > 1 and mask is None and segment_ids is None:
+    if seq_parallel_degree() > 1 and mask is None and segment_ids is None \
+            and window is None:
         from deepspeed_tpu.ops.ring_attention import ring_attention
         return ring_attention(q, k, v, current_mesh(), causal=causal,
-                              sm_scale=1.0 / math.sqrt(D))
-    if cfg.sparse_attention and mask is None and segment_ids is None:
+                              sm_scale=sm)
+    if cfg.sparse_attention and mask is None and segment_ids is None \
+            and window is None:
         if q.dtype == jnp.float16 and jax.default_backend() in ("tpu",
                                                                 "axon"):
             raise ValueError("sparse_attention kernels cannot run fp16 on "
@@ -508,9 +529,9 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         sa = dict(cfg.sparse_attention)
         mode = sa.pop("mode", "fixed")
         return _sparse_attn(q, k, v, get_sparsity_config(mode, **sa),
-                            causal=causal, sm_scale=1.0 / math.sqrt(D))
+                            causal=causal, sm_scale=sm)
     scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32)
-    scores = scores / math.sqrt(D)
+    scores = scores * sm
     if cfg.position_type == "alibi":
         pos = jnp.arange(S)
         rel = (pos[None, :] - pos[:, None]).astype(jnp.float32)  # k - q
@@ -518,6 +539,11 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
     if causal:
         cm = jnp.tril(jnp.ones((S, S), jnp.bool_))
         scores = jnp.where(cm[None, None], scores, -1e30)
+    if window is not None:
+        w = jnp.asarray(window, jnp.int32)
+        pos = jnp.arange(S)
+        band = (pos[:, None] - pos[None, :]) < w  # i - j < window
+        scores = jnp.where((w <= 0) | band[None, None], scores, -1e30)
     if mask is not None:  # [B, S] padding mask over keys
         scores = jnp.where(mask[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -537,7 +563,8 @@ def _activation(x, gate, cfg: TransformerConfig):
 
 
 def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
-                      kv_row=None, kv_scale=None, kv_suffix=None):
+                      kv_row=None, kv_scale=None, kv_suffix=None,
+                      window=None):
     """Single-token GQA attention against a KV ring buffer, with NO repeat of
     the kv heads in memory (reference's decode kernels repeat in registers:
     ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``).
@@ -559,6 +586,8 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
     B, _, Nq, D = q.shape
     Nkv, T = ck.shape[1], ck.shape[2]
     rep = Nq // Nkv
+    sm = (cfg.attn_scale if cfg is not None and cfg.attn_scale is not None
+          else 1.0 / math.sqrt(D))
     # the Pallas decode kernel is opt-in (attention_impl="pallas"): measured
     # end-to-end on v5e it loses to the windowed-XLA path (24 pallas_calls
     # per token cost more than the length-aware reads save; the XLA path
@@ -568,6 +597,8 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
                   and q.dtype != jnp.float16  # Mosaic has no f16
                   and kv_scale is None        # kernel reads float caches
                   and kv_suffix is None       # kernel knows no suffix rows
+                  and window is None          # kernel has no band mask
+                  and (cfg.attn_scale is None)  # kernel fixes sm=1/sqrt(D)
                   and jax.default_backend() in ("tpu", "axon") and D >= 64)
     if use_pallas:
         from deepspeed_tpu.ops.decode_attention import decode_attention
@@ -590,7 +621,7 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
     else:
         scores = jnp.einsum("bgrd,bgtd->bgrt", qg, ck
                             ).astype(jnp.float32)
-    scores = scores / math.sqrt(D)
+    scores = scores * sm
     if cfg is not None and cfg.position_type == "alibi":
         rel = (jnp.arange(T) - index).astype(jnp.float32)        # k - q
         slopes = alibi_slopes(Nq).reshape(Nkv, rep)
@@ -609,24 +640,34 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
             prefix_len = index
         # buffer rows at >= prefix_len are stale; the current token's logit
         # comes from the fresh row (rel distance 0 — no alibi term)
-        valid = (jnp.arange(T) < prefix_len)[None, None, None, :]
+        keep = jnp.arange(T) < prefix_len
+        if window is not None:
+            # local band: buffer position t (absolute) visible iff
+            # index - t < window; <= 0 means global
+            w = jnp.asarray(window, jnp.int32)
+            keep = keep & ((w <= 0) | (index - jnp.arange(T) < w))
+        valid = keep[None, None, None, :]
         scores = jnp.where(valid, scores, -1e30)
         s_self = jnp.einsum("bgrd,bgtd->bgrt", qg,
                             k_row.astype(qg.dtype)).astype(jnp.float32)
-        s_self = s_self / math.sqrt(D)
+        s_self = s_self * sm
         if kv_suffix is not None:
             Ssuf = sk.shape[2]
             s_suf = jnp.einsum("bgrd,bgtd->bgrt", qg,
                                sk.astype(qg.dtype)).astype(jnp.float32)
-            s_suf = s_suf / math.sqrt(D)
+            s_suf = s_suf * sm
             if cfg is not None and cfg.position_type == "alibi":
                 rel_suf = (prefix_len + jnp.arange(Ssuf) - index
                            ).astype(jnp.float32)
                 slopes = alibi_slopes(Nq).reshape(Nkv, rep)
                 s_suf = s_suf + slopes[None, :, :, None] * \
                     rel_suf[None, None, None, :]
-            sval = (jnp.arange(Ssuf) < count)[None, None, None, :]
-            s_suf = jnp.where(sval, s_suf, -1e30)
+            skeep = jnp.arange(Ssuf) < count
+            if window is not None:
+                w = jnp.asarray(window, jnp.int32)
+                abs_pos = prefix_len + jnp.arange(Ssuf)
+                skeep = skeep & ((w <= 0) | (index - abs_pos < w))
+            s_suf = jnp.where(skeep[None, None, None, :], s_suf, -1e30)
             scores = jnp.concatenate([scores, s_suf, s_self], axis=-1)
             probs = jax.nn.softmax(scores, axis=-1)
             out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
@@ -641,8 +682,11 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
         out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
         out = out + probs[..., T:].astype(q.dtype) * v_row.astype(q.dtype)
         return out.reshape(B, 1, Nq, D)
-    valid = (jnp.arange(T) <= index)[None, None, None, :]
-    scores = jnp.where(valid, scores, -1e30)
+    keep = jnp.arange(T) <= index
+    if window is not None:
+        w = jnp.asarray(window, jnp.int32)
+        keep = keep & ((w <= 0) | (index - jnp.arange(T) < w))
+    scores = jnp.where(keep[None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = _decode_pv(probs, cv, kv_scale, q.dtype)
     return out.reshape(B, 1, Nq, D)
@@ -784,7 +828,7 @@ def fused_logical_axes(cfg: TransformerConfig) -> Params:
 
 def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                       positions=None, dropout_rng=None, deterministic=True,
-                      cache=None, return_kv: bool = False):
+                      cache=None, return_kv: bool = False, attn_window=None):
     """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
     cache=(ck, cv, index[, read_len]): decode mode — x is [B, 1, H]. The
@@ -866,17 +910,20 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
             attn_out = _decode_attention(q, ck[:, :, :read_len],
                                          cv[:, :, :read_len], index, cfg,
                                          kv_row=(k_row, v_row),
-                                         kv_scale=sc, kv_suffix=kv_suffix)
+                                         kv_scale=sc, kv_suffix=kv_suffix,
+                                         window=attn_window)
         else:
             attn_out = _decode_attention(q, ck, cv, index, cfg,
                                          kv_row=(k_row, v_row),
                                          kv_scale=kv_scale,
-                                         kv_suffix=kv_suffix)
+                                         kv_suffix=kv_suffix,
+                                         window=attn_window)
         new_kv = (k_row, v_row)
     else:
         if return_kv:
             new_kv = (k, v)
-        attn_out = attention(q, k, v, mask=mask, causal=cfg.causal, cfg=cfg)
+        attn_out = attention(q, k, v, mask=mask, causal=cfg.causal, cfg=cfg,
+                             window=attn_window)
     attn_out = attn_out.reshape(B, S, nh * hd) @ p["wo"].astype(h.dtype)
     if "bo" in p:
         attn_out = attn_out + p["bo"].astype(h.dtype)
@@ -1028,7 +1075,16 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
 
     layers = layer_override if layer_override is not None else params["layers"]
 
-    def body(carry, layer_p):
+    # per-layer local-attention windows ride the scan xs as a traced [L]
+    # operand (a static per-layer mask would force unrolling the stack)
+    if cfg.attn_windows and len(cfg.attn_windows) != cfg.num_layers:
+        raise ValueError(f"attn_windows has {len(cfg.attn_windows)} entries "
+                         f"for {cfg.num_layers} layers")
+    wins = (jnp.asarray(cfg.attn_windows, jnp.int32)
+            if cfg.attn_windows else None)
+
+    def body(carry, xs):
+        layer_p, w = xs if wins is not None else (xs, None)
         x_c, rng, aux_acc = carry
         if cfg.offload_params:
             layer_p = _fetch_layer(layer_p, cfg)
@@ -1039,7 +1095,7 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
         out = transformer_layer(x_c, layer_p, cfg, mask=attention_mask,
                                 positions=positions, dropout_rng=sub,
                                 deterministic=deterministic,
-                                return_kv=return_kv)
+                                return_kv=return_kv, attn_window=w)
         if return_kv:
             y, aux, kv = out
         else:
@@ -1066,21 +1122,23 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
         theta = jnp.asarray(pld_theta, jnp.float32)
 
         def pld_body(carry, xs):
-            layer_p, li = xs
+            lxs, li = xs
             # deeper layers drop more: keep = 1 - (i+1)/L * (1 - theta)
             keep_p = 1.0 - (li + 1).astype(jnp.float32) / L * (1.0 - theta)
             coin = jax.random.bernoulli(
                 jax.random.fold_in(dropout_rng, 7919 + li), keep_p)
             # real branch (collective-free): a dropped layer costs nothing
-            return lax.cond(coin, lambda c: body(c, layer_p),
+            return lax.cond(coin, lambda c: body(c, lxs),
                             lambda c: (c, None), carry)
 
         (x, _, aux_total), kv_stack = lax.scan(
             pld_body, (x, dropout_rng, aux_total),
-            (layers, jnp.arange(L)))
+            ((layers, wins) if wins is not None else layers,
+             jnp.arange(L)))
     elif cfg.scan_layers and not use_ltd:
         (x, _, aux_total), kv_stack = lax.scan(
-            body, (x, dropout_rng, aux_total), layers)
+            body, (x, dropout_rng, aux_total),
+            (layers, wins) if wins is not None else layers)
     else:
         n_layers = jax.tree.leaves(layers)[0].shape[0]
         carry = (x, dropout_rng, aux_total)
@@ -1092,6 +1150,8 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
                     random_ltd_layer)
                 x_c, rng, aux_acc = carry
                 rng, sub, sel_rng = jax.random.split(rng, 3)
+                win_i = (cfg.attn_windows[i] or None) if cfg.attn_windows \
+                    else None
 
                 def ltd_step(x_in, lp):
                     if cfg.offload_params:
@@ -1100,7 +1160,8 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
                     def layer_fn(xs, positions=None, mask=None):
                         return transformer_layer(
                             xs, lp, cfg, mask=mask, positions=positions,
-                            dropout_rng=sub, deterministic=deterministic)
+                            dropout_rng=sub, deterministic=deterministic,
+                            attn_window=win_i)
 
                     return random_ltd_layer(
                         x_in, layer_fn, cfg.random_ltd_keep, sel_rng,
@@ -1113,7 +1174,9 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
                 y, aux = ltd_step(x_c, layer_p)
                 carry, kv = (y, rng, aux_acc + aux), None
             else:
-                carry, kv = body(carry, layer_p)
+                carry, kv = body(
+                    carry, (layer_p, wins[i]) if wins is not None
+                    else layer_p)
             kvs.append(kv)
         x, aux_total = carry[0], carry[2]
         if return_kv:
@@ -1306,6 +1369,9 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
             lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
             tree)
 
+    wins = (jnp.asarray(cfg.attn_windows, jnp.int32)
+            if cfg.attn_windows else None)
+
     def body(x_c, i):
         layer_p = at_layer(params["layers"], i)
         ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
@@ -1322,7 +1388,8 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
             layer_p = _fetch_layer(layer_p, cfg)
         y, _, (k_row, v_row) = transformer_layer(
             x_c, layer_p, cfg, positions=positions, deterministic=True,
-            cache=c, return_kv=False)
+            cache=c, return_kv=False,
+            attn_window=None if wins is None else wins[i])
         return y, (k_row, v_row)
 
     x, (k_rows, v_rows) = lax.scan(body, x,
@@ -1430,7 +1497,11 @@ def decode_step_suffix(params: Params, token, cfg: TransformerConfig,
             layer_p = _fetch_layer(layer_p, cfg)
         x, _, (k_row, v_row) = transformer_layer(
             x, layer_p, cfg, positions=positions, deterministic=True,
-            cache=c, return_kv=False)
+            cache=c, return_kv=False,
+            # `or None`: a static 0 (global layer) must not disable the
+            # Pallas decode kernel / add a dead band mask
+            attn_window=((cfg.attn_windows[i] or None)
+                         if cfg.attn_windows else None))
         k_rows_l.append(k_row)
         v_rows_l.append(v_row)
     k_rows = jnp.stack(k_rows_l)

@@ -82,17 +82,20 @@ def _init_conv(key, kh, kw, cin, cout, dt, scale=None):
 
 
 def _res_block_params(key, cin, cout, temb, dt):
+    """temb=None -> no timestep-conditioning entries (the VAE's blocks)."""
     ks = jax.random.split(key, 4)
     p = {
         "norm1_scale": jnp.ones((cin,), dt), "norm1_bias": jnp.zeros((cin,), dt),
         "conv1": _init_conv(ks[0], 3, 3, cin, cout, dt),
         "conv1_b": jnp.zeros((cout,), dt),
-        "temb_w": (jax.random.normal(ks[1], (temb, cout)) / math.sqrt(temb)).astype(dt),
-        "temb_b": jnp.zeros((cout,), dt),
         "norm2_scale": jnp.ones((cout,), dt), "norm2_bias": jnp.zeros((cout,), dt),
         "conv2": _init_conv(ks[2], 3, 3, cout, cout, dt, scale=1e-4),
         "conv2_b": jnp.zeros((cout,), dt),
     }
+    if temb:
+        p["temb_w"] = (jax.random.normal(ks[1], (temb, cout))
+                       / math.sqrt(temb)).astype(dt)
+        p["temb_b"] = jnp.zeros((cout,), dt)
     if cin != cout:
         p["skip"] = _init_conv(ks[3], 1, 1, cin, cout, dt)
     return p

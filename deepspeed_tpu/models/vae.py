@@ -45,20 +45,9 @@ class VAEConfig:
 
 
 def _res_params(key, cin, cout, dt):
-    ks = jax.random.split(key, 3)
-    p = {
-        "norm1_scale": jnp.ones((cin,), dt),
-        "norm1_bias": jnp.zeros((cin,), dt),
-        "conv1": _init_conv(ks[0], 3, 3, cin, cout, dt),
-        "conv1_b": jnp.zeros((cout,), dt),
-        "norm2_scale": jnp.ones((cout,), dt),
-        "norm2_bias": jnp.zeros((cout,), dt),
-        "conv2": _init_conv(ks[1], 3, 3, cout, cout, dt, scale=1e-4),
-        "conv2_b": jnp.zeros((cout,), dt),
-    }
-    if cin != cout:
-        p["skip"] = _init_conv(ks[2], 1, 1, cin, cout, dt)
-    return p
+    # unet's res-block layout without the timestep-conditioning entries
+    from deepspeed_tpu.models.unet import _res_block_params
+    return _res_block_params(key, cin, cout, None, dt)
 
 
 def _res(x, p, cfg: VAEConfig):
