@@ -1,1 +1,3 @@
 from deepspeed_tpu.benchmarks.communication import run_comm_bench  # noqa: F401
+from deepspeed_tpu.benchmarks.embedding_grad import (  # noqa: F401
+    bench_embedding_grad)

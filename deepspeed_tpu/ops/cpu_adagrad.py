@@ -1,0 +1,180 @@
+"""Native host-side fused Adagrad (ctypes binding).
+
+Reference: ``deepspeed/ops/adagrad/cpu_adagrad.py:12`` (DeepSpeedCPUAdagrad)
+over ``csrc/adagrad/cpu_adagrad.cpp`` — the Adagrad member of the
+ZeRO-Offload host-optimizer family. Same build/binding pattern as
+``ops/cpu_adam.py``; CPUAdagrad exposes the CPUAdam step interface (step_num
+accepted and ignored — Adagrad has no bias correction) so the host
+swap tiers can treat the two interchangeably.
+"""
+
+import ctypes
+import hashlib
+import math
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "adagrad", "dstpu_cpu_adagrad.cpp")
+
+_LIB = None
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("DSTPU_CACHE_DIR") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "deepspeed_tpu")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_cache_dir(), f"libdstpu_cpu_adagrad-{digest}.so")
+    if os.path.exists(so):
+        return so
+    tmp = f"{so}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return so
+    except Exception as e:  # pragma: no cover - toolchain missing
+        logger.warning(f"cpu_adagrad build failed: {e}")
+        return None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    so = _build()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.dstpu_adagrad_step_bf16.argtypes = [
+        f32p, f32p, u16p, u16p, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+    lib.dstpu_adagrad_step_f32.argtypes = [
+        f32p, f32p, f32p, f32p, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+    _LIB = lib
+    return lib
+
+
+def cpu_adagrad_available() -> bool:
+    return _load() is not None
+
+
+def adagrad_step_flat(master: np.ndarray, accum: np.ndarray,
+                      grads: np.ndarray, *, lr: float, eps: float = 1e-10,
+                      weight_decay: float = 0.0, grad_scale: float = 1.0,
+                      out: Optional[np.ndarray] = None):
+    """One fused Adagrad step over caller-owned flat fp32 state buffers
+    (updated in place). grads: float32, or bf16 bits as uint16; ``out``
+    optionally receives the updated params (uint16 bf16 bits for bf16
+    grads, float32 otherwise)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native cpu_adagrad library unavailable")
+    g = np.ascontiguousarray(grads).reshape(-1)
+    n = g.size
+    for name, arr in (("master", master), ("accum", accum)):
+        if arr.size != n or arr.dtype != np.float32 \
+                or not arr.flags.c_contiguous:
+            raise ValueError(
+                f"{name}: need contiguous float32[{n}], got "
+                f"{arr.dtype}[{arr.size}]"
+                f"{'' if arr.flags.c_contiguous else ' (non-contiguous)'}")
+    if out is not None:
+        want = np.uint16 if g.dtype == np.uint16 else np.float32
+        if out.size != n or out.dtype != want \
+                or not out.flags.c_contiguous:
+            raise ValueError(f"out: need contiguous {np.dtype(want).name}"
+                             f"[{n}], got {out.dtype}[{out.size}]")
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+
+    def p(arr, ct):
+        return arr.ctypes.data_as(ctypes.POINTER(ct))
+
+    if g.dtype == np.uint16:
+        lib.dstpu_adagrad_step_bf16(
+            p(master, ctypes.c_float), p(accum, ctypes.c_float),
+            p(g, ctypes.c_uint16),
+            p(out, ctypes.c_uint16) if out is not None
+            else ctypes.cast(None, u16p),
+            n, float(lr), eps, weight_decay, float(grad_scale))
+    else:
+        g = g.astype(np.float32, copy=False)
+        lib.dstpu_adagrad_step_f32(
+            p(master, ctypes.c_float), p(accum, ctypes.c_float),
+            p(g, ctypes.c_float),
+            p(out, ctypes.c_float) if out is not None
+            else ctypes.cast(None, f32p),
+            n, float(lr), eps, weight_decay, float(grad_scale))
+
+
+class CPUAdagrad:
+    """Fused host Adagrad over flat fp32 state buffers (master, accum).
+    CPUAdam-compatible step interface (step_num ignored: no bias
+    correction), so the host swap tiers can substitute it for CPUAdam."""
+
+    def __init__(self, n: int, lr=1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, **_ignored):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native cpu_adagrad library unavailable "
+                               "(g++ build failed)")
+        self._lib = lib
+        self.n = int(n)
+        self.lr = lr
+        self.eps = eps
+        self.wd = weight_decay
+        self.master = np.zeros(self.n, np.float32)
+        self.accum = np.zeros(self.n, np.float32)
+
+    def load_master(self, params: np.ndarray):
+        np.copyto(self.master, np.asarray(params, np.float32).reshape(-1))
+
+    def sq_norm(self, grads: np.ndarray) -> float:
+        # reuse the Adam lib's norm kernels (identical math, built already)
+        from deepspeed_tpu.ops.cpu_adam import CPUAdam  # noqa: F401
+        from deepspeed_tpu.ops import cpu_adam as _ca
+        lib = _ca._load()
+        g = np.ascontiguousarray(grads).reshape(-1)
+        if g.dtype == np.uint16:
+            return float(lib.dstpu_sq_norm_bf16(
+                g.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), g.size))
+        g = g.astype(np.float32, copy=False)
+        return float(lib.dstpu_sq_norm_f32(
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), g.size))
+
+    def step(self, grads: np.ndarray, step_num: int,
+             lr: Optional[float] = None, grad_scale: float = 1.0,
+             out: Optional[np.ndarray] = None):
+        g = np.ascontiguousarray(grads).reshape(-1)
+        if out is None:
+            out = np.empty(self.n,
+                           np.uint16 if g.dtype == np.uint16 else np.float32)
+        adagrad_step_flat(self.master, self.accum, g,
+                          lr=float(self.lr if lr is None else lr),
+                          eps=self.eps, weight_decay=self.wd,
+                          grad_scale=grad_scale, out=out)
+        return out
+
+    def clip_coef(self, sq_total: float, clip: float,
+                  grad_scale: float = 1.0) -> float:
+        gnorm = math.sqrt(sq_total) * grad_scale
+        if clip and clip > 0 and gnorm > clip:
+            return clip / (gnorm + 1e-6)
+        return 1.0

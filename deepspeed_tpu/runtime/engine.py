@@ -156,6 +156,16 @@ class Engine:
                     f"micro={config.train_micro_batch_size_per_gpu} "
                     f"gas={config.gradient_accumulation_steps} "
                     f"dp={self.plan.dp_world_size}")
+        if config.sparse_gradients:
+            # reference: engine.py:2302-2369 sparse_allreduce_list. N/A by
+            # design here — see sparse_gradients_enabled() and
+            # benchmarks/embedding_grad.py for the byte math
+            logger.warning(
+                "sparse_gradients=true is a no-op on TPU: embedding "
+                "cotangents are fused scatter-adds reduce-scattered over "
+                "ICI with the other grads (V*H/dp bytes/chip); a "
+                "(values, indices) wire would need dynamic shapes and "
+                "moves more bytes at realistic vocab/batch sizes")
 
         # --- pipeline wrapping (reference: PipelineEngine construction)
         self._pp_mode = self.plan.pipe > 1
@@ -229,16 +239,22 @@ class Engine:
             has_pinned = "pinned_host" in kinds
             on_cpu = get_accelerator().platform == "cpu"
             if off_opt_cfg.use_cpu_adam:
-                if _opt_name(config) not in _ADAM_FAMILY or \
+                if (_opt_name(config) not in _ADAM_FAMILY
+                        and _opt_name(config) != "adagrad") or \
                         optimizer is not None:
                     # same contract as the nvme swapper: the fused host
-                    # kernel is Adam-family, config-built only
+                    # kernels cover the Adam family + Adagrad (reference:
+                    # csrc/{adam,adagrad}/cpu_*.cpp), config-built only
                     raise ValueError(
                         "offload_optimizer.use_cpu_adam requires a config-"
-                        f"built Adam-family optimizer (got "
+                        f"built Adam-family or Adagrad optimizer (got "
                         f"'{_opt_name(config)}'"
                         f"{', client-supplied' if optimizer else ''})")
-                from deepspeed_tpu.ops.cpu_adam import cpu_adam_available
+                if _opt_name(config) == "adagrad":
+                    from deepspeed_tpu.ops.cpu_adagrad import (
+                        cpu_adagrad_available as cpu_adam_available)
+                else:
+                    from deepspeed_tpu.ops.cpu_adam import cpu_adam_available
                 if cpu_adam_available():
                     # the optimizer runs ON the host (native fused CPU-Adam)
                     # over host-resident fp32 state: 4 bytes/param/step on
@@ -476,9 +492,9 @@ class Engine:
                          "layer_reduction")):
             from deepspeed_tpu.compression import init_compression
             self._compression = init_compression(comp_cfg)
-            if self._onebit_comm:
-                raise ValueError("compression_training with the 1-bit "
-                                 "compressed-comm path is not supported")
+            # composes with the 1-bit compressed-comm path: the shard_map
+            # step applies the same traced param transform inside its
+            # per-device loss (see _get_onebit_step)
             # activation quantization / layer reduction reshape the MODEL,
             # not the params (reference: QuantAct wraps forward;
             # student_initialization builds a shallower net)
@@ -525,9 +541,19 @@ class Engine:
                               TransformerConfig):
                 raise ValueError("quantize_training (MoQ) requires a "
                                  "transformer ModelSpec (stacked layers)")
-            if self._pp_mode or _infinity_mode(config):
-                raise ValueError("quantize_training (MoQ) with pipeline or "
-                                 "layer-streamed offload is not supported")
+            if self._pp_mode:
+                raise ValueError("quantize_training (MoQ) with pipeline "
+                                 "parallelism is not supported")
+            if _infinity_mode(config) and \
+                    (config.quantize_training.get("eigenvalue") or {}) \
+                    .get("enabled"):
+                # the blockwise-Rayleigh curvature probe needs the resident
+                # stacked-layer tree; streamed layers fall back to the
+                # uniform quantize_period schedule
+                logger.warning(
+                    "MoQ eigenvalue scheduling requires resident params; "
+                    "layer-streamed offload uses the uniform "
+                    "quantize_period for every layer")
             if self._onebit_comm:
                 raise ValueError("quantize_training (MoQ) with the 1-bit "
                                  "compressed-comm path is not supported "
@@ -719,13 +745,18 @@ class Engine:
             kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
             kw = dict(
                 betas=tuple(p.get("betas", (0.9, 0.999))),
-                eps=p.get("eps", 1e-8),
+                eps=p.get("eps", 1e-10 if name == "adagrad" else 1e-8),
                 weight_decay=p.get("weight_decay",
                                    0.01 if name == "adamw" else 0.0),
                 adam_w_mode=(name == "adamw" or p.get("adam_w_mode", False)),
                 bias_correction=p.get("bias_correction", True),
                 param_shardings=self.param_shardings,
                 compute_dtype=self.compute_dtype)
+            if name == "adagrad":
+                # host Adagrad tier rides the native swapper (the compute_on
+                # flavor's tree update is Adam-only for now)
+                return HostAdamSwapper(param_shapes, mesh=self.mesh,
+                                       optim="adagrad", **kw)
             if (get_accelerator().platform != "cpu"
                     and "pinned_host" in kinds):
                 # TPU-native flavor: Adam runs on the TPU host INSIDE the
@@ -791,6 +822,7 @@ class Engine:
             fp16=(dataclasses.asdict(cfg.fp16) if cfg.fp16.enabled else None),
             compression=self._compression,
             use_cpu_adam=off_o.use_cpu_adam,
+            moq=self._moq is not None,
             # live cache only when the user set the knob: the reference
             # default (1e9) silently pinning ~2GB of bits in HBM could OOM
             # workloads sized without it
@@ -1085,9 +1117,11 @@ class Engine:
         fp16 = self._fp16
         fp16_cfg = cfg.fp16
         clip = cfg.gradient_clipping
+        compression = self._compression
 
         def per_device(state, batch, rng):
             params = state["params"]
+            step = state["step"]
             opt_local = {
                 k: (jax.tree.map(lambda a: jnp.squeeze(a, 0), v)
                     if k in rv and v is not None else v)
@@ -1098,6 +1132,12 @@ class Engine:
 
             def micro(p, mb, r):
                 def loss_fn(q):
+                    if compression is not None:
+                        # same traced param transform the GSPMD step
+                        # applies (micro_grads above); masks/quant see the
+                        # per-device replicated params, schedule driven by
+                        # the traced step
+                        q = compression.apply(q, step)
                     loss = model.loss_fn(q, mb, r, False)
                     return loss * scale.astype(loss.dtype) if fp16 else loss
                 return jax.value_and_grad(loss_fn)(p)
@@ -1519,6 +1559,17 @@ class Engine:
         if self._last_grad_norm is None:
             return None
         return float(np.asarray(jax.device_get(self._last_grad_norm)))
+
+    def sparse_gradients_enabled(self) -> bool:
+        """API parity with the reference's sparse-embedding-grad switch
+        (``engine.py:2302-2369`` sparse_allreduce_list). Always False on
+        TPU — BY DESIGN, not omission: under jit+GSPMD the embedding
+        cotangent is a fused scatter-add reduce-scattered over ICI like
+        every other gradient (V*H/dp bytes/chip), a (values, indices)
+        wire would need dynamic shapes, and the static-shape alternative
+        moves more bytes at every realistic (vocab, batch). Evidence:
+        ``benchmarks/embedding_grad.py``."""
+        return False
 
     def train_micro_batch_size_per_gpu(self) -> int:
         return self.config.train_micro_batch_size_per_gpu

@@ -197,10 +197,14 @@ class InfinityExecutor:
                  backend: str = "nvme", param_cache_bytes: int = 0,
                  gas: int = 1, mesh=None, fp16: Optional[Dict[str, Any]] = None,
                  compression=None, use_cpu_adam: bool = False,
-                 max_live_params: int = 0):
+                 max_live_params: int = 0, moq: bool = False):
         if model_cfg.num_experts > 1:
             raise ValueError("offload_param.device=nvme supports dense "
                              "transformers (MoE experts not yet streamed)")
+        if model_cfg.attn_windows:
+            raise ValueError("layer-streamed offload does not thread "
+                             "per-layer attn_windows yet (one jit serves "
+                             "every layer)")
         self.cfg = dataclasses.replace(model_cfg, scan_layers=False,
                                        offload_params=False)
         self.b1, self.b2 = betas
@@ -232,6 +236,12 @@ class InfinityExecutor:
         # (path-compatible with the monolithic engine path: the per-layer
         # tree is wrapped under "layers/", masks computed per layer)
         self.compression = compression
+        # MoQ composes with layer streaming: each per-layer jit takes the
+        # layer's scheduled bit-width as a traced scalar (the engine's
+        # [L] ``_moq_bits`` side-channel, indexed per layer), so schedule
+        # updates never recompile and the quantize-dequantize runs inside
+        # the same program that unflattens the streamed chunk
+        self.moq = bool(moq)
 
         L = self.cfg.num_layers
         # per-layer leaf template from a single-layer config (shapes only)
@@ -417,10 +427,11 @@ class InfinityExecutor:
             return jax.lax.with_sharding_constraint(t, spec) if multi else t
 
         compression = self.compression
+        moq_on = self.moq
 
         tp_specs = self._tp_leaf_specs
 
-        def leaves_from_flat(flat, step=None):
+        def leaves_from_flat(flat, step=None, qbits=None):
             """Gathered flat vector -> layer param pytree (compute dtype).
             The ONE place that slices/reshapes/TP-constrains leaves — used
             by both the forward unflatten and the backward fp32 view."""
@@ -443,18 +454,27 @@ class InfinityExecutor:
                 tree = compression.apply(
                     {"layers": tree},
                     step if step is not None else 0)["layers"]
+            if moq_on and qbits is not None:
+                # MoQ fake-quant at this layer's scheduled bit-width;
+                # weight leaves only (matches MoQ.apply's stacked ndim>=3
+                # filter — per-layer norm scales/biases are 1-d)
+                from deepspeed_tpu.runtime.quantize import (
+                    _ste_quant_traced_bits)
+                tree = {k: (_ste_quant_traced_bits(v, qbits)
+                            if getattr(v, "ndim", 0) >= 2 else v)
+                        for k, v in tree.items()}
             return tree
 
-        def unflatten(flat_bits, step=None):
+        def unflatten(flat_bits, step=None, qbits=None):
             """uint16 bf16-bits (C,) -> layer param pytree (compute dtype)."""
             flat = jax.lax.bitcast_convert_type(flat_bits, jnp.bfloat16)
             # one explicit all-gather of the bf16 chunk (the ZeRO-3 fetch);
             # without it every dynamic_slice below would gather separately
             flat = wsc(flat, P())
-            return leaves_from_flat(flat, step)
+            return leaves_from_flat(flat, step, qbits)
 
-        def layer_fwd(flat_bits, x, mask, positions, step):
-            p = unflatten(flat_bits, step)
+        def layer_fwd(flat_bits, x, mask, positions, step, qbits):
+            p = unflatten(flat_bits, step, qbits)
             y, _aux = transformer_layer(x, p, cfg, mask=mask,
                                         positions=positions,
                                         deterministic=True)
@@ -462,13 +482,13 @@ class InfinityExecutor:
 
         self._layer_fwd = jax.jit(layer_fwd)
 
-        def layer_bwd(flat_bits, x, dy, mask, positions, step):
+        def layer_bwd(flat_bits, x, dy, mask, positions, step, qbits):
             """Recompute-VJP for one layer: returns (flat fp32 grads, dx,
             grad sq-norm). The fwd recompute inside vjp IS the remat."""
             def f(bits_f32, x):
                 # differentiate w.r.t. a fp32 VIEW of the params so the
                 # cotangent comes back fp32 (bitcast isn't differentiable)
-                p = leaves_from_flat(bits_f32, step)
+                p = leaves_from_flat(bits_f32, step, qbits)
                 y, _aux = transformer_layer(x, p, cfg, mask=mask,
                                             positions=positions,
                                             deterministic=True)
@@ -834,6 +854,13 @@ class InfinityExecutor:
         with self.mesh:
             return self._train_batch(batch)
 
+    def _qbits(self, batch, i: int):
+        """Layer i's traced MoQ bit-width (engine side-channel), or a dummy
+        scalar when MoQ is off (the jit operand is dead code then)."""
+        if self.moq and isinstance(batch, dict) and "_moq_bits" in batch:
+            return jnp.float32(np.asarray(batch["_moq_bits"])[i])
+        return jnp.float32(32.0)
+
     def _train_batch(self, batch) -> Dict[str, Any]:
         L = self.cfg.num_layers
         ids_all, labels_all, mask_all = self._batch_arrays(batch)
@@ -884,7 +911,8 @@ class InfinityExecutor:
             for i in range(L):
                 bits = self._resolve_param(fut, i)
                 fut = self._fetch_param_async(i + 1) if i + 1 < L else None
-                x = self._layer_fwd(bits, x, mask, positions, step_t)
+                x = self._layer_fwd(bits, x, mask, positions, step_t,
+                                    self._qbits(batch, i))
                 acts.append(x)
 
             loss, dnl_top, dx = self._top_fwd_bwd(self.nl_params, acts[L],
@@ -898,7 +926,8 @@ class InfinityExecutor:
                 bits = self._resolve_param(fut, i)
                 fut = self._fetch_param_async(i - 1) if i > 0 else None
                 dp, dx, sq = self._layer_bwd(bits, acts[i], dx, mask,
-                                             positions, step_t)
+                                             positions, step_t,
+                                             self._qbits(batch, i))
                 acts[i + 1] = None  # free the activation as we pass it
                 if self._pinned:
                     if grad_stage[i] is not None:  # accumulate on device
@@ -1144,7 +1173,8 @@ class InfinityExecutor:
                 bits = self._resolve_param(fut, i)
                 fut = self._fetch_param_async(i + 1) if i + 1 < L else None
                 x = self._layer_fwd(bits, x, mask, None,
-                                    jnp.int32(self.applied_steps))
+                                    jnp.int32(self.applied_steps),
+                                    self._qbits(batch, i))
             return self._top_loss(self.nl_params, x, labels)
 
     # ------------------------------------------------------------------

@@ -562,11 +562,12 @@ class HostAdamSwapper:
                  betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
                  bias_correction: bool = True, param_shardings=None,
-                 compute_dtype=jnp.bfloat16, **_ignored):
-        from deepspeed_tpu.ops.cpu_adam import CPUAdam
+                 compute_dtype=jnp.bfloat16, optim: str = "adam",
+                 **_ignored):
         self.mesh = mesh
         self.compute_dtype = compute_dtype
         self.lr = lr
+        self.optim = optim
         leaves, self._treedef = jax.tree.flatten(param_template)
         self._shapes = [l.shape for l in leaves]
         self._sizes = [int(np.prod(s)) for s in self._shapes]
@@ -575,9 +576,18 @@ class HostAdamSwapper:
         self._param_sh = (jax.tree.flatten(param_shardings)[0]
                           if param_shardings is not None
                           else [None] * len(leaves))
-        self.cpu = CPUAdam(self.n, lr=lr, betas=betas, eps=eps,
-                           weight_decay=weight_decay, adamw_mode=adam_w_mode,
-                           bias_correction=bias_correction)
+        if optim == "adagrad":
+            # host Adagrad tier (reference: DeepSpeedCPUAdagrad over
+            # csrc/adagrad/cpu_adagrad.cpp) — CPUAdam-compatible interface
+            from deepspeed_tpu.ops.cpu_adagrad import CPUAdagrad
+            self.cpu = CPUAdagrad(self.n, lr=lr, eps=eps,
+                                  weight_decay=weight_decay)
+        else:
+            from deepspeed_tpu.ops.cpu_adam import CPUAdam
+            self.cpu = CPUAdam(self.n, lr=lr, betas=betas, eps=eps,
+                               weight_decay=weight_decay,
+                               adamw_mode=adam_w_mode,
+                               bias_correction=bias_correction)
         self._bf16 = compute_dtype == jnp.bfloat16
         self._f16 = compute_dtype == jnp.float16
         wire_np = (np.uint16 if self._bf16
@@ -598,8 +608,8 @@ class HostAdamSwapper:
             self._cast = jax.jit(lambda g: g.astype(jnp.float16))
         else:
             self._cast = jax.jit(lambda g: g.astype(jnp.float32))
-        logger.info(f"host CPU-Adam: {self.n / 1e6:.1f}M params, fp32 state "
-                    "host-resident, wire dtype "
+        logger.info(f"host CPU-{optim.capitalize()}: {self.n / 1e6:.1f}M "
+                    "params, fp32 state host-resident, wire dtype "
                     f"{'bf16' if self._bf16 else 'f16' if self._f16 else 'f32'}")
 
     def initialize(self, params):
@@ -648,13 +658,19 @@ class HostAdamSwapper:
         return jax.tree.unflatten(self._treedef, out_leaves), gnorm, False
 
     def export_state(self) -> Dict[str, np.ndarray]:
+        if self.optim == "adagrad":
+            return {"master": self.cpu.master.copy(),
+                    "accum": self.cpu.accum.copy()}
         return {"master": self.cpu.master.copy(), "m": self.cpu.m.copy(),
                 "v": self.cpu.v.copy()}
 
     def import_state(self, state: Dict[str, np.ndarray]):
         np.copyto(self.cpu.master, state["master"])
-        np.copyto(self.cpu.m, state["m"])
-        np.copyto(self.cpu.v, state["v"])
+        if self.optim == "adagrad":
+            np.copyto(self.cpu.accum, state["accum"])
+        else:
+            np.copyto(self.cpu.m, state["m"])
+            np.copyto(self.cpu.v, state["v"])
 
     def close(self):
         pass

@@ -52,3 +52,34 @@ def test_single_device_mesh_runs():
     rows = run_comm_bench(mesh1, sizes=[1 << 10], ops=("psum", "all_gather"),
                           iters=2)
     assert all("error" not in r for r in rows), rows
+
+
+def test_embedding_grad_stance_bench():
+    """Sparse-embedding-grad N/A-by-design evidence (reference:
+    engine.py:2302-2369 sparse allreduce): the microbench runs, the dense
+    reduce-scatter shard beats the static-shape sparse wire at realistic
+    shapes, and the engine reports the stance."""
+    from deepspeed_tpu.benchmarks import bench_embedding_grad
+    out = bench_embedding_grad(vocab=512, hidden=32, batch=2, seq=16,
+                               layers=1, steps=2)
+    assert out["step_full_s"] > 0 and out["step_frozen_embed_s"] > 0
+    assert np.isfinite(out["embed_grad_cost_pct"])
+    # the byte math at a REALISTIC shape: gpt2-vocab, 4k tokens, dp=8 —
+    # dense moves ~6.4MB/chip, the sparse wire ~28MB/chip
+    dense = 50257 * 256 * 4 / 8
+    sparse = 8 * 512 * (256 * 4 + 4) * 7
+    assert dense < sparse
+
+
+def test_engine_sparse_gradients_stance():
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, make_model
+    model = make_model(TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        max_seq_len=32, dtype=jnp.float32, attention_impl="xla"))
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8, "sparse_gradients": True,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": False}, "steps_per_print": 1000})
+    assert engine.sparse_gradients_enabled() is False

@@ -441,3 +441,51 @@ class TestInfinityHostAdam:
         assert math.isclose(ref, got, rel_tol=1e-5), (ref, got)
         engine._infinity_exec.close()
         e2._infinity_exec.close()
+
+
+class TestInfinityMoQ:
+    """MoQ composes with the layer-streamed executor (VERDICT r4 item 8):
+    the per-layer jits fake-quant each streamed layer at its scheduled
+    bit-width via the engine's traced ``_moq_bits`` side-channel."""
+
+    def _cfg(self, tmp, start_bits=6):
+        cfg = _cfg_dict(tmp)
+        cfg["quantize_training"] = {
+            "enabled": True,
+            "quantize_bits": {"start_bits": start_bits, "target_bits": 4},
+            "quantize_schedule": {"quantize_period": 2}}
+        return cfg
+
+    def test_streamed_moq_loss_parity(self, tmp_path):
+        """Streamed forward at step 0 == monolithic forward over the SAME
+        chunk-store weights with MoQ.apply at bits(0) — and the quantized
+        loss measurably differs from the unquantized one."""
+        engine, *_ = deepspeed_tpu.initialize(model=_model(),
+                                              config=self._cfg(tmp_path))
+        ex = engine._infinity_exec
+        assert ex.moq
+        params = _gather_stacked(ex)
+        batch = _batch()
+        from deepspeed_tpu.models.transformer import lm_loss
+        moq = engine._moq
+        ref_cfg = ex.cfg.__class__(**{**ex.cfg.__dict__, "scan_layers": True})
+        ids = {"input_ids": jnp.asarray(batch["input_ids"])}
+        qparams = moq.apply(params, jnp.asarray(moq.bits(0)))
+        ref_loss = float(lm_loss(qparams, ids, ref_cfg, deterministic=True))
+        noq_loss = float(lm_loss(params, ids, ref_cfg, deterministic=True))
+        got = float(engine.train_batch(batch)["loss"])
+        assert abs(got - ref_loss) < 3e-2, (got, ref_loss)
+        # 6-bit fake-quant must actually bite (else the test proves nothing)
+        assert abs(ref_loss - noq_loss) > 5 * abs(got - ref_loss) or \
+            abs(ref_loss - noq_loss) > 1e-3, (ref_loss, noq_loss)
+        ex.close()
+
+    def test_streamed_moq_trains(self, tmp_path):
+        engine, *_ = deepspeed_tpu.initialize(model=_model(),
+                                              config=self._cfg(tmp_path))
+        losses = [float(engine.train_batch(_batch(seed=s))["loss"])
+                  for s in range(6)]
+        assert np.isfinite(losses).all()
+        # schedule advanced: bits dropped toward the target
+        assert engine._moq.bits(engine.global_steps).max() < 6
+        engine._infinity_exec.close()

@@ -221,3 +221,90 @@ class TestHostCPUAdam:
         e2.load_checkpoint(str(tmp_path), tag="ha")
         resumed = [float(e2.train_batch(batch)["loss"]) for _ in range(2)]
         np.testing.assert_allclose(cont, resumed, rtol=2e-4, atol=1e-5)
+
+
+class TestHostCPUAdagrad:
+    """Host Adagrad tier (reference: DeepSpeedCPUAdagrad over
+    csrc/adagrad/cpu_adagrad.cpp): offload_optimizer.use_cpu_adam with an
+    adagrad optimizer routes to the native host Adagrad."""
+
+    def _cfg(self):
+        return {"train_batch_size": 16,
+                "optimizer": {"type": "adagrad",
+                              "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False}, "steps_per_print": 1000,
+                "zero_optimization": {"stage": 1,
+                                      "offload_optimizer": {
+                                          "device": "cpu",
+                                          "use_cpu_adam": True}}}
+
+    def test_kernel_parity_vs_traced_adagrad(self):
+        """The native flat kernel == the traced ops.optimizers adagrad
+        math on random buffers (both dtypes of the grad wire)."""
+        from deepspeed_tpu.ops.cpu_adagrad import (adagrad_step_flat,
+                                                   cpu_adagrad_available)
+        if not cpu_adagrad_available():
+            pytest.skip("native cpu_adagrad unavailable")
+        import ml_dtypes
+        rng = np.random.default_rng(0)
+        n = 4097
+        master = rng.normal(size=n).astype(np.float32)
+        accum = np.abs(rng.normal(size=n)).astype(np.float32)
+        g32 = rng.normal(size=n).astype(np.float32)
+        ref_g = g32 + 0.01 * master
+        ref_accum = accum + ref_g * ref_g
+        ref_master = master - 1e-2 * ref_g / (np.sqrt(ref_accum) + 1e-10)
+        m2, a2 = master.copy(), accum.copy()
+        out = np.empty(n, np.float32)
+        adagrad_step_flat(m2, a2, g32, lr=1e-2, eps=1e-10,
+                          weight_decay=0.01, out=out)
+        np.testing.assert_allclose(m2, ref_master, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(a2, ref_accum, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(out, ref_master, rtol=1e-6, atol=1e-7)
+        # bf16-bits wire
+        gb = g32.astype(ml_dtypes.bfloat16)
+        m3, a3 = master.copy(), accum.copy()
+        out16 = np.empty(n, np.uint16)
+        adagrad_step_flat(m3, a3, gb.view(np.uint16), lr=1e-2, eps=1e-10,
+                          weight_decay=0.01, out=out16)
+        g16 = gb.astype(np.float32) + 0.01 * master
+        acc16 = accum + g16 * g16
+        ref16 = master - 1e-2 * g16 / (np.sqrt(acc16) + 1e-10)
+        np.testing.assert_allclose(m3, ref16, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            out16.view(ml_dtypes.bfloat16).astype(np.float32),
+            ref16, rtol=1e-2, atol=1e-3)
+
+    def test_matches_baseline_engine(self):
+        from deepspeed_tpu.ops.cpu_adagrad import cpu_adagrad_available
+        if not cpu_adagrad_available():
+            pytest.skip("native cpu_adagrad unavailable")
+        base = {"train_batch_size": 16,
+                "optimizer": {"type": "adagrad", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False}, "steps_per_print": 1000}
+        e1, *_ = deepspeed_tpu.initialize(model=tiny_model(), config=base)
+        e2, *_ = deepspeed_tpu.initialize(model=tiny_model(),
+                                          config=self._cfg())
+        assert e2._swap_storage == "cpu_adam"
+        assert e2._swapper is not None and e2._swapper.optim == "adagrad"
+        batch = make_batch(16, 32, vocab=64)
+        l1 = [float(e1.train_batch(batch)["loss"]) for _ in range(5)]
+        l2 = [float(e2.train_batch(batch)["loss"]) for _ in range(5)]
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=1e-5)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        from deepspeed_tpu.ops.cpu_adagrad import cpu_adagrad_available
+        if not cpu_adagrad_available():
+            pytest.skip("native cpu_adagrad unavailable")
+        engine, *_ = deepspeed_tpu.initialize(model=tiny_model(),
+                                              config=self._cfg())
+        batch = make_batch(16, 32, vocab=64)
+        for _ in range(3):
+            engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path), tag="hag")
+        cont = [float(engine.train_batch(batch)["loss"]) for _ in range(2)]
+        e2, *_ = deepspeed_tpu.initialize(model=tiny_model(),
+                                          config=self._cfg())
+        e2.load_checkpoint(str(tmp_path), tag="hag")
+        resumed = [float(e2.train_batch(batch)["loss"]) for _ in range(2)]
+        np.testing.assert_allclose(cont, resumed, rtol=2e-4, atol=1e-5)

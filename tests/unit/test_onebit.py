@@ -227,3 +227,38 @@ class TestOnebitFp16Clip:
         shards = [np.asarray(s.data) for s in leaf.addressable_shards]
         for s in shards[1:]:
             np.testing.assert_array_equal(shards[0], s)
+
+
+class TestOnebitCompression:
+    """compression_training composes with the 1-bit compressed-comm path
+    (VERDICT r4 item 8): the shard_map step applies the same traced param
+    transform as the GSPMD step applies in micro_grads."""
+
+    COMP = {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {
+            "q8": {"params": {"target_bits": 8}, "modules": ["*"]}}}}
+
+    def test_warmup_matches_dense_with_compression(self, devices8):
+        """Warmup phase == dense Adam, both under the same weight-quant
+        transform — loss curves must match the GSPMD engine exactly."""
+        b = make_batch(16, 32, vocab=64, seed=4)
+        e1 = _engine("adam", freeze_kw={"weight_decay": 0.0},
+                     compression_training=self.COMP)
+        l1 = [float(e1.train_batch(b)["loss"]) for _ in range(4)]
+        e2 = _engine("onebitadam", freeze_kw={"freeze_step": 100},
+                     compression_training=self.COMP)
+        assert e2._onebit_comm and e2._compression is not None
+        l2 = [float(e2.train_batch(b)["loss"]) for _ in range(4)]
+        np.testing.assert_allclose(l1, l2, rtol=2e-5, atol=1e-6)
+        # the transform is live: quantized forward differs from a no-comp run
+        e3 = _engine("onebitadam", freeze_kw={"freeze_step": 100})
+        l3 = float(e3.train_batch(b)["loss"])
+        assert abs(l3 - l2[0]) > 1e-6
+
+    def test_compressed_stage_with_compression_converges(self, devices8):
+        b = make_batch(16, 32, vocab=64, seed=5)
+        e = _engine("onebitadam", freeze_kw={"lr": 2e-3, "freeze_step": 3},
+                    compression_training=self.COMP)
+        losses = [float(e.train_batch(b)["loss"]) for _ in range(8)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
