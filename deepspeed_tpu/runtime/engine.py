@@ -297,7 +297,6 @@ class Engine:
         # live in pinned host DRAM; the forward scan streams one layer at a
         # time into HBM (models/transformer.py body device_put).
         off_p_cfg = config.zero_optimization.offload_param
-        self._offload_param = off_p_cfg.enabled
         # ZeRO-Infinity layer-streamed executor: owns BOTH the param chunks
         # and the optimizer chunks (reference: partitioned_param_swapper.py:35
         # + stage3.py:1735 sub-group loop). Two tiers:
@@ -307,13 +306,29 @@ class Engine:
         self._infinity_exec = None
         self._infinity_backend = None
         if self._infinity:
-            self._offload_param = False
-            if off_p_cfg.device == "nvme":
+            if off_p_cfg.device == "nvme" or off_opt_cfg.device == "nvme":
+                # the LayerStore is one tier for param AND opt chunks: a
+                # mixed cpu/nvme request collapses to nvme as the system of
+                # record — the executor's full host bf16-bits param cache
+                # (offload_param.max_in_cpu, default all layers) gives the
+                # cpu-tier refetch speed on top
                 self._infinity_backend = "nvme"
+                if off_p_cfg.device == "cpu":
+                    logger.info(
+                        "offload_param.device=cpu + offload_optimizer."
+                        "device=nvme: chunks persist on nvme; the host "
+                        "param cache keeps params cpu-resident for refetch")
             elif get_accelerator().platform == "cpu":
                 self._infinity_backend = "host"  # CPU tests: plain buffers
             else:
                 self._infinity_backend = "pinned"
+            if not off_opt_cfg.enabled:
+                # reference ZeRO-3 can offload params while keeping the
+                # optimizer in HBM; the layer-streamed executor owns both —
+                # opt chunks ride the same tier as the params
+                logger.info("offload_param without offload_optimizer: "
+                            "optimizer chunks ride the param tier (the "
+                            "executor streams both per layer)")
             from deepspeed_tpu.models.transformer import TransformerConfig
             if not isinstance(getattr(model, "config", None), TransformerConfig):
                 raise ValueError("offload_param requires a transformer "
@@ -322,11 +337,12 @@ class Engine:
                 if not (off_p_cfg.nvme_path or off_opt_cfg.nvme_path):
                     raise ValueError("offload_param.device=nvme requires "
                                      "nvme_path")
-                if off_opt_cfg.enabled and off_opt_cfg.device != "nvme":
-                    raise ValueError(
-                        "offload_param.device=nvme pairs with "
-                        "offload_optimizer.device=nvme (the executor streams "
-                        "param AND optimizer chunks per layer)")
+                if off_opt_cfg.enabled and off_opt_cfg.device == "cpu" \
+                        and off_p_cfg.device == "nvme":
+                    logger.info(
+                        "offload_param.device=nvme + offload_optimizer."
+                        "device=cpu: opt chunks persist on nvme with the "
+                        "params (one LayerStore tier)")
             if self._infinity_multi:
                 # offload composed with data/fsdp/tensor parallelism
                 # (reference: ZeRO-3 + NVMe under a Megatron TP mpu,
@@ -368,55 +384,11 @@ class Engine:
                                  "config-built optimizer, not a client one")
             # the executor replaces the swapper AND the jitted train step
             self._nvme_opt = False
-        if self._offload_param:
-            if not self._nvme_opt:
-                # in-graph host writeback of updated params is broken in this
-                # XLA/runtime (TPU backend Internal); the working path updates
-                # params through the NVMe swapper (device outputs, eager host
-                # writeback), so param offload requires it
-                raise ValueError(
-                    "offload_param.device=cpu requires "
-                    "offload_optimizer.device=nvme (the ZeRO-Infinity "
-                    "configuration): the optimizer step produces the updated "
-                    "host-resident params")
-            from deepspeed_tpu.models.transformer import TransformerConfig
-            if not isinstance(getattr(model, "config", None), TransformerConfig):
-                raise ValueError("offload_param requires a transformer "
-                                 "ModelSpec (stacked scanned layers)")
-            if self._pp_mode:
-                raise ValueError("offload_param with pipeline parallelism is "
-                                 "not supported (stages already partition "
-                                 "the layer stack)")
-            if self.plan.world_size > 1:
-                # XLA's SPMD partitioner rejects sharded device-placement
-                # annotations ("Side-effect ops cannot be replicated") in this
-                # version; the single-chip capacity path is the ZeRO-Infinity
-                # headline anyway (40B on one V100, BASELINE.md)
-                raise ValueError("offload_param requires a single-device mesh "
-                                 "in this version; use ZeRO-3 sharding for "
-                                 "multi-chip capacity")
-            if get_accelerator().platform == "cpu":
-                logger.warning("offload_param requires a TPU runtime (CPU has "
-                               "no device-placement support); disabling")
-                self._offload_param = False
-            else:
-                import dataclasses as _dc
-                from deepspeed_tpu.models import make_model as _mk
-                if not model.config.scan_layers or not model.config.offload_params:
-                    model = _mk(_dc.replace(model.config, scan_layers=True,
-                                            offload_params=True),
-                                name=model.name)
-                    self.model = model
-                logger.info("param offload: layer stack in pinned_host DRAM, "
-                            "streamed per scan step")
-        if self._offload_param:
-            self._param_dev_shardings = self.param_shardings
-            self.param_shardings = {
-                k: (jax.tree.map(
-                        lambda s: NamedSharding(self.mesh, s.spec,
-                                                memory_kind="pinned_host"),
-                        v) if k == "layers" else v)
-                for k, v in self.param_shardings.items()}
+        # every offload_param configuration routes through the layer-streamed
+        # executor above (round-5: the old non-streamed scan-fetch train path
+        # was single-device-only — an in-graph host writeback this runtime
+        # rejects — and is deleted; cfg.offload_params scan-fetch remains for
+        # INFERENCE capacity, models/transformer.py:1089)
 
         # --- optimizer (reference: _configure_optimizer:1175)
         self.lr_scheduler = lr_scheduler
@@ -667,16 +639,8 @@ class Engine:
             params32 = self.model.init(key)
             # nvme offload: fp32 state lives on NVMe chunks, never in HBM
             opt_state = None if self._nvme_opt else self.optimizer.init(params32)
-            if self._offload_param:
-                # host-resident layer stacks stay fp32: sub-word (bf16) host
-                # DMA is broken on some TPU transports; the forward casts
-                # after the per-layer transfer
-                params = {k: (v if k == "layers" else jax.tree.map(
-                    lambda p: p.astype(self.compute_dtype), v))
-                    for k, v in params32.items()}
-            else:
-                params = jax.tree.map(
-                    lambda p: p.astype(self.compute_dtype), params32)
+            params = jax.tree.map(
+                lambda p: p.astype(self.compute_dtype), params32)
             state = {"params": params, "opt": opt_state,
                      "step": jnp.zeros((), jnp.int32)}
             if self._fp16:
@@ -770,16 +734,6 @@ class Engine:
         grad_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self.grad_specs,
             is_leaf=lambda x: isinstance(x, P))
-        # the swapper always emits device-resident compute-dtype params:
-        # in-graph host writebacks crash this TPU runtime; offload_param host
-        # residency (fp32, sub-word host DMA is broken) is restored eagerly
-        # per leaf in _nvme_apply instead
-        out_shardings = (self._param_dev_shardings if self._offload_param
-                         else self.param_shardings)
-        if self._offload_param:
-            param_shapes = jax.tree.map(
-                lambda s: jax.ShapeDtypeStruct(s.shape, self.compute_dtype),
-                param_shapes)
         return NVMeOptimizerSwapper(
             param_shapes, mesh=self.mesh, nvme_path=off.nvme_path,
             storage=self._swap_storage,
@@ -789,11 +743,10 @@ class Engine:
             adam_w_mode=(name == "adamw" or p.get("adam_w_mode", False)),
             bias_correction=p.get("bias_correction", True),
             chunk_elems=max(1, off.buffer_size // 4),  # buffer_size is bytes
-            param_shardings=out_shardings,
+            param_shardings=self.param_shardings,
             grad_shardings=grad_shardings,
             compute_dtype=self.compute_dtype,
-            pipeline=off.pipeline_read or off.pipeline_write or True,
-            host_inputs=self._offload_param)
+            pipeline=off.pipeline_read or off.pipeline_write or True)
 
     def _build_infinity(self):
         from deepspeed_tpu.runtime.infinity import InfinityExecutor
@@ -1368,17 +1321,6 @@ class Engine:
             grads, lr=self.get_lr(), step_num=applied,
             clip=self.config.gradient_clipping, grad_scale=scale)
         if not overflow:
-            if self._offload_param:
-                # eager host writeback of the layer stack, per leaf (in-graph
-                # host outputs crash this TPU runtime; host copies are fp32
-                # because sub-word host DMA is broken on this transport)
-                new_params = {
-                    k: (jax.tree.map(
-                            lambda a, s: jax.device_put(
-                                a.astype(jnp.float32), s), v,
-                            self.state_shardings["params"][k])
-                        if k == "layers" else v)
-                    for k, v in new_params.items()}
             self.state["params"] = new_params
             self.state["step"] = jax.tree.map(lambda s: s + 1, self.state["step"])
         if self._fp16:
@@ -1769,13 +1711,11 @@ def _flatten_dict(tree, prefix=""):
 
 
 def _infinity_mode(config) -> bool:
-    """Whether the config selects the ZeRO-Infinity layer-streamed executor:
-    param-on-NVMe, or the param+optimizer host-DRAM (device=cpu) pairing."""
-    zo = config.zero_optimization
-    return (zo.offload_param.enabled
-            and (zo.offload_param.device == "nvme"
-                 or (zo.offload_param.device == "cpu"
-                     and zo.offload_optimizer.device == "cpu")))
+    """Whether the config selects the ZeRO-Infinity layer-streamed executor.
+    Round 5: EVERY enabled offload_param routes here — the executor is the
+    one param-offload train path (mixed cpu/nvme tiers collapse onto the
+    nvme store with the host param cache on top; see Engine.__init__)."""
+    return config.zero_optimization.offload_param.enabled
 
 
 def _unflatten_dict(flat):
