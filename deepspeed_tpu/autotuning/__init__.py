@@ -1,1 +1,3 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune_config
+from deepspeed_tpu.autotuning.scheduler import (Experiment, ResourceManager,
+                                                schedule_experiments)

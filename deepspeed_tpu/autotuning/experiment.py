@@ -1,0 +1,62 @@
+"""One autotuning experiment process: measure a config, write result JSON.
+
+Reference: the per-experiment subprocess the reference's scheduler launches
+(``deepspeed/autotuning/scheduler.py`` run_experiment -> ds train script);
+here the measurement IS the engine — build, warm up, time a few steps,
+emit ``{"samples_per_sec", "step_ms"}`` (or ``{"error"}``) to the result
+path the scheduler polls.
+
+Usage: ``python -m deepspeed_tpu.autotuning.experiment cfg.json out.json``.
+The config may carry an ``_experiment`` section: ``{"steps": N,
+"model": {TransformerConfig kwargs}}`` — without a model section a tiny
+default transformer is measured (mesh/zero/gas relative rankings transfer).
+"""
+
+import json
+import sys
+import time
+
+
+def run_experiment(cfg_path: str, out_path: str) -> int:
+    with open(cfg_path) as f:
+        config = json.load(f)
+    exp = config.pop("_experiment", {}) or {}
+    steps = int(exp.get("steps", 3))
+    out = {}
+    try:
+        import numpy as np
+        import jax
+        import deepspeed_tpu
+        from deepspeed_tpu.models import TransformerConfig, make_model
+        mk = dict(exp.get("model") or {})
+        mk.setdefault("vocab_size", 256)
+        mk.setdefault("hidden_size", 64)
+        mk.setdefault("num_layers", 2)
+        mk.setdefault("num_heads", 4)
+        mk.setdefault("max_seq_len", 128)
+        model = make_model(TransformerConfig(**mk), name="autotune-exp")
+        config.setdefault("steps_per_print", 10 ** 9)
+        config["autotuning"] = {"enabled": False}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+        B = engine.config.train_batch_size
+        S = mk["max_seq_len"]
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, mk["vocab_size"], (B, S),
+                                           dtype=np.int32)}
+        engine.train_batch(batch)            # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_batch(batch)
+        if engine.state is not None:
+            jax.block_until_ready(engine.state["step"])
+        dt = (time.perf_counter() - t0) / steps
+        out = {"samples_per_sec": B / dt, "step_ms": dt * 1e3}
+    except Exception as e:  # noqa: BLE001 — the scheduler ranks failures -inf
+        out = {"error": f"{type(e).__name__}: {e}"[:300]}
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    return 0 if "error" not in out else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(run_experiment(sys.argv[1], sys.argv[2]))

@@ -87,6 +87,7 @@ class TestAutotuner:
         assert (("fsdp", 8), ("tensor", 1)) in meshes
         assert any(dict(m).get("tensor") == 4 for m in meshes)
 
+    @pytest.mark.slow
     def test_autotune_picks_valid_config(self, devices8, tmp_path):
         """End-to-end: autotuning enabled selects a runnable config at least
         as fast as the measured candidates, engine trains with it."""
@@ -121,3 +122,88 @@ class TestAutotuner:
                            "gradient_accumulation_steps": 1})
         assert trial.error is not None
         assert trial.samples_per_sec == float("-inf")
+
+
+class TestExperimentScheduler:
+    """Multi-host experiment scheduler (reference: autotuning/scheduler.py
+    ResourceManager): host-pool partitioning, concurrent disjoint groups,
+    result collection from per-experiment dirs."""
+
+    def test_hosts_needed(self):
+        from deepspeed_tpu.autotuning.scheduler import hosts_needed
+        assert hosts_needed({"mesh": {"axes": {"data": 8}}}, 4) == 2
+        assert hosts_needed({"mesh": {"axes": {"data": 2, "tensor": 2}}},
+                            4) == 1
+        assert hosts_needed({}, 4) == 1
+
+    def test_partitioning_and_concurrency(self, tmp_path):
+        """4 hosts, candidates needing 2/2/4: the two 2-host experiments
+        must run concurrently on disjoint groups; the 4-host one after."""
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager
+        import json as _json
+        import os as _os
+        events = []
+
+        def fake_launch(exp):
+            events.append(("launch", exp.exp_id, tuple(exp.hosts)))
+            d = _os.path.join(str(tmp_path), f"exp_{exp.exp_id}")
+            _os.makedirs(d, exist_ok=True)
+            with open(_os.path.join(d, "result.json"), "w") as f:
+                _json.dump({"samples_per_sec": 100.0 + exp.exp_id,
+                            "step_ms": 10.0}, f)
+
+        rm = ResourceManager(["h0", "h1", "h2", "h3"], chips_per_host=4,
+                             results_dir=str(tmp_path), launch=fake_launch,
+                             poll_s=0.01)
+        cfgs = [{"mesh": {"axes": {"data": 8}}},           # 2 hosts
+                {"mesh": {"axes": {"fsdp": 8}}},           # 2 hosts
+                {"mesh": {"axes": {"data": 16}}}]          # 4 hosts
+        exps = rm.schedule(cfgs)
+        # first poll launches BOTH 2-host exps before any completes
+        first_two = {e[1] for e in events[:2]}
+        assert first_two == {0, 1}
+        used = [set(e[2]) for e in events[:2]]
+        assert used[0].isdisjoint(used[1])
+        assert all(e.status == "done" for e in exps)
+        # sorted best-first: exp 2 wrote the highest samples/sec
+        assert exps[0].exp_id == 2
+
+    def test_failure_ranks_last(self, tmp_path):
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager
+        import json as _json
+        import os as _os
+
+        def fake_launch(exp):
+            d = _os.path.join(str(tmp_path), f"exp_{exp.exp_id}")
+            _os.makedirs(d, exist_ok=True)
+            if exp.exp_id == 0:
+                with open(_os.path.join(d, "result.json"), "w") as f:
+                    _json.dump({"error": "OOM"}, f)
+            else:
+                with open(_os.path.join(d, "result.json"), "w") as f:
+                    _json.dump({"samples_per_sec": 5.0}, f)
+
+        rm = ResourceManager(["h0"], results_dir=str(tmp_path),
+                             launch=fake_launch, poll_s=0.01)
+        exps = rm.schedule([{}, {}])
+        assert exps[0].exp_id == 1 and exps[0].status == "done"
+        assert exps[1].status == "failed" and exps[1].error == "OOM"
+
+    @pytest.mark.slow
+    def test_real_local_experiment_subprocess(self, tmp_path):
+        """End-to-end: the default launcher runs the experiment MODULE as a
+        real local subprocess that builds an engine and reports throughput."""
+        from deepspeed_tpu.autotuning.scheduler import schedule_experiments
+        cfg = {"train_batch_size": 4,
+               "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+               "bf16": {"enabled": False},
+               "_experiment": {"steps": 2,
+                               "model": {"vocab_size": 64, "hidden_size": 32,
+                                         "num_layers": 1, "num_heads": 2,
+                                         "max_seq_len": 32,
+                                         "attention_impl": "xla"}}}
+        exps = schedule_experiments([cfg], hosts=["localhost"],
+                                    results_dir=str(tmp_path / "exps"),
+                                    poll_s=0.2, timeout_s=600)
+        assert exps[0].status == "done", exps[0].error
+        assert exps[0].metric > 0
