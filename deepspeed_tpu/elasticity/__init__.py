@@ -1,3 +1,4 @@
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityError, compute_elastic_config, get_compatible_gpus)
 from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+from deepspeed_tpu.elasticity.rendezvous import FileRendezvous, reform_step

@@ -1,0 +1,180 @@
+"""File-store rendezvous: multi-host elastic membership + host-death
+recovery.
+
+Reference: the torchelastic rendezvous underneath
+``deepspeed/elasticity/elastic_agent.py:25`` (DSElasticAgent) — a shared
+store (etcd/c10d) tracks worker liveness via heartbeats; on a membership
+change the survivors agree on a NEW generation and restart training at the
+new world size from the last checkpoint.
+
+TPU-native re-design: the store is a shared directory (TPU pods already
+mount one for checkpoints — NFS/gcsfuse), so no extra service:
+
+- every host writes a ``hb_<host>.json`` heartbeat (monotonic counter +
+  wall time); a host whose heartbeat is older than ``dead_after_s`` is
+  dead — this is how a WHOLE-HOST failure is detected, which the per-chip
+  device probe (elastic_agent.probe_devices) cannot see;
+- the deterministic leader (lexicographically-first live host) publishes
+  ``gen_<N>.json`` manifests: {generation, hosts, coordinator}; followers
+  poll for the newest manifest;
+- when the live set differs from the current manifest's hosts, the leader
+  publishes the next generation; every member then rebuilds its jax
+  distributed runtime against the manifest's coordinator and resumes from
+  the latest checkpoint with the batch plan for the new world
+  (elasticity.compute_elastic_config — same contract as the reference's
+  restart-from-checkpoint).
+
+Deterministic and unit-testable: time is injectable, and multiple "hosts"
+are simulated as distinct host_ids over one store directory.
+"""
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class FileRendezvous:
+    """One participant's view of the membership store."""
+
+    def __init__(self, store_dir: str, host: str, *,
+                 coordinator_port: int = 8476,
+                 dead_after_s: float = 15.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.store = store_dir
+        self.host = host
+        self.port = coordinator_port
+        self.dead_after = dead_after_s
+        self._clock = clock or time.time
+        self._beats = 0
+        self._seen_gen = -1   # newest generation this member has acted on
+        os.makedirs(store_dir, exist_ok=True)
+
+    # -- heartbeats ----------------------------------------------------
+    def _hb_path(self, host: str) -> str:
+        return os.path.join(self.store, f"hb_{host}.json")
+
+    def heartbeat(self):
+        """Atomic write (tmp + rename): a torn read must not kill a host."""
+        self._beats += 1
+        tmp = self._hb_path(self.host) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"host": self.host, "beats": self._beats,
+                       "ts": self._clock()}, f)
+        os.replace(tmp, self._hb_path(self.host))
+
+    def live_hosts(self) -> List[str]:
+        now = self._clock()
+        out = []
+        for fn in sorted(os.listdir(self.store)):
+            if not fn.startswith("hb_"):
+                continue
+            try:
+                with open(os.path.join(self.store, fn)) as f:
+                    hb = json.load(f)
+                if now - float(hb["ts"]) <= self.dead_after:
+                    out.append(hb["host"])
+            except (OSError, ValueError, KeyError):  # torn/partial write
+                continue
+        return sorted(out)
+
+    # -- generations ---------------------------------------------------
+    def _gen_path(self, n: int) -> str:
+        return os.path.join(self.store, f"gen_{n:08d}.json")
+
+    def current_generation(self) -> Optional[Dict[str, Any]]:
+        gens = sorted(fn for fn in os.listdir(self.store)
+                      if fn.startswith("gen_"))
+        if not gens:
+            return None
+        try:
+            with open(os.path.join(self.store, gens[-1])) as f:
+                return json.load(f)
+        except (OSError, ValueError):  # pragma: no cover - torn write
+            return None
+
+    def is_leader(self) -> bool:
+        live = self.live_hosts()
+        return bool(live) and live[0] == self.host
+
+    def should_reform(self) -> bool:
+        """Membership drifted from the published manifest (host died or
+        rejoined) — time for a new generation."""
+        cur = self.current_generation()
+        live = self.live_hosts()
+        if cur is None:
+            return bool(live)
+        return sorted(cur["hosts"]) != live
+
+    def propose_generation(self) -> Optional[Dict[str, Any]]:
+        """Leader-only: publish the next generation over the live set.
+        Returns the manifest (followers get it via wait_generation)."""
+        if not self.is_leader():
+            return None
+        live = self.live_hosts()
+        cur = self.current_generation()
+        n = (cur["generation"] + 1) if cur else 0
+        manifest = {"generation": n, "hosts": live,
+                    "coordinator": f"{live[0]}:{self.port}",
+                    "ts": self._clock()}
+        tmp = self._gen_path(n) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self._gen_path(n))
+        self._seen_gen = n
+        logger.info(f"rendezvous: generation {n} published — "
+                    f"{len(live)} host(s), coordinator "
+                    f"{manifest['coordinator']}")
+        return manifest
+
+    def wait_generation(self, min_generation: int = 0,
+                        timeout_s: float = 60.0,
+                        poll_s: float = 0.5) -> Dict[str, Any]:
+        """Block until a manifest with generation >= min_generation exists.
+        Followers call this after noticing membership drift (or on join)."""
+        deadline = self._clock() + timeout_s
+        while True:
+            cur = self.current_generation()
+            if cur is not None and cur["generation"] >= min_generation:
+                return cur
+            if self._clock() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: no generation >= {min_generation} within "
+                    f"{timeout_s}s ({len(self.live_hosts())} live hosts)")
+            time.sleep(poll_s)
+
+    def leave(self):
+        """Graceful exit: drop the heartbeat so the next round excludes us."""
+        try:
+            os.remove(self._hb_path(self.host))
+        except OSError:
+            pass
+
+
+def reform_step(rdzv: FileRendezvous) -> Optional[Dict[str, Any]]:
+    """One membership round: heartbeat; if the live set drifted from the
+    manifest the leader publishes the next generation (followers wait for
+    it); and ANY generation this member hasn't acted on yet is returned —
+    so a follower whose leader already re-formed still learns about it on
+    its next round. Returns None when nothing changed. The caller (elastic
+    agent / launcher) rebuilds its jax distributed runtime against
+    manifest['coordinator'] and resumes from the latest checkpoint with
+    the new world's batch plan."""
+    rdzv.heartbeat()
+    published = None
+    if rdzv.should_reform():
+        cur = rdzv.current_generation()
+        want = (cur["generation"] + 1) if cur else 0
+        if rdzv.is_leader():
+            published = rdzv.propose_generation()
+        else:
+            rdzv.wait_generation(min_generation=want)
+    if published is not None:
+        return published
+    newest = rdzv.current_generation()
+    if newest is not None and newest["generation"] > rdzv._seen_gen:
+        rdzv._seen_gen = newest["generation"]
+        return newest
+    return None

@@ -258,3 +258,111 @@ def test_elastic_cli(tmp_path, capsys):
     cfg2 = tmp_path / "bad.json"
     cfg2.write_text(json.dumps({"elasticity": {"enabled": False}}))
     assert cli_main([str(cfg2)]) == 1
+
+
+class TestRendezvous:
+    """Host-death rendezvous (reference: torchelastic store under
+    elastic_agent.py:25): heartbeats detect a dead HOST (the per-chip
+    probe can't), the leader publishes the next generation, survivors
+    re-form at the smaller world."""
+
+    def _rdzv(self, tmp, host, t):
+        from deepspeed_tpu.elasticity import FileRendezvous
+        return FileRendezvous(str(tmp), host, dead_after_s=10.0,
+                              clock=lambda: t[0])
+
+    def test_membership_and_leader(self, tmp_path):
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        a.heartbeat(); b.heartbeat()
+        assert a.live_hosts() == ["host-a", "host-b"]
+        assert a.is_leader() and not b.is_leader()
+
+    def test_host_death_triggers_new_generation(self, tmp_path):
+        from deepspeed_tpu.elasticity import reform_step
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        c = self._rdzv(tmp_path, "host-c", t)
+        for r in (a, b, c):
+            r.heartbeat()
+        gen0 = a.propose_generation()
+        assert gen0["generation"] == 0 and len(gen0["hosts"]) == 3
+        # host-b dies: stops heartbeating; time passes beyond dead_after
+        t[0] = 115.0
+        a.heartbeat(); c.heartbeat()
+        assert a.live_hosts() == ["host-a", "host-c"]
+        assert a.should_reform()
+        m = reform_step(a)
+        assert m is not None and m["generation"] == 1
+        assert m["hosts"] == ["host-a", "host-c"]
+        assert m["coordinator"].startswith("host-a:")
+        # the follower's round picks up the same manifest
+        got = reform_step(c)
+        assert got is not None and got["generation"] == 1
+
+    def test_leader_death_elects_next(self, tmp_path):
+        from deepspeed_tpu.elasticity import reform_step
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        a.heartbeat(); b.heartbeat()
+        a.propose_generation()
+        # the LEADER dies: host-b must take over and publish gen 1 with
+        # itself as the coordinator
+        t[0] = 115.0
+        b.heartbeat()
+        assert b.is_leader()
+        m = reform_step(b)
+        assert m["hosts"] == ["host-b"]
+        assert m["coordinator"].startswith("host-b:")
+
+    def test_rejoin_scales_back_up(self, tmp_path):
+        from deepspeed_tpu.elasticity import reform_step
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        a.heartbeat(); b.heartbeat()
+        a.propose_generation()
+        t[0] = 115.0                      # b drops out
+        reform_step(a)
+        t[0] = 116.0                      # b comes back
+        b.heartbeat()
+        m = reform_step(a)
+        assert m["generation"] == 2 and m["hosts"] == ["host-a", "host-b"]
+
+    def test_stable_membership_is_noop(self, tmp_path):
+        from deepspeed_tpu.elasticity import reform_step
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        a.heartbeat()
+        a.propose_generation()
+        assert reform_step(a) is None
+
+    def test_graceful_leave(self, tmp_path):
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        a.heartbeat(); b.heartbeat()
+        b.leave()
+        assert a.live_hosts() == ["host-a"]
+
+    def test_elastic_batch_plan_for_new_world(self, tmp_path):
+        """The reform manifest feeds compute_elastic_config: the new world
+        gets a valid batch triad (the torchelastic-restart contract)."""
+        from deepspeed_tpu.elasticity import (FileRendezvous,
+                                              compute_elastic_config)
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        for h in ("host-a", "host-b", "host-c", "host-d"):
+            FileRendezvous(str(tmp_path), h, dead_after_s=10.0,
+                           clock=lambda: t[0]).heartbeat()
+        m = a.propose_generation()
+        chips_per_host = 4
+        world = len(m["hosts"]) * chips_per_host
+        fb, valid, micro = compute_elastic_config(
+            {"enabled": True, "max_train_batch_size": 128,
+             "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 64},
+            world_size=world)
+        assert fb % (micro * world) == 0
