@@ -137,6 +137,7 @@ class TestInt8KVCache:
                / np.linalg.norm(np.asarray(ref).ravel()))
         assert rel < 2e-2, rel
 
+    @pytest.mark.slow
     def test_generate_int8_vs_float_first_logits(self):
         """Engine-level: prefill logits are exact (cache unused); the first
         decode step's logits (read through the quantized cache) stay close

@@ -112,6 +112,7 @@ class TestKernelParity:
             sparse_attention(q, k, v, FixedSparsityConfig(block=16))
 
 
+@pytest.mark.slow
 def test_transformer_with_sparse_attention_trains(devices8):
     """End-to-end: a model configured for bigbird sparse attention trains
     through the engine (the reference wires SparseSelfAttention the same

@@ -2,6 +2,7 @@
 fully materialize; math must be identical to the monolithic loss)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -16,6 +17,7 @@ def _cfg(**kw):
     return TransformerConfig(**base)
 
 
+@pytest.mark.slow
 def test_chunked_loss_and_grads_match_full():
     cfg_full = _cfg(loss_chunk=0)
     cfg_chunk = _cfg(loss_chunk=16)

@@ -54,6 +54,7 @@ def test_single_device_mesh_runs():
     assert all("error" not in r for r in rows), rows
 
 
+@pytest.mark.slow
 def test_embedding_grad_stance_bench():
     """Sparse-embedding-grad N/A-by-design evidence (reference:
     engine.py:2302-2369 sparse allreduce): the microbench runs, the dense

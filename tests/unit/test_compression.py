@@ -93,6 +93,7 @@ class TestCompression:
         dead = [(per_head[h] == 0).all() for h in range(4)]
         assert sum(dead) == 2
 
+    @pytest.mark.slow
     def test_engine_qat_training(self, devices8):
         """QAT: weight fake-quant active after schedule_offset; training
         still converges and masters stay full precision."""
@@ -135,6 +136,7 @@ class TestCompression:
         zero_cols = (out == 0).all(axis=0).sum()
         assert zero_cols == 16
 
+    @pytest.mark.slow
     def test_activation_quant_engine(self, devices8):
         """Activation quantization: post-norm activations are fake-quantized
         (STE) once schedule_offset is reached; training converges."""
@@ -159,6 +161,7 @@ class TestCompression:
         assert engine.model.config.activation_quant_bits == 8
         assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_layer_reduction_engine(self, devices8):
         """layer_reduction: the engine trains a keep_number-layer student."""
         model = make_model(TransformerConfig(
@@ -179,6 +182,7 @@ class TestCompression:
         losses = [float(engine.train_batch(b)["loss"]) for _ in range(6)]
         assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_student_from_teacher_and_distill(self):
         """Layer-reduced student initialized from teacher layers + KD loss
         (reference: compress.py student_initialization + kd pairing)."""

@@ -55,6 +55,7 @@ class TestCurriculumScheduler:
         out = apply_seqlen_curriculum(b, 16)
         assert out["input_ids"].shape == (4, 16)
 
+    @pytest.mark.slow
     def test_engine_curriculum_seqlen(self, devices8):
         """Engine truncates batches per schedule; short early steps train."""
         model = make_model(TransformerConfig(
@@ -146,6 +147,7 @@ class TestRandomLTD:
         assert s.kept_tokens(50, 512) % 64 == 0
         assert s.kept_tokens(50, 128) == 128  # capped at seq
 
+    @pytest.mark.slow
     def test_engine_random_ltd_trains(self, devices8):
         model = make_model(TransformerConfig(
             vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,

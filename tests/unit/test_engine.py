@@ -66,6 +66,7 @@ class TestTraining:
         assert engine.state["params"]["tok_embed"].dtype == jnp.bfloat16
         assert engine.state["opt"]["master"]["tok_embed"].dtype == jnp.float32
 
+    @pytest.mark.slow
     def test_grad_accumulation_equivalence(self):
         """gas=4 over the same data must match gas=1 (reference: grad-accum
         boundary semantics)."""
@@ -97,6 +98,7 @@ class TestTraining:
         assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 class TestZeroStages:
     @pytest.mark.parametrize("stage", [0, 1, 2, 3])
     def test_stage_parity(self, stage):
@@ -179,6 +181,7 @@ class TestThreeCallAPI:
         assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow
 class TestCheckpoint:
     def test_save_load_parity(self, tmp_path):
         cfg = ds_config()

@@ -470,6 +470,7 @@ def test_roberta_import_hidden_parity():
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_bert_inference_engine_encode():
     """init_inference serves encoder models: engine.encode() hidden states
     match HF (the fill-mask/classification entry point)."""

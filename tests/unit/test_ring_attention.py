@@ -32,6 +32,7 @@ def test_ring_matches_reference(seq_mesh, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_seq_parallel_training_matches_dp():
     """sp=4: same losses as pure dp (sequence layout is invisible to math)."""
     def run(cfg_overrides):

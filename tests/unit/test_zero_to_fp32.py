@@ -4,6 +4,9 @@
 import numpy as np
 import pytest
 
+# quick tier: checkpoint-machinery suites re-build engines per test (compile-heavy)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 
