@@ -25,26 +25,42 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
-# persistent XLA compilation cache: the quick tier is compile-bound (tiny
-# models, many engine builds) — a warm cache cuts it ~4x (measured 47s -> 12s
-# on the stage-parity class). Safe across runs: entries key on HLO + flags.
-# Same DSTPU_CACHE_DIR-first resolution as ops/cpu_adam._cache_dir; an
-# unwritable cache location must not error the whole session.
-_cache_dir = os.path.join(
-    os.environ.get("DSTPU_CACHE_DIR")
-    or os.path.join(os.environ.get("XDG_CACHE_HOME",
-                                   os.path.expanduser("~/.cache")),
-                    "deepspeed_tpu"),
-    "jax-test-cache")
-try:
-    os.makedirs(_cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-except OSError:  # read-only HOME: run uncached rather than not at all
-    pass
+# NOTE on the persistent XLA compilation cache: tried (it cut warm runs
+# ~4x) and REVERTED — on this jaxlib/CPU combination, re-loading cached
+# executables for the donated+sharded engine train steps SIGABRTs inside
+# XLA on the first value fetch (reproduced with TestZeroStages: cold run
+# passes, warm run aborts; JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES=none
+# does not help). Opt in explicitly if your jaxlib is newer:
+if os.environ.get("DSTPU_TEST_COMPILE_CACHE"):
+    _cache_dir = os.path.join(
+        os.environ.get("DSTPU_CACHE_DIR")
+        or os.path.join(os.environ.get("XDG_CACHE_HOME",
+                                       os.path.expanduser("~/.cache")),
+                        "deepspeed_tpu"),
+        "jax-test-cache")
+    try:  # an unwritable cache location must not error the whole session
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except OSError:
+        pass
 
 _t_session_start = None
+
+
+def pytest_configure(config):
+    # quick tier (-m "not slow"): tests are COMPILE-bound on this 1-core box
+    # and correctness-tolerance based, so trade codegen quality for compile
+    # time (~30% wall cut measured). The full tier keeps default
+    # optimization — the heavy numerical-parity suites run with production
+    # codegen. This hook runs after CLI parsing (exact markexpr, no argv
+    # substring guessing) and before any test touches a device — jax
+    # initializes backends lazily, so the env is set in time.
+    if (config.option.markexpr or "").strip() == "not slow" and \
+            "xla_backend_optimization_level" not in os.environ.get(
+                "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] += " --xla_backend_optimization_level=0"
 
 
 def pytest_sessionstart(session):

@@ -57,6 +57,7 @@ class TestTraining:
         losses, _ = train_losses(ds_config(), steps=15)
         assert losses[-1] < losses[0] * 0.8, losses
 
+    @pytest.mark.slow
     def test_bf16_trains(self):
         model = make_model(tiny_cfg(dtype=jnp.bfloat16))
         losses, engine = train_losses(
@@ -81,10 +82,12 @@ class TestTraining:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.slow
     def test_gradient_clipping_runs(self):
         losses, _ = train_losses(ds_config(gradient_clipping=0.5), steps=5)
         assert all(np.isfinite(l) for l in losses)
 
+    @pytest.mark.slow
     def test_scheduler_warmup(self):
         cfg = ds_config(scheduler={"type": "WarmupLR", "params": {
             "warmup_min_lr": 0.0, "warmup_max_lr": 1e-2, "warmup_num_steps": 10}})
@@ -92,6 +95,7 @@ class TestTraining:
         lr = engine.get_lr()
         assert 0 < lr < 1e-2  # still warming
 
+    @pytest.mark.slow
     def test_eval_batch(self):
         _, engine = train_losses(ds_config(), steps=2)
         loss = engine.eval_batch(fixed_batch())
@@ -136,6 +140,7 @@ class TestZeroStages:
         assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow
 class TestFP16:
     def test_fp16_dynamic_scaling_trains(self):
         model = make_model(tiny_cfg(dtype=jnp.float16))
@@ -161,6 +166,7 @@ class TestFP16:
         assert after_scale <= before_scale  # shrank (or stayed if no overflow)
 
 
+@pytest.mark.slow
 class TestThreeCallAPI:
     def test_forward_backward_step(self):
         """The reference's engine.forward/backward/step loop."""
@@ -228,6 +234,7 @@ class TestCheckpoint:
         assert os.path.exists(path)
 
 
+@pytest.mark.slow
 class TestOptaxInterop:
     def test_optax_optimizer_drop_in(self):
         optax = pytest.importorskip("optax")

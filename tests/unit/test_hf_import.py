@@ -353,6 +353,7 @@ def test_gptneox_import_logit_parity():
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gptj_decode_matches_forward():
     """The parallel-block cache path: greedy decode == argmax of full
     forward (the KV-cache/decode contract for the new families)."""
@@ -549,6 +550,7 @@ def test_gptneo_import_logit_parity_local_attention():
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gptneo_decode_matches_forward():
     """Greedy decode crosses the local window boundary: the decode cache's
     band mask must match the full forward's."""

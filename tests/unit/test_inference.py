@@ -28,9 +28,10 @@ def _cfg(**overrides):
 
 @pytest.mark.parametrize("overrides", [
     {},                                                        # llama-style GQA
-    {"position_type": "learned", "activation": "gelu",
-     "norm_type": "layernorm", "num_kv_heads": 4,
-     "tie_embeddings": True},                                  # gpt2-style
+    pytest.param({"position_type": "learned", "activation": "gelu",
+                  "norm_type": "layernorm", "num_kv_heads": 4,
+                  "tie_embeddings": True},
+                 marks=pytest.mark.slow),                      # gpt2-style
 ])
 def test_decode_logits_match_full_forward(overrides):
     """prefill + N decode_steps == full forward at every position."""
@@ -83,6 +84,7 @@ def test_prefill_padded_prompt_matches_unpadded():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_generate_cached_matches_recompute(devices8):
     """Greedy generate via KV cache == the O(n^2) full-recompute fallback."""
     import dataclasses
@@ -103,6 +105,7 @@ def test_generate_cached_matches_recompute(devices8):
     assert out_cached.shape == (2, 18)
 
 
+@pytest.mark.slow
 def test_generate_tp_sharded(devices8):
     """tensor_parallel=4 decode: cache shards over the tensor axis and the
     generation matches the single-device result."""
@@ -132,6 +135,7 @@ def test_generate_beyond_max_seq_len_raises(devices8):
     assert out.shape == (1, 28)
 
 
+@pytest.mark.slow
 def test_int8_weight_only_inference(devices8):
     """quantize_bits=8: layer weights stored int8 in HBM; logits close to
     full precision, generate works, payloads really are int8."""
@@ -156,6 +160,7 @@ def test_int8_weight_only_inference(devices8):
     assert out.shape == (2, 18)
 
 
+@pytest.mark.slow
 def test_generate_temperature_sampling(devices8):
     cfg = _cfg()
     model = make_model(cfg)
@@ -199,3 +204,28 @@ class TestTwoLevelDecode:
         # ties; require near-total agreement and an exact first stretch
         assert (gen1[:, :10] == gen2[:, :10]).all(), (gen1, gen2)
         assert (gen1 == gen2).mean() > 0.9, (gen1, gen2)
+
+
+@pytest.mark.slow
+def test_two_level_decode_with_local_windows():
+    """The two-level (frozen-prefix + suffix) decode path engages at
+    max_len >= 1024; its band masks (prefix valid AND suffix terms) must
+    reproduce the full forward for a model with per-layer local windows
+    once positions run past the window."""
+    import deepspeed_tpu
+    cfg = TransformerConfig(
+        vocab_size=96, hidden_size=64, num_layers=2, num_heads=4,
+        max_seq_len=1024, dtype=jnp.float32, attention_impl="xla",
+        position_type="learned", attn_windows=(0, 16), qkv_bias=True)
+    model = make_model(cfg)
+    params = model.init(jax.random.PRNGKey(11))
+    eng = deepspeed_tpu.init_inference(model, params=params,
+                                       dtype=jnp.float32)
+    ids = np.random.default_rng(12).integers(0, 96, (1, 250)).astype(np.int32)
+    out = np.asarray(eng.generate(ids, max_new_tokens=20))
+    cur = ids
+    for _ in range(20):
+        logits = np.asarray(forward(params, jnp.asarray(cur), cfg))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)

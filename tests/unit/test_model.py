@@ -86,6 +86,7 @@ def test_scan_vs_unrolled(rng):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_matches(rng):
     kw = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
               dtype=jnp.float32, attention_impl="xla", max_seq_len=64)
@@ -108,3 +109,51 @@ def test_grad_flows_to_all_params(tiny_model, rng):
         # pos_embed rows beyond seq_len legitimately have zero grad
         if "pos_embed" not in str(path):
             assert np.abs(np.asarray(g)).sum() > 0, f"zero grad at {path}"
+
+
+def test_attn_windows_band_mask_and_grads(rng):
+    """Per-layer local-attention windows (GPT-Neo/Mistral pattern): the
+    band bites once seq > window while in-window positions stay exact;
+    grads flow, differ from the global-attention grads, and the scan and
+    unrolled window threading agree. (Numerical parity against HF's real
+    local attention lives in test_hf_import's GPT-Neo tests.)"""
+    kw = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+              dtype=jnp.float32, attention_impl="xla", max_seq_len=64,
+              position_type="learned")
+    m_win = make_model(TransformerConfig(attn_windows=(0, 4), **kw))
+    m_glob = make_model(TransformerConfig(**kw))
+    params = m_win.init(rng)
+    batch = make_batch(2, 16, vocab=128)
+    ids = jnp.asarray(batch["input_ids"])
+    # windowed forward differs from global once seq > window
+    out_w = np.asarray(m_win.apply(params, ids))
+    out_g = np.asarray(m_glob.apply(params, ids))
+    assert np.abs(out_w - out_g).max() > 1e-4
+    # positions within the window see identical context (causal prefix):
+    # the first `window` positions of every sequence must match exactly
+    np.testing.assert_allclose(out_w[:, :4], out_g[:, :4], rtol=1e-5,
+                               atol=1e-6)
+    g_w = jax.grad(lambda p: m_win.loss_fn(p, batch, None, True))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(g_w))
+    g_g = jax.grad(lambda p: m_glob.loss_fn(p, batch, None, True))(params)
+    diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+             for a, b in zip(jax.tree.leaves(g_w), jax.tree.leaves(g_g))]
+    assert max(diffs) > 1e-5   # the band mask reaches the backward
+    # scan and unrolled paths agree under windows
+    m_unroll = make_model(TransformerConfig(attn_windows=(0, 4),
+                                            scan_layers=False, **kw))
+    np.testing.assert_allclose(np.asarray(m_unroll.apply(params, ids)),
+                               out_w, rtol=1e-5, atol=1e-5)
+
+
+def test_attn_windows_length_mismatch_raises(rng):
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=3,
+                            num_heads=2, dtype=jnp.float32,
+                            attention_impl="xla", max_seq_len=32,
+                            attn_windows=(0, 4))
+    m = make_model(cfg)
+    params = m.init(rng)
+    ids = jnp.asarray(make_batch(1, 8, vocab=64)["input_ids"])
+    with pytest.raises(ValueError, match="attn_windows"):
+        m.apply(params, ids)

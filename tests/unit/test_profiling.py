@@ -57,6 +57,7 @@ class TestFlopsProfiler:
         f4, f8 = fwd(_tiny(num_layers=4)), fwd(_tiny(num_layers=8))
         assert 1.6 < f8 / f4 < 2.2, (f4, f8)
 
+    @pytest.mark.slow
     def test_engine_integration_prints_profile(self, devices8, caplog):
         model = make_model(_tiny())
         engine, *_ = deepspeed_tpu.initialize(model=model, config={

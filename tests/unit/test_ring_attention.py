@@ -55,6 +55,7 @@ def test_seq_parallel_training_matches_dp():
     np.testing.assert_allclose(base, sp, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_seq_parallel_with_zero3():
     from deepspeed_tpu.models import TransformerConfig, make_model
     model = make_model(TransformerConfig(
