@@ -37,6 +37,7 @@ def test_chunked_loss_and_grads_match_full():
         np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6), gf, gc)
 
 
+@pytest.mark.slow
 def test_chunk_not_dividing_seq_falls_back_gracefully():
     cfg = _cfg(loss_chunk=24)  # 24 does not divide 64 -> largest divisor used
     params = init_params(jax.random.PRNGKey(1), cfg)
