@@ -526,11 +526,9 @@ class Engine:
                     "MoQ eigenvalue scheduling requires resident params; "
                     "layer-streamed offload uses the uniform "
                     "quantize_period for every layer")
-            if self._onebit_comm:
-                raise ValueError("quantize_training (MoQ) with the 1-bit "
-                                 "compressed-comm path is not supported "
-                                 "(the shard_map step bypasses the param "
-                                 "transform)")
+            # composes with the 1-bit compressed-comm path: the shard_map
+            # step applies the same traced _moq_bits transform inside its
+            # per-device loss (see _get_onebit_step)
             self._moq = build_moq(config.quantize_training,
                                   model.config.num_layers)
 
@@ -865,10 +863,8 @@ class Engine:
             return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
         if isinstance(batch, dict):
-            # "_"-prefixed keys are per-step side-channels (_pld_theta,
-            # _moq_bits), replicated across microbatches whatever their rank
             mbs = {k: (jnp.broadcast_to(v, (gas,) + jnp.shape(v))
-                       if k.startswith("_") else split(v))
+                       if _is_side_channel(k) else split(v))
                    for k, v in batch.items()}
         else:
             mbs = jax.tree.map(split, batch)
@@ -1071,6 +1067,7 @@ class Engine:
         fp16_cfg = cfg.fp16
         clip = cfg.gradient_clipping
         compression = self._compression
+        moq = self._moq
 
         def per_device(state, batch, rng):
             params = state["params"]
@@ -1091,6 +1088,8 @@ class Engine:
                         # per-device replicated params, schedule driven by
                         # the traced step
                         q = compression.apply(q, step)
+                    if moq is not None and "_moq_bits" in mb:
+                        q = moq.apply(q, mb["_moq_bits"])
                     loss = model.loss_fn(q, mb, r, False)
                     return loss * scale.astype(loss.dtype) if fp16 else loss
                 return jax.value_and_grad(loss_fn)(p)
@@ -1171,9 +1170,19 @@ class Engine:
         out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
         if fp16:
             out_metrics_spec["loss_scale"] = P()
-        # per-leaf batch specs: scalar side-channels replicate, rows shard
-        batch_spec = P("data") if batch is None else jax.tree.map(
-            lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(), batch)
+        # per-leaf batch specs: side-channels and scalars replicate,
+        # data rows shard
+        if batch is None:
+            batch_spec = P("data")
+        elif isinstance(batch, dict):
+            batch_spec = {
+                k: (P() if _is_side_channel(k)
+                    or getattr(v, "ndim", 0) < 1 else P("data"))
+                for k, v in batch.items()}
+        else:
+            batch_spec = jax.tree.map(
+                lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(),
+                batch)
         fn = jax.shard_map(
             per_device, mesh=mesh,
             in_specs=(state_spec, batch_spec, P()),
@@ -1452,10 +1461,8 @@ class Engine:
             return jax.device_put(x, NamedSharding(self.mesh, s))
         repl = NamedSharding(self.mesh, P())
         if isinstance(batch, dict):
-            # "_"-prefixed side-channels (_pld_theta, _moq_bits) replicate:
-            # their leading dim is NOT the batch dim
             return {k: (jax.device_put(jnp.asarray(v), repl)
-                        if k.startswith("_") else put(v))
+                        if _is_side_channel(k) else put(v))
                     for k, v in batch.items()}
         return jax.tree.map(put, batch)
 
@@ -1708,6 +1715,15 @@ def _flatten_dict(tree, prefix=""):
         elif v is not None:
             out[key] = v
     return out
+
+
+def _is_side_channel(key) -> bool:
+    """Batch-dict keys starting with "_" are per-step side-channels
+    (_pld_theta, _moq_bits): replicated across microbatches and devices —
+    their leading dim (if any) is NOT the batch dim. The ONE place the
+    convention lives; _accum_micro_grads, _device_batch and the 1-bit
+    batch specs all consult it."""
+    return isinstance(key, str) and key.startswith("_")
 
 
 def _infinity_mode(config) -> bool:
