@@ -45,6 +45,7 @@ class TestLaunchAgent:
         with pytest.raises(argparse.ArgumentTypeError, match="world_info"):
             _parse_world_info("coordinator=h:8476")
 
+    @pytest.mark.slow
     def test_child_sees_env_and_rc_passthrough(self, tmp_path):
         script = tmp_path / "child.py"
         script.write_text(

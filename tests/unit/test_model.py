@@ -100,6 +100,7 @@ def test_remat_matches(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_grad_flows_to_all_params(tiny_model, rng):
     params = tiny_model.init(rng)
     batch = make_batch(2, 16)
