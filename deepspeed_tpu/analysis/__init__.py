@@ -1,0 +1,55 @@
+"""graft-lint: static analysis over lowered/compiled step programs.
+
+The reference DeepSpeed has no compiler to interrogate — its canonical
+silent failure is an extra allreduce nobody notices until the bill arrives.
+Here every step is an XLA program we can read, so the expected collectives,
+buffer donations, dtypes, and replication of every config are *assertable*:
+
+    report = engine.audit()                 # lint this engine's own steps
+    python -m deepspeed_tpu.analysis.lint --config ds_config.json   # CLI
+
+Modules:
+    hlo_parse     — collective/alias/convert/replication parsers
+    program       — abstract lowering to ProgramArtifacts + SPMD fd capture
+    expectations  — per-config collective kind policy
+    analyzers     — CollectiveAudit, DonationLint, DtypePromotionLint,
+                    ReplicationBudget
+    report        — Finding/Report, suppression, baselines
+    corpus        — seeded known-bad programs the lint must flag
+    lint          — runner + CLI (the CI gate)
+"""
+
+from deepspeed_tpu.analysis.analyzers import (AnalysisSettings,
+                                              CollectiveAudit, DonationLint,
+                                              DtypePromotionLint,
+                                              ReplicationBudget,
+                                              default_analyzers)
+from deepspeed_tpu.analysis.expectations import (CollectivePolicy,
+                                                 expected_collectives)
+from deepspeed_tpu.analysis.hlo_parse import (CollectiveOp, collective_census,
+                                              parse_collectives,
+                                              parse_donated_params,
+                                              parse_upcasts,
+                                              replicated_tensor_bytes,
+                                              shape_bytes)
+from deepspeed_tpu.analysis.lint import (analyze_programs, audit_engine,
+                                         lower_engine_programs, run_lint)
+from deepspeed_tpu.analysis.program import (ProgramArtifacts, abstractify,
+                                            assert_no_spmd_replication,
+                                            capture_spmd_warnings,
+                                            jaxpr_primitive_census,
+                                            lower_program)
+from deepspeed_tpu.analysis.report import (Finding, Report, compare_census,
+                                           load_baseline, save_baseline)
+
+__all__ = [
+    "AnalysisSettings", "CollectiveAudit", "CollectiveOp", "CollectivePolicy",
+    "DonationLint", "DtypePromotionLint", "Finding", "ProgramArtifacts",
+    "Report", "ReplicationBudget", "abstractify", "analyze_programs",
+    "assert_no_spmd_replication", "audit_engine", "capture_spmd_warnings",
+    "collective_census", "compare_census", "default_analyzers",
+    "expected_collectives", "jaxpr_primitive_census", "load_baseline",
+    "lower_engine_programs", "lower_program", "parse_collectives",
+    "parse_donated_params", "parse_upcasts", "replicated_tensor_bytes",
+    "run_lint", "save_baseline", "shape_bytes",
+]

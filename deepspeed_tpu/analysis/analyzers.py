@@ -1,0 +1,236 @@
+"""The four graft-lint analyzers.
+
+Each analyzer is ``analyze(artifacts, settings) -> [Finding]`` over one
+lowered program (analysis/program.py). They are pure text/structure passes —
+no execution, no device state — so the same code audits a 2-device CPU
+lowering in CI and a 256-chip lowering on a real pod.
+
+1. CollectiveAudit    — census of all-reduce/all-gather/reduce-scatter/
+                        all-to-all/collective-permute ops vs the kind policy
+                        for the config (expectations.py) and any exact pin
+                        (config analysis.expect_collectives or a baseline).
+                        Guards the reference's canonical silent failure: an
+                        extra allreduce nobody notices until the bill.
+2. DonationLint       — every state buffer the step was given to donate must
+                        alias an output; a missed donation is double memory
+                        for that buffer at peak.
+3. DtypePromotionLint — bf16/f16 configs must not widen activation-sized
+                        tensors to f32 beyond the configured floor.
+4. ReplicationBudget  — explicitly-replicated float tensors above the floor
+                        must fit the per-config byte budget (promotes the
+                        old utils/hlo_check.replicated_tensor_bytes scan).
+"""
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.analysis import hlo_parse
+from deepspeed_tpu.analysis.expectations import CollectivePolicy
+from deepspeed_tpu.analysis.report import Finding, compare_census
+
+
+@dataclasses.dataclass
+class AnalysisSettings:
+    """Knobs for one lint run — built from config ``analysis`` section."""
+    # collectives smaller than this are control-plane sync (loss means,
+    # overflow flags) and exempt from the kind policy
+    min_collective_bytes: int = 1024
+    # exact census pin: {kind: count}; empty -> kind policy only
+    expect_collectives: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # donation: buffers below the floor are noise (scalars, counters)
+    min_donation_bytes: int = 1024
+    # dtype promotion: smallest f32-widened result worth flagging
+    min_upcast_bytes: int = 1 << 20
+    # replication: smallest replicated tensor scanned / total budget allowed
+    min_replicated_bytes: int = 1 << 20
+    max_replicated_bytes: int = 0
+    # rule ids / finding-key prefixes to suppress
+    suppress: List[str] = dataclasses.field(default_factory=list)
+    baseline: Optional[str] = None
+
+    @classmethod
+    def from_config(cls, config) -> "AnalysisSettings":
+        a = getattr(config, "analysis", None)
+        if a is None:
+            return cls()
+        return cls(min_collective_bytes=a.min_collective_bytes,
+                   expect_collectives=dict(a.expect_collectives),
+                   min_donation_bytes=a.min_donation_bytes,
+                   min_upcast_bytes=a.min_upcast_bytes,
+                   min_replicated_bytes=a.min_replicated_bytes,
+                   max_replicated_bytes=a.max_replicated_bytes,
+                   suppress=list(a.suppress),
+                   baseline=a.baseline)
+
+
+# --------------------------------------------------------------------------
+
+class CollectiveAudit:
+    """Kind policy + optional exact count pin over the collective census."""
+
+    rule_forbidden = "collective-forbidden-kind"
+    rule_missing = "collective-missing"
+
+    def __init__(self, policy: CollectivePolicy):
+        self.policy = policy
+
+    def analyze(self, art, settings: AnalysisSettings,
+                ops=None) -> List[Finding]:
+        # callers that already parsed the module (lint.analyze_programs
+        # reuses the ops for the report census) pass them in — the optimized
+        # HLO of a real model is tens of MB, one regex pass is enough
+        if ops is None:
+            ops = hlo_parse.parse_collectives(art.optimized_hlo)
+        large = hlo_parse.collective_census(ops,
+                                            settings.min_collective_bytes)
+        full = hlo_parse.collective_census(ops)
+        findings = []
+        for kind, c in sorted(large.items()):
+            if kind not in self.policy.allowed:
+                findings.append(Finding(
+                    rule=self.rule_forbidden, program=art.name, ident=kind,
+                    nbytes=c["bytes"],
+                    message=(f"{c['count']} {kind} op(s) moving "
+                             f"{c['bytes']} bytes, but this config allows "
+                             f"{sorted(self.policy.allowed) or 'none'} "
+                             f"({self.policy.reason})"),
+                    data={"census": c,
+                          "allowed": sorted(self.policy.allowed)}))
+        # presence checks run against the full census: the required op may
+        # legitimately be small (tiny shard sizes in tests). Synthetic
+        # single-purpose programs (corpus) opt out — the policy's required
+        # ops describe a full train step, not a fragment.
+        required = () if art.meta.get("skip_required") else self.policy.required
+        for group in required:
+            if not any(k in full for k in group):
+                findings.append(Finding(
+                    rule=self.rule_missing, program=art.name,
+                    ident="|".join(group), severity="warning",
+                    message=(f"expected at least one of {list(group)} "
+                             f"({self.policy.reason}) but the compiled "
+                             "program has none — the config's parallelism "
+                             "may not have materialized"),
+                    data={"required": list(group),
+                          "present": sorted(full)}))
+        if settings.expect_collectives:
+            findings.extend(compare_census(
+                full, settings.expect_collectives, art.name,
+                source="config analysis.expect_collectives"))
+        return findings
+
+
+class DonationLint:
+    """Each donatable state leaf must appear in the compiled module's
+    input_output_alias map (state is argument 0, so its leaves are entry
+    parameters 0..N-1 in jit flattening order)."""
+
+    rule = "donation-missing"
+
+    def analyze(self, art, settings: AnalysisSettings) -> List[Finding]:
+        if not art.donation_expected or not art.donatable_paths:
+            return []
+        donated = set(hlo_parse.parse_donated_params(art.optimized_hlo))
+        # the pre-XLA view: which args jit marked donatable at all —
+        # distinguishes "never donated" (fix donate_argnums) from "donation
+        # requested but XLA could not honor it" (fix the output
+        # shape/layout so the buffer is reusable)
+        requested = set(hlo_parse.parse_aliased_args_stablehlo(art.stablehlo))
+        findings = []
+        for idx, (path, nbytes) in enumerate(
+                zip(art.donatable_paths, art.donatable_bytes)):
+            if idx in donated or nbytes < settings.min_donation_bytes:
+                continue
+            if idx in requested:
+                why = ("donation was requested but XLA could not honor it — "
+                       "make the output reuse the input's shape/dtype/layout")
+            elif requested:
+                why = "it was never marked donatable — check donate_argnums"
+            else:  # no stablehlo text or no aliasing attrs at all
+                why = ("check donate_argnums and that the output reuses the "
+                       "input's shape/layout")
+            findings.append(Finding(
+                rule=self.rule, program=art.name, ident=path, nbytes=nbytes,
+                message=(f"state buffer {path} ({nbytes} bytes) is not "
+                         "aliased input->output — it is held live alongside "
+                         f"its updated copy (double memory at peak); {why}"),
+                data={"arg_index": idx,
+                      "donation_requested": idx in requested}))
+        return findings
+
+
+class DtypePromotionLint:
+    """bf16/f16 programs must not widen big tensors to f32: an f32 copy of
+    an activation-sized tensor doubles its HBM footprint and bandwidth."""
+
+    rule = "dtype-upcast"
+
+    def analyze(self, art, settings: AnalysisSettings) -> List[Finding]:
+        if art.compute_dtype not in ("bf16", "f16"):
+            return []
+        ups = hlo_parse.parse_upcasts(art.optimized_hlo,
+                                      settings.min_upcast_bytes)
+        findings = []
+        seen = set()
+        for up in ups:
+            if up.shape in seen:  # one finding per distinct widened shape
+                continue
+            seen.add(up.shape)
+            count = sum(1 for u in ups if u.shape == up.shape)
+            findings.append(Finding(
+                rule=self.rule, program=art.name, ident=up.shape,
+                nbytes=up.nbytes,
+                message=(f"{count} convert(s) widen {up.from_dtype} to "
+                         f"{up.shape} ({up.nbytes} bytes) in a "
+                         f"{art.compute_dtype} program — an intended master/"
+                         "loss-path upcast belongs in the baseline; anything "
+                         "else is paying f32 bandwidth for a "
+                         f"{art.compute_dtype} model"),
+                data={"count": count, "from": up.from_dtype}))
+        return findings
+
+
+class ReplicationBudget:
+    """Explicitly-replicated float tensors >= the floor must fit the
+    config's byte budget."""
+
+    rule = "replication-over-budget"
+
+    def analyze(self, art, settings: AnalysisSettings) -> List[Finding]:
+        if art.meta.get("world_size", 2) <= 1:
+            # on a single device every tensor is trivially "replicated" —
+            # the budget only means something across >= 2 devices
+            return []
+        text = art.pre_hlo or art.stablehlo
+        if not text:
+            return []
+        hits = hlo_parse.replicated_tensor_bytes(
+            text, settings.min_replicated_bytes)
+        if art.meta.get("params_replicated_by_design"):
+            # ZeRO stages 0-2 replicate parameters on purpose; only computed
+            # tensors (resharding, broadcasts) count against the budget.
+            # Filter DECLARATION lines only ("%argN :" / "parameter(") — an
+            # op merely referencing an argument operand ("(%arg0)") is a
+            # computed tensor and stays in scope
+            hits = [(b, l) for b, l in hits
+                    if " parameter(" not in l
+                    and not re.search(r"%arg\d+\s*:", l)]
+        total = sum(b for b, _ in hits)
+        if total <= settings.max_replicated_bytes:
+            return []
+        worst = hits[0]
+        return [Finding(
+            rule=self.rule, program=art.name,
+            ident=f"total={total}", nbytes=total,
+            message=(f"{len(hits)} replicated tensor(s) totalling {total} "
+                     f"bytes exceed the budget of "
+                     f"{settings.max_replicated_bytes} bytes (largest: "
+                     f"{worst[0]} bytes — `{worst[1][:120]}`); shard it or "
+                     "raise analysis.max_replicated_bytes"),
+            data={"tensors": [{"bytes": b, "line": l} for b, l in hits[:8]],
+                  "budget": settings.max_replicated_bytes})]
+
+
+def default_analyzers(policy: CollectivePolicy):
+    return [CollectiveAudit(policy), DonationLint(), DtypePromotionLint(),
+            ReplicationBudget()]

@@ -1,0 +1,196 @@
+"""Seeded known-bad programs/configs the lint MUST flag.
+
+Each entry builds a program with exactly one planted defect and returns the
+lint Report; tests assert the right rule fires (and the CLI exposes them via
+``--corpus`` so the gate itself can be exercised end-to-end). This is the
+regression floor for the analyzers: a parser change that stops flagging any
+of these is a lint escape, not a cleanup.
+"""
+
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.analysis.analyzers import AnalysisSettings
+from deepspeed_tpu.analysis.lint import analyze_programs, run_lint
+from deepspeed_tpu.analysis.program import abstractify, lower_program
+
+
+def _mesh2(devices=None):
+    import jax
+    from jax.sharding import Mesh
+    devs = devices or jax.devices()[:2]
+    if len(devs) < 2:
+        raise SystemExit("corpus: needs >= 2 devices "
+                         "(--xla_force_host_platform_device_count)")
+    return Mesh(list(devs)[:2], ("data",))
+
+
+class _FakePlan:
+    """Just enough MeshPlan surface for expectations/report metadata."""
+    data, fsdp, tensor, pipe, expert, seq = 2, 1, 1, 1, 1, 1
+    world_size = 2
+
+    def describe(self):
+        return "corpus[data=2]"
+
+
+def _stage0_config():
+    from deepspeed_tpu.config import Config
+    return Config.load({"train_batch_size": 4,
+                        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                        "bf16": {"enabled": False}})
+
+
+def undonated_state(devices=None):
+    """Donation lint: an optimizer-like step compiled WITHOUT donating its
+    state — every big state buffer held live twice."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh2(devices)
+    repl = NamedSharding(mesh, P())
+    state = {"params": {"w": jax.ShapeDtypeStruct((256, 256), jnp.float32,
+                                                  sharding=repl)},
+             "opt": {"m": jax.ShapeDtypeStruct((256, 256), jnp.float32,
+                                               sharding=repl)}}
+
+    def step(state, lr):
+        w, m = state["params"]["w"], state["opt"]["m"]
+        m2 = 0.9 * m + w
+        return {"params": {"w": w - lr * m2}, "opt": {"m": m2}}
+
+    # the defect: no donate_argnums — the reference equivalent is an fp16
+    # optimizer that keeps both param copies resident
+    jitted = jax.jit(step)
+    art = lower_program(jitted, state, jax.ShapeDtypeStruct((), jnp.float32),
+                        name="undonated_step", mesh=mesh, donatable=state,
+                        meta={"skip_required": True})
+    return analyze_programs([art], _stage0_config(), _FakePlan(),
+                            settings=AnalysisSettings())
+
+
+def extra_collective(devices=None):
+    """Collective audit: a data-parallel grad step with ONE gratuitous extra
+    all-reduce (a replicated batch statistic nobody asked for) — the census
+    pin catches what no structural rule can."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh2(devices)
+    repl = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    w_abs = jax.ShapeDtypeStruct((128, 128), jnp.float32, sharding=repl)
+    x_abs = jax.ShapeDtypeStruct((8, 128), jnp.float32, sharding=row)
+
+    def grads(w, x):
+        loss = lambda w_: jnp.sum((x @ w_) ** 2)
+        g = jax.grad(loss)(w)          # batch-sharded x -> one all-reduce
+        extra = jnp.sum(x, axis=0)     # the silent extra: replicated [128]
+        return g, g[0, 0] + 1e-12 * jnp.sum(extra)
+
+    jitted = jax.jit(grads, out_shardings=(repl, repl))
+    art = lower_program(jitted, w_abs, x_abs, name="grad_step", mesh=mesh,
+                        donatable=None, donation_expected=False,
+                        meta={"skip_required": True})
+    # the clean program compiles to exactly one all-reduce; pin it
+    return analyze_programs(
+        [art], _stage0_config(), _FakePlan(),
+        settings=AnalysisSettings(expect_collectives={"all-reduce": 1}))
+
+
+def f32_upcast(devices=None):
+    """Dtype lint: a bf16 program that MATERIALIZES a >=1MiB f32 widening
+    of an activation (a fused elementwise convert would be fine — the lint
+    only flags top-level converts that allocate the f32 buffer)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss(x):
+        big = x.astype(jnp.float32)    # the defect: 512*512*4 = 1 MiB copy
+        return jnp.sum(big * big), big  # returning it forces materialization
+
+    x_abs = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
+    art = lower_program(jax.jit(loss), x_abs, name="bf16_loss",
+                        donatable=None, donation_expected=False,
+                        compute_dtype="bf16", meta={"skip_required": True})
+    return analyze_programs([art], _stage0_config(), _FakePlan(),
+                            settings=AnalysisSettings())
+
+
+def replicated_budget(devices=None):
+    """Replication budget: a >=1MiB tensor pinned to a replicated sharding
+    on a 2-device mesh (the double-memory mistake the old
+    replicated_tensor_bytes scan caught)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh2(devices)
+    row = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+
+    def f(x):
+        y = x * 2.0
+        # the defect: force full replication of an activation-sized tensor
+        return jax.lax.with_sharding_constraint(y, repl)
+
+    x_abs = jax.ShapeDtypeStruct((512, 512), jnp.float32, sharding=row)
+    art = lower_program(jax.jit(f), x_abs, name="replicated_step", mesh=mesh,
+                        donatable=None, donation_expected=False,
+                        meta={"skip_required": True})
+    return analyze_programs([art], _stage0_config(), _FakePlan(),
+                            settings=AnalysisSettings())
+
+
+def census_drift(devices=None):
+    """Config-level: a real ZeRO-2 engine audited against a census pin that
+    doesn't match it (the 'somebody changed the program' CI failure)."""
+    config = {
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": False},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"axes": {"data": 2}},
+        # seeded defect: the pin claims stage-0 shape (all-reduce only)
+        "analysis": {"expect_collectives": {"all-reduce": 23}},
+    }
+    import jax
+    return run_lint(config, devices=list(jax.devices())[:2])
+
+
+class NoisyLossModel:
+    """A model wrapper whose loss adds a term that forces one extra dense
+    cross-replica reduction — the classic silently-added allreduce, planted
+    at the model level so the full engine pipeline compiles it."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.name = inner.name + "-noisy"
+        self.config = getattr(inner, "config", None)
+        self.init = inner.init
+        self.logical_axes = inner.logical_axes
+
+    def loss_fn(self, params, batch, rng, deterministic):
+        import jax.numpy as jnp
+        loss = self._inner.loss_fn(params, batch, rng, deterministic)
+        # mean over the (data-sharded) batch dim -> replicated [S] result:
+        # GSPMD must insert an extra all-reduce to materialize it
+        extra = jnp.mean(batch["input_ids"].astype(jnp.float32), axis=0)
+        return loss + 1e-12 * jnp.sum(extra)
+
+
+CORPUS = {
+    "undonated-state": undonated_state,
+    "extra-collective": extra_collective,
+    "f32-upcast": f32_upcast,
+    "replicated-budget": replicated_budget,
+    "census-drift": census_drift,
+}
+
+
+def run_corpus(name: str, devices=None):
+    """Run one seeded entry; the returned Report must NOT be ok."""
+    try:
+        fn = CORPUS[name]
+    except KeyError:
+        raise SystemExit(f"unknown corpus entry '{name}' — one of "
+                         f"{sorted(CORPUS)}")
+    return fn(devices)

@@ -1,0 +1,111 @@
+"""Expected collective census per engine config.
+
+Reference analogue: SURVEY's ZeRO table — the reference *documents* which
+collectives each stage should issue (stage 1: allreduce grads + allgather
+params; stage 2: reduce-scatter; stage 3: + param allgather) but nothing
+enforces it: a hand-rolled extra allreduce ships silently. Here the stages
+are sharding specs and GSPMD chooses the collectives, so the expectation is
+a *policy over op kinds* the compiled program may/must contain:
+
+- **allowed**: kinds a gradient-sized collective may be. Anything else is a
+  mis-sharding (e.g. a dense all-reduce in the 1-bit compressed phase, or an
+  all-gather in a pure stage-0 program).
+- **required**: groups of alternatives, at least one member of each group
+  must appear. Alternatives matter because XLA lowers the same resharding
+  differently per backend (reduce-scatter may materialize as all-to-all on
+  CPU, reduce-scatter on TPU).
+
+Exact-count pinning (the sharpest gate) lives in config
+``analysis.expect_collectives`` / baselines, not here — counts depend on
+model shape and XLA version; kind policy depends only on the parallelism
+plan.
+"""
+
+import dataclasses
+from typing import FrozenSet, List, Tuple
+
+ALL_KINDS = frozenset(("all-reduce", "all-gather", "reduce-scatter",
+                       "all-to-all", "collective-permute"))
+
+# reshard/scatter alternatives: how XLA may realize a grad reduce-scatter
+_SCATTERISH = ("reduce-scatter", "all-to-all", "all-reduce")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectivePolicy:
+    allowed: FrozenSet[str]                  # kinds large collectives may be
+    required: Tuple[Tuple[str, ...], ...]    # each group: >=1 must appear
+    reason: str                              # human explanation for reports
+
+
+def expected_collectives(config, plan, *, onebit_phase=None) -> CollectivePolicy:
+    """Kind policy for the engine's train-step program under `config`/`plan`.
+
+    onebit_phase: None for the dense GSPMD step; "warm"/"comp" for the 1-bit
+    shard_map programs (the compressed phase is the one with teeth: a
+    gradient-sized dense all-reduce there defeats the algorithm).
+    """
+    if plan.world_size <= 1:
+        return CollectivePolicy(
+            allowed=frozenset(), required=(),
+            reason="single device: no collectives expected at all")
+
+    stage = config.zero_optimization.stage
+    allowed = set()
+    required: List[Tuple[str, ...]] = []
+    why: List[str] = []
+
+    if onebit_phase == "comp":
+        # packed sign bits all-gather over `data`; dense grad reduction is
+        # exactly what this phase exists to avoid
+        return CollectivePolicy(
+            allowed=frozenset({"all-gather"}),
+            required=(("all-gather",),),
+            reason="1-bit compressed phase: only the packed-sign all-gather "
+                   "may move gradient-sized data")
+
+    dp = plan.data * plan.fsdp
+    if dp > 1 or onebit_phase == "warm":
+        if stage == 0:
+            allowed |= {"all-reduce"}
+            required.append(("all-reduce",))
+            why.append("stage 0: dense grad all-reduce only")
+        elif stage == 1:
+            allowed |= {"all-reduce", "all-gather"}
+            required.append(("all-reduce",))
+            required.append(("all-gather",))
+            why.append("stage 1: grad all-reduce + updated-shard all-gather")
+        elif stage == 2:
+            allowed |= {"all-reduce", "all-gather", "reduce-scatter",
+                        "all-to-all"}
+            required.append(_SCATTERISH)
+            required.append(("all-gather",))
+            why.append("stage 2: grads reduce-scattered (backend may lower "
+                       "as all-to-all), params re-gathered")
+        else:  # stage 3
+            allowed |= {"all-reduce", "all-gather", "reduce-scatter",
+                        "all-to-all", "collective-permute"}
+            required.append(("all-gather",))
+            required.append(_SCATTERISH)
+            why.append("stage 3: param all-gather on use + grad "
+                       "reduce-scatter")
+
+    if plan.tensor > 1:
+        allowed |= {"all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all"}
+        why.append("tensor parallel: activation partial-sum reductions")
+    if plan.expert > 1:
+        allowed |= {"all-to-all", "all-reduce"}
+        required.append(("all-to-all",))
+        why.append("MoE: token dispatch/combine all-to-all")
+    if plan.pipe > 1:
+        allowed |= {"collective-permute", "all-reduce"}
+        required.append(("collective-permute",))
+        why.append("pipeline: stage-to-stage ppermute + loss/grad psum")
+    if plan.seq > 1:
+        allowed |= {"collective-permute", "all-gather", "all-to-all"}
+        why.append("sequence parallel: ring-attention permutes")
+
+    return CollectivePolicy(allowed=frozenset(allowed),
+                            required=tuple(required),
+                            reason="; ".join(why) or "no parallel axes")

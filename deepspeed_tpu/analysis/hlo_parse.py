@@ -1,0 +1,247 @@
+"""Textual parsers over lowered / compiled XLA programs.
+
+Reference analogue: none — DeepSpeed has no compiler artifact to parse; its
+collectives are imperative NCCL calls and the only audit trail is a wire
+sniffer (comms_logger). Here every step is a compiled HLO module whose text
+names every collective with its shape, every input/output buffer alias
+(donation), and every dtype conversion — so lints can be plain parsers.
+
+Three program representations matter (analysis/program.py produces them):
+
+- **optimized HLO** (``compiled.as_text()``): post-GSPMD, post-fusion. The
+  collectives that will actually hit the ICI live here, as do the
+  ``input_output_alias`` entries that realize buffer donation.
+- **pre-optimization HLO** (``lowered.as_text(dialect="hlo")``): still
+  carries explicit ``sharding={...}`` annotations — the replication scan
+  reads these.
+- **StableHLO** (``lowered.as_text()``): per-argument ``tf.aliasing_output``
+  and ``mhlo.sharding`` attributes.
+"""
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+# HLO primitive byte widths (token/opaque types are skipped).
+ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+# `all-reduce(` / `all-gather-start(` — requires the open paren so operand
+# references (`%all-reduce.16`) and op_name metadata (underscored) don't match
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(-start|-done)?\(")
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(dtype: str, dims_csv: str) -> int:
+    """Bytes of one HLO shape token, e.g. ("f32", "2,32,32") -> 8192."""
+    n = 1
+    for d in dims_csv.split(","):
+        if d:
+            n *= int(d)
+    return n * ITEMSIZE.get(dtype, 0)
+
+
+def result_bytes(result_text: str) -> int:
+    """Total bytes of an op's result type text — handles tuples
+    ``(f32[16]{0}, f32[16]{0})`` and plain ``f32[2,32]{1,0}``."""
+    return sum(shape_bytes(dt, dims)
+               for dt, dims in _SHAPE_RE.findall(result_text))
+
+
+@dataclass
+class CollectiveOp:
+    kind: str            # all-reduce | all-gather | ...
+    nbytes: int          # result bytes (sum over tuple elements)
+    line: str            # the defining HLO line (trimmed)
+    is_async: bool = False
+
+
+def parse_collectives(optimized_hlo: str) -> List[CollectiveOp]:
+    """Every collective op in a compiled module, with result byte sizes.
+
+    Async pairs count once (the ``-start`` carries the shape; the ``-done``
+    is skipped). ``-start`` ops return a tuple wrapping the in-flight
+    operand alongside the result (plus u32 contexts for permutes), so for
+    those the op size is the LARGEST tuple element, not the sum — summing
+    would double-count every async collective. Plain variadic ops (an
+    all-reduce over N grad buffers) do sum their elements. Ops inside
+    fusions/while bodies appear in the text and are counted — an op in a
+    scanned loop body is ONE static site.
+    """
+    out = []
+    for line in optimized_hlo.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m or m.group(2) == "-done":
+            continue
+        head = line[:m.start()]
+        if "=" not in head:
+            continue  # operand continuation line, not a definition
+        result_text = head.split("=", 1)[1]
+        is_async = m.group(2) == "-start"
+        sizes = [shape_bytes(dt, dims)
+                 for dt, dims in _SHAPE_RE.findall(result_text)]
+        nbytes = (max(sizes) if is_async and len(sizes) > 1
+                  else sum(sizes)) if sizes else 0
+        out.append(CollectiveOp(kind=m.group(1), nbytes=nbytes,
+                                line=line.strip()[:240], is_async=is_async))
+    return out
+
+
+def collective_census(ops: List[CollectiveOp],
+                      min_bytes: int = 0) -> Dict[str, Dict[str, int]]:
+    """Aggregate: {kind: {"count": n, "bytes": total}} for ops >= min_bytes."""
+    census: Dict[str, Dict[str, int]] = {}
+    for op in ops:
+        if op.nbytes < min_bytes:
+            continue
+        c = census.setdefault(op.kind, {"count": 0, "bytes": 0})
+        c["count"] += 1
+        c["bytes"] += op.nbytes
+    return census
+
+
+# --------------------------------------------------------------------------
+# Donation (input/output buffer aliasing)
+# --------------------------------------------------------------------------
+
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(?:may-alias|must-alias)\)")
+
+
+def parse_donated_params(optimized_hlo: str) -> List[int]:
+    """Entry-parameter numbers that alias an output buffer (i.e. whose
+    donation XLA actually honored). Parsed from the module header's
+    ``input_output_alias={ {out}: (param, {path}, may-alias), ... }``."""
+    m = _ALIAS_BLOCK_RE.search(optimized_hlo)
+    if not m:
+        return []
+    # the alias map lives on the HloModule header line
+    header = optimized_hlo[m.end():optimized_hlo.index("\n", m.end())]
+    return sorted({int(p) for p in _ALIAS_ENTRY_RE.findall(header)})
+
+
+_ARG_DECL_RE = re.compile(r"%arg(\d+)\s*:")
+
+
+def parse_aliased_args_stablehlo(stablehlo: str) -> List[int]:
+    """Argument positions carrying ``tf.aliasing_output`` in StableHLO text —
+    the donation view *before* XLA decides what it can honor.
+
+    Attribution is per-argument: the text is sliced between consecutive
+    ``%argN:`` declarations so a later argument's attribute dict (which may
+    contain commas and quoted braces) is never charged to an earlier one.
+    """
+    decls = list(_ARG_DECL_RE.finditer(stablehlo))
+    out = set()
+    for i, m in enumerate(decls):
+        end = decls[i + 1].start() if i + 1 < len(decls) else len(stablehlo)
+        segment = stablehlo[m.end():end]
+        # the last arg's slice runs into the body; attrs end at the result
+        # arrow, and tf.aliasing_output only ever appears in the signature
+        arrow = segment.find("->")
+        if arrow != -1:
+            segment = segment[:arrow]
+        if "tf.aliasing_output" in segment:
+            out.add(int(m.group(1)))
+    return sorted(out)
+
+
+# --------------------------------------------------------------------------
+# Dtype promotion
+# --------------------------------------------------------------------------
+
+@dataclass
+class ConvertOp:
+    to_dtype: str
+    from_dtype: str
+    nbytes: int          # bytes of the widened result
+    shape: str           # e.g. "f32[4,16,64]"
+    line: str
+
+
+_CONVERT_RE = re.compile(
+    r"=\s*(f32|f64)\[([\d,]*)\][^ ]*\s+convert\((bf16|f16)\[")
+_COMPUTATION_HEADER_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*(?:\()")
+
+
+def parse_upcasts(hlo_text: str, min_bytes: int = 0) -> List[ConvertOp]:
+    """Widening converts (bf16/f16 -> f32/f64) with result bytes >=
+    min_bytes, in optimized HLO.
+
+    Only TOP-LEVEL converts (entry / while-body / conditional computations)
+    count: a convert inside a ``%fused_computation`` body is elementwise
+    inside one kernel and never materializes the f32 buffer — flagging it
+    would indict every fused softmax/grad cast a bf16 model intends.
+    """
+    out = []
+    in_fusion = False
+    for line in hlo_text.splitlines():
+        if not line.startswith(" "):  # computation header at column 0
+            m = _COMPUTATION_HEADER_RE.match(line)
+            if m:
+                in_fusion = "fused_" in m.group(2)
+            continue
+        if in_fusion:
+            continue
+        m = _CONVERT_RE.search(line)
+        if not m:
+            continue
+        to_dt, dims, from_dt = m.groups()
+        nb = shape_bytes(to_dt, dims)
+        if nb < min_bytes:
+            continue
+        out.append(ConvertOp(to_dtype=to_dt, from_dtype=from_dt, nbytes=nb,
+                             shape=f"{to_dt}[{dims}]",
+                             line=line.strip()[:240]))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Replication scan (absorbed from utils/hlo_check.replicated_tensor_bytes)
+# --------------------------------------------------------------------------
+
+# HLO:        sharding={replicated}
+# StableHLO:  mhlo.sharding = "{replicated}"
+_REPLICATED_RE = re.compile(
+    r'sharding\s*=\s*(?:"?\{replicated\}"?|\{\{replicated\}\})')
+# anchored on '=' so only the RESULT shape is charged — matching operand
+# shapes would bill a big sharded input to a tiny replicated result
+_FLOAT_SHAPE_RE = re.compile(r"=\s*(f32|bf16|f16|f64)\[([\d,]+)\]")
+_FLOAT_SHAPE_ST_RE = re.compile(r"tensor<([\dx]+)x(f32|bf16|f16|f64)>")
+
+
+def replicated_tensor_bytes(hlo_text: str,
+                            min_bytes: int = 1 << 20) -> List[Tuple[int, str]]:
+    """Scan HLO (or StableHLO) text for explicitly replicated float tensors
+    larger than min_bytes. Returns (bytes, line) tuples, largest first.
+
+    Complements the runtime SPMD-warning capture (analysis.program): the
+    warning catches the partitioner's resharding *fallback*; this catches ops
+    that were *assigned* a replicated sharding for activation-sized tensors.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        if not _REPLICATED_RE.search(line):
+            continue
+        nbytes = 0
+        m = _FLOAT_SHAPE_RE.search(line)
+        if m:
+            nbytes = shape_bytes(m.group(1), m.group(2))
+        else:
+            st = _FLOAT_SHAPE_ST_RE.search(line)
+            if st:
+                dims, dt = st.groups()
+                nbytes = shape_bytes(dt, dims.replace("x", ","))
+        if nbytes >= min_bytes:
+            out.append((nbytes, line.strip()[:200]))
+    return sorted(out, key=lambda t: -t[0])

@@ -62,6 +62,15 @@ class ResourceManager:
     or via the pdsh multinode runner otherwise, writing the result JSON
     into ``results_dir/exp_<id>/result.json`` exactly like the reference's
     per-experiment directories.
+
+    **Shared-filesystem requirement**: remotely-launched experiments write
+    ``result.json`` under ``results_dir`` *on the remote host*, and
+    ``_collect`` reads that same path *on this host* — so for multi-host
+    pools ``results_dir`` must live on storage every host mounts (NFS /
+    gcsfuse; TPU pods already mount one for checkpoints). With a local-only
+    results_dir every remote experiment reports "no result file". Pass a
+    custom ``launch`` that fetches results over its own transport to lift
+    the requirement.
     """
 
     def __init__(self, hosts: List[str], chips_per_host: int = 4,
@@ -82,6 +91,10 @@ class ResourceManager:
         os.makedirs(d, exist_ok=True)
         return d
 
+    @staticmethod
+    def _is_local(hosts: List[str]) -> bool:
+        return set(hosts) <= {"localhost", "127.0.0.1", os.uname().nodename}
+
     def _launch_default(self, exp: Experiment):
         d = self._exp_dir(exp)
         cfg_path = os.path.join(d, "config.json")
@@ -90,8 +103,7 @@ class ResourceManager:
             json.dump(exp.config, f)
         script = [sys.executable, "-m", "deepspeed_tpu.autotuning.experiment",
                   cfg_path, out_path]
-        local = set(exp.hosts) <= {"localhost", "127.0.0.1",
-                                   os.uname().nodename}
+        local = self._is_local(exp.hosts)
         if local:
             self._procs[exp.exp_id] = subprocess.Popen(
                 script, stdout=subprocess.DEVNULL,
@@ -115,7 +127,18 @@ class ResourceManager:
             exp.error = exp.result.get("error")
         else:
             exp.status = "failed"
-            exp.error = f"no result file (rc={rc})"
+            if exp.hosts and not self._is_local(exp.hosts):
+                # the most common cause is NOT the experiment failing but
+                # results_dir living on host-local storage (see class doc)
+                exp.error = (
+                    f"no result file at {out_path} (rc={rc}) — experiment "
+                    f"ran remotely on {exp.hosts}; results_dir "
+                    f"'{self.results_dir}' must be on a filesystem shared "
+                    "by every host (NFS/gcsfuse), or pass a custom launch "
+                    "that fetches results back")
+                logger.error(f"autotuning exp {exp.exp_id}: {exp.error}")
+            else:
+                exp.error = f"no result file (rc={rc})"
 
     def _done(self, exp: Experiment) -> bool:
         proc = self._procs.get(exp.exp_id)

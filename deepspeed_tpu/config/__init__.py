@@ -4,5 +4,6 @@ from deepspeed_tpu.config.config import (
     SequenceParallelConfig, MoEConfig, MeshConfig, ActivationCheckpointingConfig,
     FlopsProfilerConfig, CommsLoggerConfig, AIOConfig, CheckpointConfig,
     ElasticityConfig, AutotuningConfig, CurriculumConfig, CompressionConfig,
+    AnalysisConfig,
 )
 from deepspeed_tpu.config.config_utils import ConfigError, ConfigModel

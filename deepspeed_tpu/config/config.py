@@ -313,6 +313,28 @@ class AutotuningConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class AnalysisConfig(ConfigModel):
+    """graft-lint (``deepspeed_tpu/analysis``) knobs. TPU-native: the
+    reference has no compiled program to lint; its nearest relative is the
+    runtime ``comms_logger`` section. All thresholds are bytes."""
+    # collectives smaller than this are control-plane sync (loss scalars,
+    # overflow flags), exempt from the kind policy
+    min_collective_bytes: int = 1024
+    # exact census pin {op-kind: count}; any drift is an error. Empty = kind
+    # policy only (see analysis/expectations.py)
+    expect_collectives: Dict[str, int] = config_field({})
+    min_donation_bytes: int = 1024
+    min_upcast_bytes: int = 1 << 20
+    min_replicated_bytes: int = 1 << 20
+    max_replicated_bytes: int = 0
+    # finding keys / rule ids to suppress (accepted exceptions)
+    suppress: List[str] = config_field([])
+    # path to a baseline JSON (analysis.report.save_baseline): known
+    # findings are suppressed, recorded census becomes an exact pin
+    baseline: Optional[str] = None
+
+
+@dataclasses.dataclass
 class MeshConfig(ConfigModel):
     """TPU-native: explicit mesh override. By default the planner derives the
     mesh from world size and the parallelism degrees."""
@@ -372,6 +394,7 @@ class Config(ConfigModel):
     quantize_training: Dict[str, Any] = config_field({})
     elasticity: ElasticityConfig = config_field(ElasticityConfig)
     autotuning: AutotuningConfig = config_field(AutotuningConfig)
+    analysis: AnalysisConfig = config_field(AnalysisConfig)
 
     # ---------------------------------------------------------------------
     @classmethod

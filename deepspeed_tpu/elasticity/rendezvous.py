@@ -42,12 +42,26 @@ class FileRendezvous:
     def __init__(self, store_dir: str, host: str, *,
                  coordinator_port: int = 8476,
                  dead_after_s: float = 15.0,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
         self.store = store_dir
         self.host = host
         self.port = coordinator_port
         self.dead_after = dead_after_s
         self._clock = clock or time.time
+        # sleep must come from the same time source as the deadline checks:
+        # a full poll_s time.sleep under an injected fake clock either hangs
+        # (clock never advances) or insta-times-out (clock jumped past the
+        # deadline). With a fake clock and no injected sleep, yield a bounded
+        # 1ms real sleep per poll — the deadline logic stays on the fake
+        # clock, but the loop cannot busy-spin a core (or hammer the store
+        # with heartbeats) while another thread advances time.
+        if sleep is not None:
+            self._sleep = sleep
+        elif clock is None:
+            self._sleep = time.sleep
+        else:
+            self._sleep = lambda s: time.sleep(min(s, 0.001))
         self._beats = 0
         self._seen_gen = -1   # newest generation this member has acted on
         os.makedirs(store_dir, exist_ok=True)
@@ -67,15 +81,18 @@ class FileRendezvous:
 
     def live_hosts(self) -> List[str]:
         now = self._clock()
-        out = []
+        out = set()
         for fn in sorted(os.listdir(self.store)):
-            if not fn.startswith("hb_"):
+            # atomic-write temps (hb_<host>.json.tmp.<pid>) share the hb_
+            # prefix: counting one would duplicate a host (wrong world size,
+            # spurious reform)
+            if not fn.startswith("hb_") or ".tmp." in fn:
                 continue
             try:
                 with open(os.path.join(self.store, fn)) as f:
                     hb = json.load(f)
                 if now - float(hb["ts"]) <= self.dead_after:
-                    out.append(hb["host"])
+                    out.add(hb["host"])
             except (OSError, ValueError, KeyError):  # torn/partial write
                 continue
         return sorted(out)
@@ -85,8 +102,11 @@ class FileRendezvous:
         return os.path.join(self.store, f"gen_{n:08d}.json")
 
     def current_generation(self) -> Optional[Dict[str, Any]]:
+        # gen_N.json.tmp.<pid> sorts AFTER gen_N.json: reading a torn temp
+        # as "the newest manifest" would make this return None and let a
+        # leader republish generation 0 over existing history
         gens = sorted(fn for fn in os.listdir(self.store)
-                      if fn.startswith("gen_"))
+                      if fn.startswith("gen_") and ".tmp." not in fn)
         if not gens:
             return None
         try:
@@ -133,9 +153,14 @@ class FileRendezvous:
                         timeout_s: float = 60.0,
                         poll_s: float = 0.5) -> Dict[str, Any]:
         """Block until a manifest with generation >= min_generation exists.
-        Followers call this after noticing membership drift (or on join)."""
+        Followers call this after noticing membership drift (or on join).
+
+        Keeps heartbeating while blocked: a reform can take most of a
+        minute, and a follower that goes silent for dead_after_s would be
+        declared dead and excluded from the very generation it waits for."""
         deadline = self._clock() + timeout_s
         while True:
+            self.heartbeat()
             cur = self.current_generation()
             if cur is not None and cur["generation"] >= min_generation:
                 return cur
@@ -143,7 +168,7 @@ class FileRendezvous:
                 raise TimeoutError(
                     f"rendezvous: no generation >= {min_generation} within "
                     f"{timeout_s}s ({len(self.live_hosts())} live hosts)")
-            time.sleep(poll_s)
+            self._sleep(poll_s)
 
     def leave(self):
         """Graceful exit: drop the heartbeat so the next round excludes us."""

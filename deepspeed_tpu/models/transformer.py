@@ -1076,7 +1076,12 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     layers = layer_override if layer_override is not None else params["layers"]
 
     # per-layer local-attention windows ride the scan xs as a traced [L]
-    # operand (a static per-layer mask would force unrolling the stack)
+    # operand (a static per-layer mask would force unrolling the stack).
+    # COST: under scan every layer sees a traced window and takes the
+    # O(S^2) XLA attention path — including global (w=0) layers. For
+    # alternating-window models (GPT-Neo, Mistral-style) set
+    # scan_layers=False: the unrolled path below passes each layer its
+    # STATIC window, so global layers keep the flash/Pallas kernel.
     if cfg.attn_windows and len(cfg.attn_windows) != cfg.num_layers:
         raise ValueError(f"attn_windows has {len(cfg.attn_windows)} entries "
                          f"for {cfg.num_layers} layers")
@@ -1174,8 +1179,14 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
                 y, aux = ltd_step(x_c, layer_p)
                 carry, kv = (y, rng, aux_acc + aux), None
             else:
+                # unrolled layers take the STATIC per-layer window (0 ->
+                # None, as decode_step_suffix does) so global layers keep
+                # the flash/Pallas kernel instead of paying the windowed
+                # XLA path for a band mask they don't have
+                win_i = ((cfg.attn_windows[i] or None)
+                         if cfg.attn_windows else None)
                 carry, kv = body(
-                    carry, (layer_p, wins[i]) if wins is not None
+                    carry, (layer_p, win_i) if wins is not None
                     else layer_p)
             kvs.append(kv)
         x, aux_total = carry[0], carry[2]

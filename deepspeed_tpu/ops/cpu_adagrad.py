@@ -151,6 +151,11 @@ class CPUAdagrad:
         from deepspeed_tpu.ops.cpu_adam import CPUAdam  # noqa: F401
         from deepspeed_tpu.ops import cpu_adam as _ca
         lib = _ca._load()
+        if lib is None:
+            # the adagrad .so can build while the adam .so fails — same
+            # RuntimeError as the step path, not an AttributeError mid-swap
+            raise RuntimeError("native cpu_adam library unavailable "
+                               "(needed for the sq_norm kernels)")
         g = np.ascontiguousarray(grads).reshape(-1)
         if g.dtype == np.uint16:
             return float(lib.dstpu_sq_norm_bf16(

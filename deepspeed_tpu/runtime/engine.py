@@ -1380,6 +1380,27 @@ class Engine:
         with self.mesh:
             return self._eval_step(self.state, batch)
 
+    def audit(self, batch=None, *, settings=None, raise_on_findings=False):
+        """Static analysis of this engine's own compiled step programs
+        (graft-lint, ``deepspeed_tpu/analysis``): lower the jitted steps on
+        abstract shapes — nothing executes — and check the collective
+        census, buffer donation, dtype promotion, and replication budget
+        against this config's expectations.
+
+        Reference analogue: none — DeepSpeed can only discover an extra
+        allreduce by watching the wire (comms_logger); here the compiled
+        program is inspected before a single step runs. Returns an
+        ``analysis.Report``; with raise_on_findings=True, raises
+        RuntimeError when any error-severity finding survives
+        suppression/baseline."""
+        self._activate_context()
+        from deepspeed_tpu.analysis import audit_engine
+        report = audit_engine(self, batch=batch, settings=settings)
+        if raise_on_findings and not report.ok:
+            raise RuntimeError("engine.audit found problems:\n"
+                               + report.summary())
+        return report
+
     # --- 3-call compatibility API (reference: forward:1652/backward:1794/step:1990)
     def forward(self, batch):
         """Compute loss+grads for one microbatch; grads are buffered until

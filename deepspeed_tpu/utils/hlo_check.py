@@ -1,100 +1,16 @@
-"""Compile-time SPMD hygiene checks.
+"""Compile-time SPMD hygiene checks — absorbed into ``deepspeed_tpu.analysis``.
 
-XLA's SPMD partitioner falls back to full replication when it cannot reshard
-a tensor efficiently ("Involuntary full rematerialization",
-spmd_partitioner.cc). At toy shapes that is a warning on stderr; at real
-shapes it is an activation-sized all-to-all + replicate in the hot loop.
-Reference analogue: DeepSpeed has no compiler to warn it — its equivalent
-failure is a silent extra allreduce; here we can make the compiler's warning
-a hard error.
-
-The warning is emitted by XLA's C++ logging directly on fd 2, invisible to
-Python's `warnings`/`logging`, so detection needs an fd-level capture around
-compilation.
+This module grew into the graft-lint static-analysis subsystem
+(``deepspeed_tpu/analysis/``): the fd-2 SPMD-warning capture lives in
+``analysis.program``, the replicated-tensor scan in ``analysis.hlo_parse``
+(promoted to a budgeted analyzer in ``analysis.analyzers``). Import from
+``deepspeed_tpu.analysis`` going forward; these re-exports keep old callers
+working.
 """
 
-import contextlib
-import os
-import re
-import sys
-import tempfile
+from deepspeed_tpu.analysis.hlo_parse import replicated_tensor_bytes
+from deepspeed_tpu.analysis.program import (assert_no_spmd_replication,
+                                            capture_spmd_warnings)
 
-# spmd_partitioner.cc fallback lines worth failing a build over.
-_SPMD_PATTERNS = (
-    "Involuntary full rematerialization",
-    "involuntary full rematerialization",
-)
-
-
-@contextlib.contextmanager
-def capture_spmd_warnings(matches: list):
-    """Capture fd-2 output (XLA C++ logs) while compiling; append any SPMD
-    full-rematerialization warning lines to `matches`.
-
-    Everything captured is re-emitted to the real stderr afterwards so no
-    diagnostics are swallowed. Use around `.lower().compile()` or the first
-    traced call of a jitted function.
-    """
-    sys.stderr.flush()
-    saved_fd = os.dup(2)
-    with tempfile.TemporaryFile(mode="w+b") as tmp:
-        os.dup2(tmp.fileno(), 2)
-        try:
-            yield matches
-        finally:
-            sys.stderr.flush()
-            os.dup2(saved_fd, 2)
-            os.close(saved_fd)
-            tmp.seek(0)
-            text = tmp.read().decode("utf-8", errors="replace")
-            if text:
-                sys.stderr.write(text)
-                sys.stderr.flush()
-            for line in text.splitlines():
-                if any(p in line for p in _SPMD_PATTERNS):
-                    matches.append(line)
-
-
-def assert_no_spmd_replication(compile_fn, *args, **kwargs):
-    """Run `compile_fn(*args, **kwargs)` (something that triggers XLA SPMD
-    compilation) and raise RuntimeError if the partitioner reported an
-    involuntary full rematerialization. Returns compile_fn's result."""
-    matches: list = []
-    with capture_spmd_warnings(matches):
-        result = compile_fn(*args, **kwargs)
-    if matches:
-        raise RuntimeError(
-            "XLA SPMD involuntary full rematerialization during compile "
-            f"({len(matches)} site(s)) — a tensor is being replicated in the "
-            "hot loop:\n" + "\n".join(matches[:8]))
-    return result
-
-
-_REPLICATED_RE = re.compile(r"sharding=\{replicated\}")
-_SHAPE_RE = re.compile(r"= (f32|bf16|f16)\[([\d,]+)\]")
-
-
-def replicated_tensor_bytes(hlo_text: str, min_bytes: int = 1 << 20):
-    """Scan compiled HLO text for explicitly replicated float tensors larger
-    than min_bytes. Returns a list of (bytes, line) tuples.
-
-    Complements capture_spmd_warnings: the warning catches the resharding
-    fallback; this catches ops that were *assigned* a replicated sharding for
-    activation-sized tensors.
-    """
-    itemsize = {"f32": 4, "bf16": 2, "f16": 2}
-    out = []
-    for line in hlo_text.splitlines():
-        if not _REPLICATED_RE.search(line):
-            continue
-        m = _SHAPE_RE.search(line)
-        if not m:
-            continue
-        dtype, dims = m.group(1), m.group(2)
-        n = 1
-        for d in dims.split(","):
-            n *= int(d)
-        nbytes = n * itemsize[dtype]
-        if nbytes >= min_bytes:
-            out.append((nbytes, line.strip()[:200]))
-    return out
+__all__ = ["assert_no_spmd_replication", "capture_spmd_warnings",
+           "replicated_tensor_bytes"]

@@ -77,3 +77,14 @@ def test_grad_scale_and_norm():
     opt2.load_master(np.zeros(n, np.float32))
     out2 = opt2.step(g, 1)
     np.testing.assert_allclose(out1, out2, rtol=1e-6, atol=1e-7)
+
+
+def test_adagrad_sq_norm_guard(monkeypatch):
+    """CPUAdagrad.sq_norm borrows the Adam lib's norm kernels; if the adam
+    .so build failed while the adagrad .so built, it must raise the same
+    RuntimeError as the step path — not AttributeError on None."""
+    from deepspeed_tpu.ops import cpu_adam as _ca
+    from deepspeed_tpu.ops.cpu_adagrad import CPUAdagrad
+    monkeypatch.setattr(_ca, "_load", lambda: None)
+    with pytest.raises(RuntimeError, match="cpu_adam library unavailable"):
+        CPUAdagrad.sq_norm(None, np.ones(8, np.float32))

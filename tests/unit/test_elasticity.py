@@ -1,6 +1,8 @@
 """Elasticity tests (reference: elasticity/elasticity.py + the reference's
 tests/unit/elasticity/test_elastic.py cases)."""
 
+import json
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -347,6 +349,53 @@ class TestRendezvous:
         a.heartbeat(); b.heartbeat()
         b.leave()
         assert a.live_hosts() == ["host-a"]
+
+    def test_atomic_write_temps_are_invisible(self, tmp_path):
+        """hb_*.json.tmp.<pid> / gen_*.json.tmp.<pid> share the scanned
+        prefixes: a complete-but-unrenamed heartbeat temp must not
+        double-count a host, and a torn gen temp (which sorts AFTER the
+        real manifest) must not hide the published generation."""
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        a.heartbeat(); b.heartbeat()
+        # a stalled writer left a COMPLETE heartbeat temp behind
+        (tmp_path / "hb_host-b.json.tmp.4242").write_text(
+            json.dumps({"host": "host-b", "beats": 9, "ts": 100.0}))
+        assert a.live_hosts() == ["host-a", "host-b"]  # not duplicated
+        m = a.propose_generation()
+        assert m["hosts"] == ["host-a", "host-b"]
+        # a torn manifest temp sorts last; current_generation must skip it
+        (tmp_path / "gen_00000000.json.tmp.4242").write_text("{\"trunc")
+        assert a.current_generation()["generation"] == 0
+        assert not a.should_reform()  # no spurious reform either
+
+    def test_wait_generation_keeps_heartbeating(self, tmp_path):
+        """A follower blocked in wait_generation must not be declared dead
+        mid-reform: the poll loop heartbeats, and the sleep comes from the
+        injectable clock (a real sleep under a fake clock hangs)."""
+        from deepspeed_tpu.elasticity import FileRendezvous
+        t = [100.0]
+        a = FileRendezvous(str(tmp_path), "host-a", dead_after_s=3.0,
+                           clock=lambda: t[0])
+        slept = []
+
+        def fake_sleep(s):
+            slept.append(s)
+            t[0] += s
+            if t[0] >= 108.0:   # leader publishes well past dead_after
+                a.heartbeat()
+                a.propose_generation()
+
+        b = FileRendezvous(str(tmp_path), "host-b", dead_after_s=3.0,
+                           clock=lambda: t[0], sleep=fake_sleep)
+        a.heartbeat(); b.heartbeat()
+        m = b.wait_generation(min_generation=0, timeout_s=60.0, poll_s=1.0)
+        # the wait spanned >> dead_after_s, yet host-b stayed live because
+        # the poll loop heartbeats — so it's IN the new generation
+        assert t[0] - 100.0 > b.dead_after
+        assert m["hosts"] == ["host-a", "host-b"]
+        assert slept and all(s == 1.0 for s in slept)
 
     def test_elastic_batch_plan_for_new_world(self, tmp_path):
         """The reform manifest feeds compute_elastic_config: the new world

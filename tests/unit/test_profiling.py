@@ -190,6 +190,21 @@ class TestExperimentScheduler:
         assert exps[0].exp_id == 1 and exps[0].status == "done"
         assert exps[1].status == "failed" and exps[1].error == "OOM"
 
+    def test_remote_missing_result_names_shared_fs(self, tmp_path):
+        """A remote experiment with no result file must say WHY it probably
+        failed: results_dir not on shared storage (the collect path is read
+        on the scheduler host)."""
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager
+
+        def fake_launch(exp):
+            pass  # "remote" run that writes nothing visible locally
+
+        rm = ResourceManager(["far-host-1"], results_dir=str(tmp_path),
+                             launch=fake_launch, poll_s=0.01)
+        exps = rm.schedule([{}])
+        assert exps[0].status == "failed"
+        assert "shared" in exps[0].error and "far-host-1" in exps[0].error
+
     @pytest.mark.slow
     def test_real_local_experiment_subprocess(self, tmp_path):
         """End-to-end: the default launcher runs the experiment MODULE as a
