@@ -118,6 +118,8 @@ def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable"):
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},
+        # async step pipeline: bounded dispatch window + input prefetch
+        "pipeline": {"in_flight": 4, "prefetch": True},
         "steps_per_print": 1000000,
     })
 
@@ -138,13 +140,29 @@ def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable"):
     engine.train_batch(make_batch())
     sync()
 
+    # async path (the headline step_ms): train_batches keeps
+    # pipeline.in_flight steps dispatched ahead with prefetched inputs; the
+    # trailing sync() makes the timing honest (blocked, not dispatch-only)
     t0 = time.perf_counter()
-    for _ in range(nsteps):
-        engine.train_batch(make_batch())
+    engine.train_batches((make_batch() for _ in range(nsteps)), nsteps)
     sync()
     dt = time.perf_counter() - t0
+
+    # per-step sync path (the pre-async behavior): fetch a metric after
+    # every step so each dispatch stalls on the previous step's round trip.
+    # step_ms_sync - step_ms is the dispatch stall the pipeline removed.
+    nsync = min(nsteps, 10)
+    t0 = time.perf_counter()
+    for _ in range(nsync):
+        m = engine.train_batch(make_batch())
+        float(np.asarray(jax.device_get(m["loss"])))
+    dt_sync = (time.perf_counter() - t0) / nsync
+    extras = {
+        "step_ms_sync": round(dt_sync * 1000, 2),
+        "dispatch_stall_ms": round((dt_sync - dt / nsteps) * 1000, 2),
+    }
     n = num_params(engine.state["params"])
-    return cfg, engine, n, dt
+    return cfg, engine, n, dt, extras
 
 
 def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
@@ -177,8 +195,8 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
     last_err = None
     for size, S, B in ladder:
         try:
-            cfg, engine, n_params, dt = _try_rung(size, S, B, nsteps, chunk=chunk,
-                                                  remat=remat)
+            cfg, engine, n_params, dt, extras = _try_rung(
+                size, S, B, nsteps, chunk=chunk, remat=remat)
         except Exception as e:  # noqa: BLE001 — OOM ladder fallback
             if _is_oom(e):
                 print(f"bench: llama-{size} seq={S} bs={B} OOM'd; stepping down",
@@ -197,6 +215,7 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
             "vs_baseline": round(mfu / 0.45, 4),
             "tokens_per_sec_per_chip": round(tok_per_sec / max(1, jax.device_count()), 1),
             "step_ms": round(dt / nsteps * 1000, 2),
+            **extras,
         }
         if on_tpu and not (quick or model_size):
             # the training engine (~90% of HBM with ZeRO state) must go
@@ -243,7 +262,7 @@ def _long_seq_bench(size: str, S: int = 8192, B: int = 2,
     """Long-context rung: same model trained at seq 8k (the blocked-KV flash
     kernel's VMEM residency is O(block), so sequence length is HBM-bound —
     the round-2 kernel capped out below this)."""
-    cfg, engine, n_params, dt = _try_rung(size, S, B, nsteps, chunk=1024)
+    cfg, engine, n_params, dt, _ = _try_rung(size, S, B, nsteps, chunk=1024)
     mfu = _mfu(cfg, n_params, B, S, nsteps, dt)
     del engine
     gc.collect()

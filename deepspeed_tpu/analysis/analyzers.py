@@ -114,9 +114,17 @@ class CollectiveAudit:
                     data={"required": list(group),
                           "present": sorted(full)}))
         if settings.expect_collectives:
+            # exact pins are PER STEP; a fused K-step program (unrolled
+            # loop, meta fuse_steps=K) must carry exactly K of each — fewer
+            # means a collective was hoisted out of the loop, more means one
+            # was duplicated into it
+            k = int(art.meta.get("fuse_steps", 1) or 1)
+            expected = {kind: n * k
+                        for kind, n in settings.expect_collectives.items()}
             findings.extend(compare_census(
-                full, settings.expect_collectives, art.name,
-                source="config analysis.expect_collectives"))
+                full, expected, art.name,
+                source="config analysis.expect_collectives"
+                       + (f" (x{k} fused steps)" if k > 1 else "")))
         return findings
 
 

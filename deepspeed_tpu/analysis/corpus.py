@@ -156,6 +156,49 @@ def census_drift(devices=None):
     return run_lint(config, devices=list(jax.devices())[:2])
 
 
+def fused_loop_hoist(devices=None):
+    """Collective audit: a fused K-step loop whose per-step grad all-reduce
+    was hoisted OUT of the unrolled loop — the K local updates diverge per
+    rank and only the final reduce papers over it. The per-step census pin
+    (scaled by meta fuse_steps=K, the same mechanics engine.train_batches'
+    fused program is audited with) expects K all-reduces and sees 1."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    K = 4
+    mesh = _mesh2(devices)
+    repl = NamedSharding(mesh, P())
+    w_abs = jax.ShapeDtypeStruct((128, 128), jnp.float32, sharding=repl)
+    xs_abs = jax.ShapeDtypeStruct((K, 8, 128), jnp.float32,
+                                  sharding=NamedSharding(mesh, P(None, "data")))
+
+    def per_device(w, xs):
+        # the defect: each unrolled step updates with the LOCAL gradient;
+        # the cross-replica mean runs once at the end instead of per step
+        for i in range(K):
+            g = jax.grad(lambda w_: jnp.sum((xs[i] @ w_) ** 2))(w)
+            w = w - 1e-3 * g
+        return lax.pmean(w, "data")   # 1 all-reduce where K belong
+
+    try:  # jax>=0.5 spelling, else the experimental module
+        fn = jax.shard_map(per_device, mesh=mesh,
+                           in_specs=(P(), P(None, "data")), out_specs=P(),
+                           axis_names={"data"}, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        fn = _sm(per_device, mesh=mesh,
+                 in_specs=(P(), P(None, "data")), out_specs=P(),
+                 check_rep=False)
+    art = lower_program(jax.jit(fn), w_abs, xs_abs, name="fused_step",
+                        mesh=mesh, donatable=None, donation_expected=False,
+                        meta={"skip_required": True, "fuse_steps": K})
+    # pin is PER STEP (one grad all-reduce); the audit scales it by K
+    return analyze_programs(
+        [art], _stage0_config(), _FakePlan(),
+        settings=AnalysisSettings(expect_collectives={"all-reduce": 1}))
+
+
 class NoisyLossModel:
     """A model wrapper whose loss adds a term that forces one extra dense
     cross-replica reduction — the classic silently-added allreduce, planted
@@ -183,6 +226,7 @@ CORPUS = {
     "f32-upcast": f32_upcast,
     "replicated-budget": replicated_budget,
     "census-drift": census_drift,
+    "fused-hoist": fused_loop_hoist,
 }
 
 

@@ -123,6 +123,26 @@ def lower_engine_programs(engine, batch=None) -> List[ProgramArtifacts]:
             engine._train_step, state_abs, batch_abs, rng_abs,
             name="train_step", mesh=engine.mesh,
             donatable=state_abs, compute_dtype=dtag, meta=meta))
+        k = int(getattr(engine.config.pipeline, "fuse_steps", 1) or 1)
+        if k > 1 and engine._can_fuse():
+            # same predicate train_batches uses: don't gate CI on a fused
+            # program the engine would refuse to dispatch (curriculum/LTD/
+            # PLD/MoQ configs fall back to single-step)
+            # the fused K-step program is a distinct compiled artifact: its
+            # census must be exactly Kx the single step's (a collective
+            # hoisted out of — or duplicated into — the unrolled loop is
+            # drift). CollectiveAudit scales exact pins by meta fuse_steps.
+            import numpy as np
+            stacked = jax.tree.map(
+                lambda x: np.stack([np.asarray(x)] * k), batch)
+            batches_abs = abstractify(engine._device_batches(stacked))
+            rngs_abs = jax.ShapeDtypeStruct(
+                (k,) + tuple(engine._rng.shape), engine._rng.dtype)
+            arts.append(lower_program(
+                engine._get_fused_step(k), state_abs, batches_abs, rngs_abs,
+                name="train_step_fused", mesh=engine.mesh,
+                donatable=state_abs, compute_dtype=dtag,
+                meta={**meta, "fuse_steps": k}))
     return arts
 
 
@@ -248,7 +268,12 @@ def main(argv=None) -> int:
         if args.baseline:
             settings = AnalysisSettings.from_config(cfg)
             settings.baseline = args.baseline
-        report = run_lint(cfg, settings=settings)
+        # honor --devices even when the backend has more (a pre-existing
+        # XLA_FLAGS device count is preserved by _ensure_cpu_devices):
+        # baselines/pins are per mesh size
+        import jax
+        report = run_lint(cfg, settings=settings,
+                          devices=list(jax.devices())[:args.devices])
 
     print(report.summary(), file=sys.stderr)
     if args.json_out:

@@ -150,6 +150,18 @@ class PipelineConfig(ConfigModel):
     micro_batches: Optional[int] = None   # defaults to gradient_accumulation_steps
     activation_checkpoint_interval: int = 0
     schedule: str = "1f1b"                # 1f1b | gpipe | interleaved
+    # --- async STEP pipeline (engine.train_batches; orthogonal to the
+    # stage-parallel knobs above). The reference hides dispatch behind CUDA
+    # streams; here XLA async dispatch does it — these bound/amplify it.
+    in_flight: int = 2       # dispatched-steps window train_batches keeps open
+    prefetch: bool = True    # double-buffered device_put of batch N+1
+    fuse_steps: int = 1      # K>1: unroll K optimizer steps into ONE dispatch
+
+    def validate(self):
+        if self.in_flight < 1:
+            raise ConfigError("pipeline.in_flight must be >= 1")
+        if self.fuse_steps < 1:
+            raise ConfigError("pipeline.fuse_steps must be >= 1")
 
 
 @dataclasses.dataclass

@@ -7,6 +7,7 @@ batch slicing per host in the multi-host case (each host yields its slice of
 the global batch; jax.make_array_from_process_local_data assembles it).
 """
 
+import collections
 import math
 from typing import Any, Callable, Iterable, Iterator, Optional
 
@@ -60,6 +61,65 @@ class DataLoader:
             idx = order[start:start + self.batch_size]
             rows = [self.dataset[int(i)] for i in idx]
             yield self.collate_fn(rows)
+
+
+class PrefetchLoader:
+    """Double-buffered device prefetch for the async step pipeline.
+
+    Wraps any host-batch iterable and starts the sharding-aware
+    ``device_put`` of batch N+1 while the consumer runs step N: JAX dispatch
+    is asynchronous, so ``put_fn`` returns as soon as the H2D transfer is
+    *queued* and the copy overlaps the in-flight step instead of sitting on
+    the dispatch critical path (the reference hides the same latency behind
+    a side CUDA stream).
+
+    ``put_fn`` is typically ``engine._device_batch`` — idempotent: a leaf
+    already placed with the target sharding passes through untouched, so the
+    engine's curriculum/LTD/PLD batch rewrites compose (a rewritten leaf is
+    simply re-placed at consume time).
+
+    ``depth=2`` is classic double buffering; higher depths only help when
+    batch production (collate) is burstier than one step. Batch ORDER is the
+    wrapped loader's order — prefetch reorders nothing, including across
+    epoch boundaries (``set_epoch``/``epoch`` proxy through).
+    """
+
+    def __init__(self, loader, put_fn: Callable[[Any], Any], depth: int = 2):
+        if put_fn is None:
+            raise ValueError("PrefetchLoader needs a device placement fn "
+                             "(engine._device_batch)")
+        self.loader = loader
+        self.put_fn = put_fn
+        self.depth = max(1, int(depth))
+
+    def __len__(self):
+        return len(self.loader)
+
+    @property
+    def epoch(self):
+        return getattr(self.loader, "epoch", 0)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __iter__(self) -> Iterator:
+        it = iter(self.loader)
+        buf = collections.deque()
+        try:
+            while len(buf) < self.depth:
+                buf.append(self.put_fn(next(it)))
+        except StopIteration:
+            pass
+        while buf:
+            out = buf.popleft()
+            # top up BEFORE yielding: the put of batch N+depth is queued
+            # while the consumer still holds (and then steps on) batch N
+            try:
+                buf.append(self.put_fn(next(it)))
+            except StopIteration:
+                pass
+            yield out
 
 
 class RepeatingLoader:

@@ -544,9 +544,13 @@ class Engine:
 
         # --- bookkeeping (reference: engine timers/monitor wiring)
         self.global_steps = 0
-        self.skipped_steps = 0
+        # host-side part of the skip counter: the jitted paths account
+        # skips in-graph (state["skipped"]); host-driven paths (NVMe
+        # swapper, layer-streamed executor) bump this offset directly
+        self._skipped_offset = 0
         self._ckpt_engine = None  # persistent async checkpoint engine
         self._last_grad_norm = None
+        self._last_log_window = 0
         self.micro_steps = 0
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -650,6 +654,10 @@ class Engine:
                 state["loss_scale"] = {"scale": ls.scale,
                                        "good_steps": ls.good_steps,
                                        "hysteresis": ls.hysteresis}
+                # device-resident skip accounting: the jitted step advances
+                # this on overflow so the host never fetches the overflow
+                # flag in the hot loop (engine.skipped_steps reads it lazily)
+                state["skipped"] = jnp.zeros((), jnp.int32)
             return state
 
         # Determine opt-state sharding by matching leaves against params:
@@ -835,6 +843,8 @@ class Engine:
         if "loss_scale" in state_shapes:
             out["loss_scale"] = jax.tree.map(
                 lambda s: NamedSharding(mesh, P()), state_shapes["loss_scale"])
+        if "skipped" in state_shapes:
+            out["skipped"] = NamedSharding(mesh, P())
         return out
 
     # ------------------------------------------------------------------
@@ -959,6 +969,12 @@ class Engine:
             new_state = {"params": new_params, "opt": new_opt, "step": new_step}
             if loss_scale_state is not None:
                 new_state["loss_scale"] = loss_scale_state
+            if fp16:
+                # in-graph skip counter: no per-step bool(overflow) fetch on
+                # the host — skipped_steps/get_lr read this lazily at
+                # steps_per_print boundaries
+                new_state["skipped"] = (state["skipped"]
+                                        + overflow.astype(jnp.int32))
             metrics = {"loss": mean_loss, "grad_norm": gnorm,
                        "overflow": overflow}
             if fp16:
@@ -984,6 +1000,11 @@ class Engine:
             """One full optimizer step over `gas` microbatches."""
             mean_loss, grads = batch_grads(state, batch, rng)
             return apply_grads(state, grads, mean_loss)
+
+        # raw (unjitted) step for the fused K-step program; recompiles
+        # (Random-LTD/act-quant rebuilds) invalidate any cached fusions
+        self._train_step_fn = train_step
+        self._fused_steps = {}
 
         if self._nvme_opt:
             # optimizer apply happens chunk-wise through the NVMe swapper;
@@ -1151,6 +1172,8 @@ class Engine:
                 new_state["loss_scale"] = {"scale": new_ls.scale,
                                            "good_steps": new_ls.good_steps,
                                            "hysteresis": new_ls.hysteresis}
+                new_state["skipped"] = (state["skipped"]
+                                        + overflow.astype(jnp.int32))
             metrics = {"loss": mean_loss, "grad_norm": gnorm,
                        "overflow": overflow}
             if fp16:
@@ -1167,6 +1190,7 @@ class Engine:
         if fp16:
             state_spec["loss_scale"] = {k: P() for k in
                                         self.state["loss_scale"]}
+            state_spec["skipped"] = P()
         out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
         if fp16:
             out_metrics_spec["loss_scale"] = P()
@@ -1234,13 +1258,15 @@ class Engine:
             # retrigger compilation
             batch["_moq_bits"] = self._moq.bits(self.global_steps)
         if self._infinity:
-            # unsharded single-device executor: no mesh batch placement
+            # unsharded single-device executor: no mesh batch placement.
+            # The executor is host-driven per step, so overflow is already
+            # a host value — account it on the host offset directly
             metrics = self._infinity_exec.train_batch(batch)
             self.global_steps += 1
             self.micro_steps += self.config.gradient_accumulation_steps
             if self._fp16 and bool(metrics.get("overflow")):
-                self.skipped_steps += 1
-            self.tput_timer.stop()
+                self._skipped_offset += 1
+            self.tput_timer.stop(output=metrics)
             self._log_step(dict(metrics))
             return metrics
         batch = self._device_batch(batch)
@@ -1253,6 +1279,10 @@ class Engine:
             step_fn = self._get_onebit_step(phase, batch)
             with self.mesh:
                 self.state, metrics = step_fn(self.state, batch, sub)
+            # EXPLICIT sync point: the warm->compressed phase switch is a
+            # host decision keyed on the applied-update count, so this path
+            # pays one overflow fetch per step by design (skip accounting
+            # itself stays in-graph — state["skipped"])
             if not (self._fp16 and bool(metrics["overflow"])):
                 self._onebit_applied += 1  # overflow steps don't advance
         else:
@@ -1264,9 +1294,11 @@ class Engine:
                 self.state["opt"] = self._opt_to_host(self.state["opt"])
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
-        if self._fp16 and bool(metrics["overflow"]):
-            self.skipped_steps += 1  # reference: overflow accounting in step:1635
-        self.tput_timer.stop()
+        # no host overflow fetch here: skip accounting is in-graph for the
+        # jitted paths (reference step:1635 does it eagerly; the eager bool()
+        # was the per-step stall this engine removes), and _nvme_apply
+        # already accounted its host-side overflow
+        self.tput_timer.stop(output=metrics)
         metrics = {k: v for k, v in metrics.items()}
         self._log_step(metrics)
         fp_cfg = self.config.flops_profiler
@@ -1278,6 +1310,149 @@ class Engine:
                 self.flops_profile = FlopsProfiler(fp_cfg).run(self, batch)
             finally:
                 self._profiling = False
+        return metrics
+
+    # ------------------------------------------------------------------
+    # async multi-step pipeline (train_batches)
+    # ------------------------------------------------------------------
+    def train_batches(self, data_iter, num_steps: int) -> Dict[str, Any]:
+        """Async multi-step train loop: consume `num_steps` global batches
+        from `data_iter` keeping up to ``pipeline.in_flight`` dispatched
+        steps in flight.
+
+        Because overflow/skip accounting lives in the donated jitted state,
+        the host never waits on step N to decide step N+1: each iteration
+        dispatches and moves on, bounded by blocking on the (i-in_flight)'th
+        step's output so dispatch can't run away from execution. With
+        ``pipeline.prefetch`` the sharding-aware device_put of batch N+1
+        overlaps step N; with ``pipeline.fuse_steps`` K>1 (plain dense path
+        only) K sequential optimizer steps compile into ONE dispatch.
+        Metric fetches happen only at steps_per_print boundaries
+        (_log_step). Returns the LAST step's metrics — device arrays;
+        float() them to force the final sync.
+
+        The reference has no equivalent single call: its train loop hides
+        Python overhead behind CUDA streams but still reads the overflow
+        flag every step (engine step:1635)."""
+        import collections
+        import itertools
+        self._activate_context()
+        pcfg = self.config.pipeline
+        in_flight = max(1, int(pcfg.in_flight))
+        k = max(1, int(pcfg.fuse_steps))
+        use_fused = k > 1 and self._can_fuse()
+        if k > 1 and not use_fused:
+            logger.warning(
+                "pipeline.fuse_steps ignored: the fused program needs the "
+                "plain dense jitted path (no 1-bit/NVMe/infinity executor, "
+                "no per-step batch rewrites)")
+        it = itertools.islice(iter(data_iter), num_steps)
+        if not use_fused and pcfg.prefetch and not self._infinity:
+            from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+            it = iter(PrefetchLoader(it, put_fn=self._device_batch))
+        window = collections.deque()
+        metrics = None
+        done = 0
+        while done < num_steps:
+            if use_fused and num_steps - done >= k:
+                chunk = list(itertools.islice(it, k))
+                if not chunk:
+                    break
+                if len(chunk) < k:
+                    # short read: run the tail through the single-step path
+                    # below rather than jit-compiling a one-off smaller
+                    # fused program
+                    for batch in chunk:
+                        metrics = self.train_batch(batch)
+                        done += 1
+                    break
+                metrics = self._train_batch_fused(chunk)
+                done += k
+            else:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                metrics = self.train_batch(batch)
+                done += 1
+            window.append(metrics["loss"])
+            if len(window) > in_flight:
+                # bound host run-ahead: wait for the oldest in-flight step
+                # before dispatching further (backpressure, not a stall —
+                # in_flight-1 steps are still queued behind it)
+                jax.block_until_ready(window.popleft())
+        if done < num_steps:
+            logger.warning(f"train_batches: iterator exhausted after {done} "
+                           f"of {num_steps} steps")
+        return metrics
+
+    def _can_fuse(self) -> bool:
+        """The fused K-step program covers the plain dense jitted path only:
+        host-driven executors (1-bit phase switch, NVMe swapper, infinity)
+        and per-step host batch rewrites (curriculum/LTD/PLD/MoQ, a pending
+        act-quant rebuild) need step granularity."""
+        return (self._train_step is not None and not self._onebit_comm
+                and not self._nvme_opt and not self._infinity
+                and not self._offload_opt
+                and self._curriculum is None and self._ltd is None
+                and self._pld is None and self._moq is None
+                and (not self._act_quant or self._act_quant_on)
+                and not self.config.flops_profiler.enabled)
+
+    def _get_fused_step(self, k: int):
+        """Jitted K-step program: the train state threads through K
+        sequential (unrolled) optimizer steps in ONE dispatch, donated
+        end-to-end. Per-step collectives scale exactly Kx — the analysis
+        census pins that (a collective hoisted out of or duplicated into
+        the unrolled loop is census drift)."""
+        fn = self._fused_steps.get(k)
+        if fn is not None:
+            return fn
+        step_fn = self._train_step_fn
+        state_sh = self.state_shardings
+
+        def fused(state, batches, rngs):
+            out = []
+            for i in range(k):
+                mb = jax.tree.map(lambda x: x[i], batches)
+                state, m = step_fn(state, mb, rngs[i])
+                # pin the inter-step state to the program-boundary shardings:
+                # without this GSPMD reshards the unrolled interior freely
+                # and the collective census stops being Kx the single step
+                state = jax.tree.map(
+                    lambda x, s: jax.lax.with_sharding_constraint(x, s)
+                    if s is not None else x,
+                    state, state_sh,
+                    is_leaf=lambda x: x is None)
+                out.append(m)
+            metrics = jax.tree.map(lambda *xs: jnp.stack(xs), *out)
+            return state, metrics
+
+        fn = jax.jit(fused,
+                     in_shardings=(self.state_shardings, None, None),
+                     out_shardings=(self.state_shardings, None),
+                     donate_argnums=(0,))
+        self._fused_steps[k] = fn
+        return fn
+
+    def _train_batch_fused(self, batches) -> Dict[str, Any]:
+        """Dispatch ONE jitted program covering len(batches) sequential
+        optimizer steps (host batches stacked on a leading step dim).
+        Bookkeeping matches that many train_batch calls; the returned
+        metrics are the last sub-step's, still device-resident."""
+        k = len(batches)
+        self.tput_timer.start()
+        self._rng, sub = jax.random.split(self._rng)
+        rngs = jax.random.split(sub, k)
+        placed = self._device_batches(_stack_batches(batches))
+        with self.mesh:
+            self.state, metrics_k = self._get_fused_step(k)(
+                self.state, placed, rngs)
+        self.global_steps += k
+        self.micro_steps += k * self.config.gradient_accumulation_steps
+        metrics = jax.tree.map(lambda v: v[-1], metrics_k)  # lazy slice
+        self.tput_timer.stop(output=metrics, steps=k)
+        self._log_step(dict(metrics))
         return metrics
 
     def _rebuild_act_quant(self, model):
@@ -1332,6 +1507,10 @@ class Engine:
         if not overflow:
             self.state["params"] = new_params
             self.state["step"] = jax.tree.map(lambda s: s + 1, self.state["step"])
+        elif self._fp16:
+            # host-driven path: overflow is already a host bool here, so the
+            # skip lands on the host offset (the device counter stays 0)
+            self._skipped_offset += 1
         if self._fp16:
             ls = fp16_mod.LossScaleState(
                 scale=jnp.asarray(scale, jnp.float32),
@@ -1450,12 +1629,10 @@ class Engine:
         if self._nvme_opt:
             gas = self.config.gradient_accumulation_steps
             grads = jax.tree.map(lambda g: g / gas, self._grad_buffer)
-            metrics = self._nvme_apply(grads, mean_loss)
+            metrics = self._nvme_apply(grads, mean_loss)  # accounts skips
             self._grad_buffer = None
             self._accum_count = 0
             self.global_steps += 1
-            if self._fp16 and bool(metrics["overflow"]):
-                self.skipped_steps += 1
             self._log_step(metrics)
             return metrics
         if self._offload_opt:
@@ -1468,42 +1645,86 @@ class Engine:
         self._grad_buffer = None
         self._accum_count = 0
         self.global_steps += 1
-        if self._fp16 and bool(metrics["overflow"]):
-            self.skipped_steps += 1
+        # skip accounting is in-graph (state["skipped"]) — no overflow fetch
         self._log_step(metrics)
         return metrics
 
     # ------------------------------------------------------------------
     def _device_batch(self, batch):
+        """Sharding-aware batch placement. IDEMPOTENT: a leaf already placed
+        with the target sharding passes through untouched, so the
+        PrefetchLoader can run this ahead of time and curriculum/LTD/PLD
+        rewrites (which slice or extend the batch) are simply re-placed at
+        consume time."""
         spec = self._batch_spec()
+        def place(x, sh):
+            if isinstance(x, jax.Array) and x.sharding == sh:
+                return x  # already resident (prefetch path): no dispatch
+            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            return jax.device_put(x, sh)
         def put(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
             s = P(*spec[:min(x.ndim, len(spec))])  # 0-d leaves → replicated
-            return jax.device_put(x, NamedSharding(self.mesh, s))
+            return place(x, NamedSharding(self.mesh, s))
         repl = NamedSharding(self.mesh, P())
         if isinstance(batch, dict):
-            return {k: (jax.device_put(jnp.asarray(v), repl)
+            return {k: (place(jnp.asarray(v) if not isinstance(v, jax.Array)
+                              else v, repl)
                         if _is_side_channel(k) else put(v))
                     for k, v in batch.items()}
         return jax.tree.map(put, batch)
+
+    def _device_batches(self, stacked):
+        """Place a K-stacked batch (leaves ``[K, global_batch, ...]``) for
+        the fused multi-step program: the leading step dim is replicated
+        (each unrolled step slices its own row), the rest shards exactly
+        like _device_batch."""
+        spec = self._batch_spec()
+        def put(key, x):
+            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            if _is_side_channel(key) or x.ndim <= 1:
+                s = P()  # replicated: [K] side-channels / scalars
+            else:
+                s = P(None, *spec[:min(x.ndim - 1, len(spec))])
+            return jax.device_put(x, NamedSharding(self.mesh, s))
+        if isinstance(stacked, dict):
+            return {k: put(k, v) for k, v in stacked.items()}
+        return jax.tree.map(lambda x: put(None, x), stacked)
 
     def _log_step(self, metrics):
         # keep the device array; get_global_grad_norm() fetches on demand
         if "grad_norm" in metrics:
             self._last_grad_norm = metrics["grad_norm"]
         cfg = self.config
-        if self.global_steps % max(1, cfg.steps_per_print) == 0:
-            loss = float(metrics["loss"])
-            lr = self.get_lr()
-            msg = (f"step={self.global_steps} loss={loss:.4f} "
-                   f"lr={lr:.3e} gnorm={float(metrics['grad_norm']):.3f}")
-            if "loss_scale" in metrics:
-                msg += f" scale={float(metrics['loss_scale']):.0f}"
-            logger.info(msg)
-            if self.monitor is not None and self.monitor.enabled:
-                self.monitor.write_events([
-                    ("Train/loss", loss, self.global_steps),
-                    ("Train/lr", lr, self.global_steps)])
+        # window-crossing check, not `% == 0`: a fused K-step dispatch
+        # advances global_steps by K and can stride over the exact multiple
+        window = self.global_steps // max(1, cfg.steps_per_print)
+        if window == self._last_log_window:
+            return
+        self._last_log_window = window
+        # the ONE steady-state sync point of the hot loop: every logged
+        # metric comes back in a single device_get instead of one blocking
+        # float() per metric
+        fetch = {k: metrics[k] for k in ("loss", "grad_norm", "loss_scale")
+                 if k in metrics}
+        vals = {k: float(np.asarray(v))
+                for k, v in jax.device_get(fetch).items()}
+        lr = self.get_lr()
+        msg = (f"step={self.global_steps} loss={vals['loss']:.4f} "
+               f"lr={lr:.3e} gnorm={vals.get('grad_norm', 0.0):.3f}")
+        if "loss_scale" in vals:
+            msg += f" scale={vals['loss_scale']:.0f}"
+        logger.info(msg)
+        if self.monitor is not None and self.monitor.enabled:
+            events = [("Train/loss", vals["loss"], self.global_steps),
+                      ("Train/lr", lr, self.global_steps)]
+            if "grad_norm" in vals:
+                events.append(("Train/grad_norm", vals["grad_norm"],
+                               self.global_steps))
+            if "loss_scale" in vals:
+                events.append(("Train/loss_scale", vals["loss_scale"],
+                               self.global_steps))
+            self.monitor.write_events(events)  # one batched write
 
     # ------------------------------------------------------------------
     # info API (reference parity helpers)
@@ -1511,12 +1732,35 @@ class Engine:
     def get_lr(self) -> float:
         if self._schedule is not None:
             # evaluate at the APPLIED update count (+1 = the lr the next
-            # update will use); overflow-skipped steps don't advance it
+            # update will use); overflow-skipped steps don't advance it.
+            # Plain Python int -> the schedule's numpy path: no device
+            # program is built or run for a log-boundary call
             applied = self.global_steps - self.skipped_steps
-            return float(self._schedule(jnp.asarray(applied + 1)))
+            return float(self._schedule(applied + 1))
         if isinstance(self._base_lr, (int, float)):
             return float(self._base_lr)
         return 0.0
+
+    @property
+    def skipped_steps(self) -> int:
+        """Overflow-skipped optimizer steps. The jitted paths account skips
+        in-graph (state["skipped"]) so reading this is a LAZY device fetch —
+        call it at steps_per_print boundaries, not per step; host-driven
+        paths (NVMe swapper, layer-streamed executor) land on the host
+        offset and cost nothing."""
+        return self._skipped_offset + self._device_skipped()
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        # checkpoint restore: reconcile the host offset against whatever the
+        # (just-loaded) device counter says
+        self._skipped_offset = int(value) - self._device_skipped()
+
+    def _device_skipped(self) -> int:
+        state = getattr(self, "state", None)
+        if isinstance(state, dict) and "skipped" in state:
+            return int(np.asarray(jax.device_get(state["skipped"])))
+        return 0
 
     def get_loss_scale(self) -> float:
         if self._fp16:
@@ -1600,8 +1844,29 @@ class Engine:
         self.wait_checkpoint()
         if self._infinity:
             return self._load_infinity_checkpoint(load_dir, tag)
-        state, client_state = ckpt_mod.load_checkpoint(
-            load_dir, tag, template=self.state, shardings=self.state_shardings)
+        try:
+            state, client_state = ckpt_mod.load_checkpoint(
+                load_dir, tag, template=self.state,
+                shardings=self.state_shardings)
+        except Exception as orig:
+            if not (isinstance(self.state, dict) and "skipped" in self.state):
+                raise
+            # fp16 checkpoints written before the device-resident skip
+            # counter have no "skipped" leaf: restore without it, then
+            # rebuild it as zero — the skipped_steps setter reconciles the
+            # host offset against client_state below. If the retry fails
+            # too, the failure wasn't the missing leaf: surface the
+            # ORIGINAL error, not the retry's
+            tmpl = {k: v for k, v in self.state.items() if k != "skipped"}
+            sh = {k: v for k, v in self.state_shardings.items()
+                  if k != "skipped"}
+            try:
+                state, client_state = ckpt_mod.load_checkpoint(
+                    load_dir, tag, template=tmpl, shardings=sh)
+            except Exception:
+                raise orig
+            state["skipped"] = jax.device_put(
+                jnp.zeros((), jnp.int32), self.state_shardings["skipped"])
         if not load_optimizer_states:
             state["opt"] = self.state["opt"]
         if self._offload_opt:
@@ -1725,6 +1990,17 @@ def load_16bit_model(path: str):
             if "bfloat16" in dt and key in data:
                 data[key] = data[key].view(ml_dtypes.bfloat16)
     return data
+
+
+def _stack_batches(batches):
+    """Stack K host batches on a new leading step dim for the fused
+    program. Host-side np.stack by design: the fused path consumes raw
+    loader output (one device_put moves the whole K-chunk)."""
+    if isinstance(batches[0], dict):
+        return {k: np.stack([np.asarray(b[k]) for b in batches])
+                for k in batches[0]}
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
 
 
 def _flatten_dict(tree, prefix=""):

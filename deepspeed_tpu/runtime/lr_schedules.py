@@ -3,13 +3,21 @@
 Reference: ``deepspeed/runtime/lr_schedules.py:17-20`` — LRRangeTest, OneCycle,
 WarmupLR, WarmupDecayLR (same names + parameter keys). A schedule here is a
 callable usable inside jit (step may be a traced int32), which is why these
-are closures over jnp math instead of stateful scheduler objects.
+are closures over array math instead of stateful scheduler objects.
+
+Dual-mode evaluation: inside the jitted step the optimizer calls the schedule
+with a traced int32 and the math runs in jnp; host callers (``engine.get_lr``
+at log boundaries, the NVMe swapper's per-step lr) pass a plain Python int
+and the SAME closure evaluates in numpy — a float comes back with zero device
+work, so a log-boundary ``get_lr()`` cannot stall the async step pipeline.
 """
 
 import math
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 Schedule = Callable[[jnp.ndarray], jnp.ndarray]
 
@@ -22,15 +30,22 @@ COSINE = "CosineAnnealing"  # TPU-native addition (commonly needed, absent in re
 VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, COSINE]
 
 
+def _xp(step):
+    """jnp for traced/device inputs (tracers are jax.Array instances), numpy
+    for host ints/floats — the one dispatch point for dual-mode schedules."""
+    return jnp if isinstance(step, jax.Array) else np
+
+
 def lr_range_test(lr_range_test_min_lr: float = 1e-3,
                   lr_range_test_step_size: int = 2000,
                   lr_range_test_step_rate: float = 1.0,
                   lr_range_test_staircase: bool = False, **_) -> Schedule:
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
+        xp = _xp(step)
+        step = xp.asarray(step, xp.float32)
         interval = step / lr_range_test_step_size
         if lr_range_test_staircase:
-            interval = jnp.floor(interval)
+            interval = xp.floor(interval)
         return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
     return schedule
 
@@ -38,15 +53,16 @@ def lr_range_test(lr_range_test_min_lr: float = 1e-3,
 def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
               warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
-        frac = jnp.clip(step / max(1, warmup_num_steps), 0.0, 1.0)
+        xp = _xp(step)
+        step = xp.asarray(step, xp.float32)
+        frac = xp.clip(step / max(1, warmup_num_steps), 0.0, 1.0)
         if warmup_type == "log":
             # matches reference: min + (max-min) * log1p-normalized progress
-            gamma = jnp.log1p(frac * (math.e - 1.0))
+            gamma = xp.log1p(frac * (math.e - 1.0))
         else:
             gamma = frac
         warm = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
-        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr)
+        return xp.where(step < warmup_num_steps, warm, warmup_max_lr)
     return schedule
 
 
@@ -56,11 +72,12 @@ def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
     warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
 
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
-        decay_frac = jnp.clip(
+        xp = _xp(step)
+        step = xp.asarray(step, xp.float32)
+        decay_frac = xp.clip(
             (total_num_steps - step) / max(1.0, total_num_steps - warmup_num_steps),
             0.0, 1.0)
-        return jnp.where(step < warmup_num_steps, warm(step), warmup_max_lr * decay_frac)
+        return xp.where(step < warmup_num_steps, warm(step), warmup_max_lr * decay_frac)
     return schedule
 
 
@@ -73,17 +90,18 @@ def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
     total_cycle = cycle_first_step_size + second
 
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
-        up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
-        down = jnp.clip((step - cycle_first_step_size) / max(1, second), 0.0, 1.0)
-        in_cycle_lr = jnp.where(
+        xp = _xp(step)
+        step = xp.asarray(step, xp.float32)
+        up = xp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down = xp.clip((step - cycle_first_step_size) / max(1, second), 0.0, 1.0)
+        in_cycle_lr = xp.where(
             step <= cycle_first_step_size,
             cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up,
             cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down)
         if decay_step_size > 0:
-            decay_steps = jnp.maximum(0.0, (step - total_cycle) / decay_step_size)
+            decay_steps = xp.maximum(0.0, (step - total_cycle) / decay_step_size)
             decayed = cycle_min_lr / (1.0 + decay_steps * decay_lr_rate)
-            return jnp.where(step > total_cycle, decayed, in_cycle_lr)
+            return xp.where(step > total_cycle, decayed, in_cycle_lr)
         return in_cycle_lr
     return schedule
 
@@ -91,12 +109,13 @@ def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
 def cosine_annealing(max_lr: float, total_num_steps: int,
                      warmup_num_steps: int = 0, min_lr: float = 0.0, **_) -> Schedule:
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
+        xp = _xp(step)
+        step = xp.asarray(step, xp.float32)
         warm = max_lr * step / max(1, warmup_num_steps)
-        progress = jnp.clip((step - warmup_num_steps) /
-                            max(1, total_num_steps - warmup_num_steps), 0.0, 1.0)
-        cos = min_lr + 0.5 * (max_lr - min_lr) * (1.0 + jnp.cos(jnp.pi * progress))
-        return jnp.where(step < warmup_num_steps, warm, cos)
+        progress = xp.clip((step - warmup_num_steps) /
+                           max(1, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        cos = min_lr + 0.5 * (max_lr - min_lr) * (1.0 + xp.cos(xp.pi * progress))
+        return xp.where(step < warmup_num_steps, warm, cos)
     return schedule
 
 

@@ -101,25 +101,37 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + tokens/sec + TFLOPS reporting across steps.
+    """Samples/sec + time/step reporting across steps.
 
     Reference: ``deepspeed/utils/timer.py:136``. We keep the same skip of the
     first few steps (compile warm-up dominates on XLA far more than on CUDA).
+
+    Async-dispatch aware: under JAX async dispatch a per-step host timestamp
+    measures DISPATCH, not execution, and a per-step device sync (the old
+    behavior) serializes the very pipeline the engine builds. Timing is
+    therefore window-based: the timer blocks only when a window of
+    ``steps_per_output`` steps closes — via ``jax.block_until_ready`` on the
+    step *output* when the caller passes one to ``stop(output=...)`` — and
+    reports the window-average step time. ``enabled=False`` removes even
+    those syncs (pure dispatch timing / debugging).
     """
 
     def __init__(self, batch_size: int, start_step: int = 2,
                  steps_per_output: int = 50, monitor_memory: bool = False,
-                 logging_fn=None):
+                 logging_fn=None, enabled: bool = True):
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
-        self.steps_per_output = steps_per_output
+        self.steps_per_output = max(1, steps_per_output)
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or logger.info
+        self.enabled = enabled
         self.initialized = False
         self.global_step_count = 0
         self.local_step_count = 0
         self.total_elapsed_time = 0.0
-        self._start_time = 0.0
+        self.timed_steps = 0
+        self._window_start = None   # perf_counter at the open window's start
+        self._window_steps = 0
         self.started = False
 
     def update_epoch_count(self):
@@ -127,30 +139,54 @@ class ThroughputTimer:
 
     def start(self):
         self.started = True
-        if self.global_step_count >= self.start_step:
-            _device_sync()
-            self._start_time = time.perf_counter()
+        if not self.enabled:
+            return
+        if self.global_step_count >= self.start_step \
+                and self._window_start is None:
+            _device_sync()  # anchor the first window honestly
+            self._window_start = time.perf_counter()
+            self._window_steps = 0
 
-    def stop(self, global_step: bool = True, report_speed: bool = True):
+    def stop(self, global_step: bool = True, report_speed: bool = True,
+             output=None, steps: int = 1):
+        """Count `steps` finished dispatches (a fused K-step program passes
+        steps=K). At window boundaries, block on `output` (the step's
+        metrics/state) so the recorded time covers execution, not dispatch."""
         if not self.started:
             return
         self.started = False
+        before = self.global_step_count
         if global_step:
-            self.global_step_count += 1
-            self.local_step_count += 1
-        if self.global_step_count > self.start_step and self._start_time:
+            self.global_step_count += steps
+            self.local_step_count += steps
+        if not self.enabled or self._window_start is None:
+            return
+        self._window_steps += steps
+        if (self.global_step_count // self.steps_per_output) == \
+                (before // self.steps_per_output):
+            return  # window still open: no sync, no fetch
+        if output is not None:
+            try:
+                import jax
+                jax.block_until_ready(output)
+            except Exception:
+                _device_sync()
+        else:
             _device_sync()
-            duration = time.perf_counter() - self._start_time
-            self.total_elapsed_time += duration
-            if report_speed and self.global_step_count % self.steps_per_output == 0:
-                self.logging(
-                    f"step={self.global_step_count}, "
-                    f"samples/sec={self.avg_samples_per_sec():.2f}, "
-                    f"time/step(ms)={duration * 1000:.2f}")
+        now = time.perf_counter()
+        duration = now - self._window_start
+        self.total_elapsed_time += duration
+        self.timed_steps += self._window_steps
+        if report_speed:
+            self.logging(
+                f"step={self.global_step_count}, "
+                f"samples/sec={self.avg_samples_per_sec():.2f}, "
+                f"time/step(ms)="
+                f"{duration / max(1, self._window_steps) * 1000:.2f}")
+        self._window_start = now
+        self._window_steps = 0
 
     def avg_samples_per_sec(self) -> float:
-        if self.global_step_count <= self.start_step or self.total_elapsed_time == 0:
+        if self.timed_steps == 0 or self.total_elapsed_time == 0:
             return 0.0
-        steps = self.global_step_count - self.start_step
-        avg = self.total_elapsed_time / max(1, steps)
-        return self.batch_size / avg
+        return self.batch_size * self.timed_steps / self.total_elapsed_time

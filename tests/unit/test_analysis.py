@@ -179,6 +179,7 @@ _CORPUS_RULES = {
     "f32-upcast": "dtype-upcast",
     "replicated-budget": "replication-over-budget",
     "census-drift": "collective-census-drift",
+    "fused-hoist": "collective-census-drift",
 }
 
 
@@ -260,6 +261,21 @@ class TestCleanConfigs:
             got = {k: c["count"]
                    for k, c in report.census["train_step"].items()}
             assert got == want, f"stage {stage} census drifted: {got}"
+
+    @pytest.mark.slow
+    def test_fused_program_census_scales_by_k(self, devices8):
+        """pipeline.fuse_steps=K lowers a second artifact (train_step_fused)
+        whose census must be EXACTLY Kx the single-step pins: a collective
+        hoisted out of (or duplicated into) the unrolled loop is drift."""
+        report = audit_stage(2, {"data": 2}, devices=devices8[:2],
+                             pipeline={"fuse_steps": 2},
+                             analysis={"expect_collectives": STAGE2_CENSUS})
+        assert report.ok, report.summary()
+        single = {k: c["count"] for k, c in report.census["train_step"].items()}
+        fused = {k: c["count"]
+                 for k, c in report.census["train_step_fused"].items()}
+        assert single == STAGE2_CENSUS
+        assert fused == {k: 2 * v for k, v in STAGE2_CENSUS.items()}, fused
 
     def test_extra_allreduce_in_model_fails_pin(self, devices8):
         """A model-level silently-added cross-replica reduction must break

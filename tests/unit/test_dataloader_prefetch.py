@@ -1,0 +1,217 @@
+"""Async step pipeline: PrefetchLoader + device-resident overflow accounting.
+
+Pins the PR-2 tentpole contracts:
+  * prefetch preserves batch order, including across epoch boundaries, and
+    set_epoch still reshuffles through the wrapper;
+  * the prefetch device_put is idempotent through engine._device_batch
+    (already-placed leaves pass through untouched);
+  * a 20-step fp16 run with a forced overflow at step 7 produces identical
+    global_steps / skipped_steps / final params (bit-for-bit) under the
+    per-step-fetch sync path, the async train_batches path, and the fused
+    K-step path — overflow/skip accounting lives in the jitted state, so
+    removing the host sync must not change a single bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.dataloader import (DataLoader, PrefetchLoader,
+                                              RepeatingLoader)
+
+
+# --------------------------------------------------------------------------
+# prefetch ordering
+# --------------------------------------------------------------------------
+
+def rows(n=12, d=4):
+    return [{"x": np.full((d,), i, np.float32)} for i in range(n)]
+
+
+class TestPrefetchOrder:
+    def test_preserves_order_within_epoch(self):
+        loader = DataLoader(rows(), batch_size=4)
+        pf = PrefetchLoader(loader, put_fn=lambda b: b)
+        got = [b["x"][:, 0].tolist() for b in pf]
+        want = [b["x"][:, 0].tolist() for b in loader]
+        assert got == want
+
+    def test_preserves_order_across_epoch_boundary(self):
+        """RepeatingLoader under prefetch: the epoch rollover happens inside
+        the wrapped iterator; prefetch must not reorder around it."""
+        loader = DataLoader(rows(8), batch_size=4, shuffle=True, seed=3)
+        pf = PrefetchLoader(RepeatingLoader(loader), put_fn=lambda b: b,
+                            depth=3)
+        it = iter(pf)
+        got = [next(it)["x"][:, 0].tolist() for _ in range(6)]  # 3 epochs
+        ref_loader = DataLoader(rows(8), batch_size=4, shuffle=True, seed=3)
+        want = []
+        for epoch in range(3):
+            ref_loader.set_epoch(epoch)
+            want += [b["x"][:, 0].tolist() for b in ref_loader]
+        assert got == want
+
+    def test_set_epoch_reshuffles_through_wrapper(self):
+        loader = DataLoader(rows(16), batch_size=4, shuffle=True, seed=0)
+        pf = PrefetchLoader(loader, put_fn=lambda b: b)
+        pf.set_epoch(0)
+        e0 = [b["x"][:, 0].tolist() for b in pf]
+        pf.set_epoch(1)
+        e1 = [b["x"][:, 0].tolist() for b in pf]
+        assert e0 != e1                       # reshuffled
+        assert sorted(sum(e0, [])) == sorted(sum(e1, []))  # same data
+        assert pf.epoch == 1
+
+    def test_short_iterator_and_len(self):
+        loader = DataLoader(rows(4), batch_size=4)
+        pf = PrefetchLoader(loader, put_fn=lambda b: b, depth=8)
+        assert len(pf) == 1
+        assert len(list(pf)) == 1
+
+
+# --------------------------------------------------------------------------
+# sync vs async vs fused parity (the tentpole acceptance gate)
+# --------------------------------------------------------------------------
+
+class ToyLinear:
+    """Minimal ModelSpec whose loss can be pushed to an fp16 grad overflow
+    on demand through the input magnitude."""
+
+    name = "toy-linear"
+
+    def __init__(self, d=8):
+        self.d = d
+
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (self.d, self.d),
+                                       jnp.float32) * 0.1}
+
+    @property
+    def logical_axes(self):
+        return {"w": None}
+
+    def loss_fn(self, params, batch, rng, deterministic):
+        y = batch["x"] @ params["w"].astype(batch["x"].dtype)
+        return jnp.mean(jnp.square(y).astype(jnp.float32))
+
+
+def fp16_cfg(**overrides):
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           # scale 2^8: unit-scale grads stay well inside fp16 range, the
+           # boosted batch overflows deterministically
+           "fp16": {"enabled": True, "initial_scale_power": 8},
+           "bf16": {"enabled": False},
+           "steps_per_print": 100}
+    cfg.update(overrides)
+    return cfg
+
+
+def overflow_batches(n=20, boost_at=7):
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(16, 8)).astype(np.float32)}
+               for _ in range(n)]
+    # 1e8 * scale(2^8) saturates the fp16 grads -> overflow -> skipped step
+    batches[boost_at] = {"x": (batches[boost_at]["x"] * 1e8
+                               ).astype(np.float32)}
+    return batches
+
+
+def params_bits(engine):
+    w = np.asarray(jax.device_get(engine.state["params"]["w"]))
+    return w.view(np.uint16)
+
+
+class TestSyncAsyncParity:
+    def test_overflow_accounting_matches_bit_for_bit(self):
+        batches = overflow_batches()
+
+        # sync path: host fetch after every step (the pre-PR behavior)
+        sync, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                            config=fp16_cfg())
+        overflows = 0
+        for b in batches:
+            m = sync.train_batch(b)
+            overflows += int(bool(np.asarray(jax.device_get(m["overflow"]))))
+        assert overflows == 1
+
+        # async path: train_batches (prefetch + bounded in-flight window),
+        # no per-step host fetch anywhere
+        async_, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                              config=fp16_cfg())
+        async_.train_batches(iter(batches), 20)
+
+        assert sync.global_steps == async_.global_steps == 20
+        assert sync.skipped_steps == async_.skipped_steps == 1
+        assert sync.get_loss_scale() == async_.get_loss_scale()
+        np.testing.assert_array_equal(params_bits(sync), params_bits(async_))
+        # the applied-update counter also skipped exactly the overflow step
+        assert int(np.asarray(jax.device_get(async_.state["step"]))) == 19
+
+    def test_fused_k_steps_match_bit_for_bit(self):
+        """pipeline.fuse_steps=4: 5 dispatches cover 20 steps; the in-graph
+        loss-scale/skip accounting threads through the unrolled program."""
+        batches = overflow_batches()
+        ref, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                           config=fp16_cfg())
+        for b in batches:
+            ref.train_batch(b)
+        fused, *_ = deepspeed_tpu.initialize(
+            model=ToyLinear(),
+            config=fp16_cfg(pipeline={"fuse_steps": 4, "in_flight": 2}))
+        fused.train_batches(iter(batches), 20)
+        assert fused.global_steps == 20
+        assert fused.skipped_steps == ref.skipped_steps == 1
+        np.testing.assert_array_equal(params_bits(ref), params_bits(fused))
+
+    def test_checkpoint_roundtrips_device_skip_counter(self, tmp_path):
+        batches = overflow_batches(n=10)
+        e, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                         config=fp16_cfg())
+        e.train_batches(iter(batches), 10)
+        assert e.skipped_steps == 1
+        e.save_checkpoint(str(tmp_path), tag="ck")
+        e2, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                          config=fp16_cfg())
+        e2.load_checkpoint(str(tmp_path), tag="ck")
+        assert e2.global_steps == 10
+        assert e2.skipped_steps == 1
+        # keeps counting in-graph after restore
+        more = overflow_batches(n=5, boost_at=2)
+        e2.train_batches(iter(more), 5)
+        assert e2.skipped_steps == 2
+
+
+    def test_loads_legacy_checkpoint_without_skip_counter(self, tmp_path):
+        """fp16 checkpoints written before the device-resident counter have
+        no "skipped" leaf; load falls back and reconciles from
+        client_state."""
+        e, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                         config=fp16_cfg())
+        e.train_batches(iter(overflow_batches(n=5, boost_at=2)), 5)
+        # simulate the pre-PR on-disk layout: no skipped leaf in the state
+        # tree, the skip recorded host-side only
+        e.state.pop("skipped")
+        e.state_shardings.pop("skipped")
+        e._skipped_offset = 1
+        e.save_checkpoint(str(tmp_path), tag="legacy")
+        e2, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                          config=fp16_cfg())
+        e2.load_checkpoint(str(tmp_path), tag="legacy")
+        assert e2.global_steps == 5
+        assert e2.skipped_steps == 1
+        assert "skipped" in e2.state  # rebuilt; keeps counting in-graph
+        e2.train_batches(iter(overflow_batches(n=5, boost_at=3)), 5)
+        assert e2.skipped_steps == 2
+
+
+class TestDeviceBatchIdempotent:
+    def test_second_put_passes_through(self):
+        e, *_ = deepspeed_tpu.initialize(model=ToyLinear(),
+                                         config=fp16_cfg())
+        b = {"x": np.ones((16, 8), np.float32)}
+        placed = e._device_batch(b)
+        again = e._device_batch(placed)
+        assert again["x"] is placed["x"]  # no re-dispatch of a placed leaf
