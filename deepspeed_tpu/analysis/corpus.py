@@ -199,6 +199,48 @@ def fused_loop_hoist(devices=None):
         settings=AnalysisSettings(expect_collectives={"all-reduce": 1}))
 
 
+def telemetry_leak(devices=None):
+    """Telemetry done WRONG, both ways the real accumulators must never be:
+    (a) the stats buffer is NOT donated — every step holds the old and new
+    [256,256] window plane live at once (the real leaf rides the donated
+    state); (b) the per-step update all-reduces a batch statistic across
+    `data` instead of accumulating device-locally (the real leaf adds one
+    dense collective: zero). The donation lint must flag the un-donated
+    buffer and the census pin must flag the extra all-reduce."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh2(devices)
+    repl = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    params_abs = {"w": jax.ShapeDtypeStruct((128, 128), jnp.float32,
+                                            sharding=repl)}
+    tel_abs = {"stats": jax.ShapeDtypeStruct((256, 256), jnp.float32,
+                                             sharding=repl)}
+    x_abs = jax.ShapeDtypeStruct((8, 128), jnp.float32, sharding=row)
+
+    def step(params, telemetry, x):
+        loss = lambda w_: jnp.sum((x @ w_) ** 2)
+        g = jax.grad(loss)(params["w"])  # batch-sharded x -> one all-reduce
+        # defect (b): a replicated batch statistic folded into the stats
+        # plane — GSPMD must insert a second all-reduce every step
+        batch_mean = jnp.mean(x, axis=0)
+        stats = telemetry["stats"] + jnp.tile(batch_mean, 2)[None, :]
+        return {"w": params["w"] - 1e-3 * g}, {"stats": stats}
+
+    # defect (a): only the params are donated; the telemetry arg is not
+    jitted = jax.jit(step, donate_argnums=(0,),
+                     out_shardings=({"w": repl}, {"stats": repl}))
+    art = lower_program(
+        jitted, params_abs, tel_abs, x_abs, name="telemetry_step", mesh=mesh,
+        donatable={"params": params_abs, "telemetry": tel_abs},
+        meta={"skip_required": True})
+    # the clean program compiles to exactly the one grad all-reduce; pin it
+    return analyze_programs(
+        [art], _stage0_config(), _FakePlan(),
+        settings=AnalysisSettings(expect_collectives={"all-reduce": 1}))
+
+
 class NoisyLossModel:
     """A model wrapper whose loss adds a term that forces one extra dense
     cross-replica reduction — the classic silently-added allreduce, planted
@@ -227,6 +269,7 @@ CORPUS = {
     "replicated-budget": replicated_budget,
     "census-drift": census_drift,
     "fused-hoist": fused_loop_hoist,
+    "telemetry-leak": telemetry_leak,
 }
 
 

@@ -90,14 +90,47 @@ class CommsLogger:
             lines.append(f"{key:<32} host_ms={self.host_ms[key]:.1f}")
         return "\n".join(lines)
 
+    def events(self, step: int):
+        """Monitor-ready ``(name, value, step)`` triples of the running
+        totals: per-op ``comm/<op>[axis]/{count,bytes}`` plus
+        ``comm/host_ms/<op>`` for host-blocking comm. Counts/bytes are
+        recorded at TRACE time (a jitted collective is ONE static site
+        however many times the compiled program runs); the engine fans these
+        out at steps_per_print boundaries, so totals grow only when new
+        programs are traced — the per-execution wire model is the telemetry
+        static x runtime join."""
+        with self._lock:
+            counts = dict(self.counts)
+            nbytes = dict(self.bytes)
+            host = dict(self.host_ms)
+        out = []
+        for key in sorted(counts):
+            out.append((f"comm/{key}/count", float(counts[key]), step))
+            out.append((f"comm/{key}/bytes", float(nbytes[key]), step))
+        for op in sorted(host):
+            out.append((f"comm/host_ms/{op}", float(host[op]), step))
+        return out
+
 
 comms_logger = CommsLogger()
 
 
-def log_summary() -> str:
-    """Reference: ``deepspeed.comm.log_summary`` (comm/comm.py:413)."""
+def log_summary(monitor=None, step: Optional[int] = None) -> str:
+    """Reference: ``deepspeed.comm.log_summary`` (comm/comm.py:413). With a
+    ``monitor`` (e.g. ``engine.monitor``), the totals also fan out as
+    monitor events instead of log-only text — pass ``step`` (e.g.
+    ``engine.global_steps``): wandb silently drops events whose step is
+    lower than what it already logged."""
     msg = comms_logger.summary()
     logger.info("\n" + msg)
+    if monitor is not None and getattr(monitor, "enabled", False):
+        if step is None:
+            logger.warning("comm.log_summary(monitor=...) without step= — "
+                           "events land on step 0 and step-ordered sinks "
+                           "(wandb) may drop them; pass "
+                           "step=engine.global_steps")
+            step = 0
+        monitor.write_events(comms_logger.events(step))
     return msg
 
 

@@ -4,6 +4,7 @@ from deepspeed_tpu.config.config import (
     SequenceParallelConfig, MoEConfig, MeshConfig, ActivationCheckpointingConfig,
     FlopsProfilerConfig, CommsLoggerConfig, AIOConfig, CheckpointConfig,
     ElasticityConfig, AutotuningConfig, CurriculumConfig, CompressionConfig,
-    AnalysisConfig,
+    AnalysisConfig, TelemetryConfig, TelemetryTraceConfig, AnomalyConfig,
+    MonitorSinkConfig,
 )
 from deepspeed_tpu.config.config_utils import ConfigError, ConfigModel

@@ -347,6 +347,55 @@ class AnalysisConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class TelemetryTraceConfig(ConfigModel):
+    """Windowed ``jax.profiler`` capture (device-side timeline). The host
+    span recorder is always on with telemetry; this section only gates the
+    heavyweight profiler window."""
+    enabled: bool = False
+    start_step: int = 10        # first step of the capture window
+    num_steps: int = 2          # window length in steps
+    output_dir: str = "telemetry_traces"
+
+    def validate(self):
+        if self.num_steps < 1:
+            raise ConfigError("telemetry.trace.num_steps must be >= 1")
+
+
+@dataclasses.dataclass
+class AnomalyConfig(ConfigModel):
+    """Thresholds for the window anomaly rules (telemetry/anomaly.py)."""
+    enabled: bool = True
+    ema_alpha: float = 0.3            # baseline EMA weight per window
+    warmup_windows: int = 1           # windows that only seed baselines
+    loss_spike_factor: float = 2.0    # |loss_mean| > factor x baseline
+    gnorm_drift_factor: float = 10.0  # gnorm_mean outside [base/f, base*f]
+    overflow_burst_rate: float = 0.25  # overflow-skipped fraction of window
+    stall_regression_factor: float = 3.0  # block ms/step > factor x baseline
+
+
+@dataclasses.dataclass
+class TelemetryConfig(ConfigModel):
+    """TPU-native observability (``deepspeed_tpu/telemetry``): in-graph
+    window accumulators in the donated jitted state, host step tracing,
+    anomaly events, and the static x runtime join (modeled comms bytes/sec +
+    window MFU). Design constraint: ZERO added steady-state host syncs — the
+    accumulator leaf drains through the engine's existing single batched
+    device_get at steps_per_print boundaries."""
+    enabled: bool = False
+    gnorm_hist_buckets: int = 16      # log2 buckets of the grad-norm hist
+    update_ratio: bool = True         # per-step ||update||/||param|| stats
+    static_join: bool = True          # census/flops x observed rate events
+    jsonl_path: Optional[str] = None  # machine-readable event sink (JSONL)
+    max_trace_events: int = 20000     # host span ring size
+    trace: TelemetryTraceConfig = config_field(TelemetryTraceConfig)
+    anomaly: AnomalyConfig = config_field(AnomalyConfig)
+
+    def validate(self):
+        if self.gnorm_hist_buckets < 2:
+            raise ConfigError("telemetry.gnorm_hist_buckets must be >= 2")
+
+
+@dataclasses.dataclass
 class MeshConfig(ConfigModel):
     """TPU-native: explicit mesh override. By default the planner derives the
     mesh from world size and the parallelism degrees."""
@@ -392,6 +441,8 @@ class Config(ConfigModel):
     tensorboard: MonitorSinkConfig = config_field(MonitorSinkConfig)
     wandb: MonitorSinkConfig = config_field(MonitorSinkConfig)
     csv_monitor: MonitorSinkConfig = config_field(MonitorSinkConfig)
+    json_monitor: MonitorSinkConfig = config_field(MonitorSinkConfig)
+    telemetry: TelemetryConfig = config_field(TelemetryConfig)
     flops_profiler: FlopsProfilerConfig = config_field(FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = config_field(CommsLoggerConfig)
     aio: AIOConfig = config_field(AIOConfig)

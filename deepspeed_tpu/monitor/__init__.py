@@ -1,1 +1,3 @@
-from deepspeed_tpu.monitor.monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, CSVMonitor
+from deepspeed_tpu.monitor.monitor import (MonitorMaster, TensorBoardMonitor,
+                                           WandbMonitor, CSVMonitor,
+                                           JSONLMonitor)

@@ -1,19 +1,62 @@
-"""Experiment monitoring: TensorBoard / W&B / CSV fan-out.
+"""Experiment monitoring: TensorBoard / W&B / CSV / JSONL fan-out.
 
 Reference: ``deepspeed/monitor/monitor.py:26`` (MonitorMaster) and the
 per-sink writers (``monitor/{tensorboard,wandb,csv_monitor}.py``). Same event
 contract: ``write_events([(name, value, step), ...])``. Only the process-0
 host writes (reference gates on rank 0).
+
+PR-3 additions: structured records (``write_records([{...}, ...])``) carry
+telemetry windows and anomaly events — the JSONL sink writes them verbatim
+(machine-readable, one JSON object per line); scalar sinks receive a scalar
+projection (``anomaly/<rule>`` = severity code). The CSV sink caches open
+file handles (one open per metric per run, not per event) and the W&B sink
+batches one ``wandb.log`` call per step.
 """
 
 import csv
+import json
+import math
 import os
 import time
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
+# ONE severity->code mapping (telemetry/anomaly.py owns it); a drifting
+# duplicate here would make write_records' scalar projection disagree with
+# the anomaly/* events the engine emits directly for the same record
+from deepspeed_tpu.telemetry.anomaly import SEVERITY_NUM as _SEVERITY_NUM
 from deepspeed_tpu.utils.logging import logger
 
 Event = Tuple[str, float, int]
+
+
+def _jsonable(value):
+    """Strict-JSON projection: NaN/Infinity have no JSON spelling and would
+    make the machine-readable sink unparseable exactly when a run diverges —
+    map them to null (recursively)."""
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    return value
+
+
+def _record_to_event(record: Dict[str, Any]) -> Optional[Event]:
+    """Scalar projection of a structured record for sinks that only plot
+    numbers: anomaly records become ``anomaly/<rule>`` = severity code;
+    records carrying an explicit name/value pass through; the rest (e.g.
+    full telemetry windows, already emitted as telemetry/* scalars) drop."""
+    step = int(record.get("step", 0) or 0)
+    if record.get("type") == "anomaly":
+        return (f"anomaly/{record.get('rule', 'unknown')}",
+                float(_SEVERITY_NUM.get(record.get("severity"), 1)), step)
+    if "name" in record and "value" in record:
+        try:
+            return (str(record["name"]), float(record["value"]), step)
+        except (TypeError, ValueError):
+            return None
+    return None
 
 
 class Monitor:
@@ -21,6 +64,13 @@ class Monitor:
 
     def write_events(self, events: List[Event]) -> None:
         raise NotImplementedError
+
+    def write_records(self, records: List[Dict[str, Any]]) -> None:
+        """Structured records; default implementation projects to scalar
+        events (JSONL overrides to keep the full structure)."""
+        events = [e for e in map(_record_to_event, records) if e is not None]
+        if events:
+            self.write_events(events)
 
     def flush(self) -> None:
         pass
@@ -80,31 +130,127 @@ class WandbMonitor(Monitor):
     def write_events(self, events: List[Event]) -> None:
         if not self.enabled:
             return
+        # one network call per STEP, not per event (the engine hands the
+        # whole boundary batch in one write_events)
+        by_step: Dict[int, Dict[str, float]] = {}
         for name, value, step in events:
-            self.wandb.log({name: float(value)}, step=int(step))
+            by_step.setdefault(int(step), {})[name] = float(value)
+        for step in sorted(by_step):
+            self.wandb.log(by_step[step], step=step)
 
 
 class CSVMonitor(Monitor):
     def __init__(self, cfg):
         self.enabled = False
+        # open handles cached per metric: one open/close per run, not per
+        # event (flush() closes them; the next write reopens in append
+        # mode). Initialized BEFORE the enabled gate: flush()/__del__ on a
+        # disabled instance must not AttributeError
+        self._files: Dict[str, Tuple[Any, Any]] = {}
         if not (cfg.enabled and _is_rank0()):
             return
         self.dir = os.path.join(cfg.output_path or "csv_logs", cfg.job_name)
         os.makedirs(self.dir, exist_ok=True)
-        self._files = {}
         self.enabled = True
+
+    def _writer(self, name: str):
+        ent = self._files.get(name)
+        if ent is None:
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name, "time"])
+            self._files[name] = ent = (f, w)
+        return ent
 
     def write_events(self, events: List[Event]) -> None:
         if not self.enabled:
             return
         for name, value, step in events:
-            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", name, "time"])
-                w.writerow([int(step), float(value), time.time()])
+            _, w = self._writer(name)
+            w.writerow([int(step), float(value), time.time()])
+        for f, _ in self._files.values():
+            # one cheap flush per boundary batch: rows are durable without
+            # the old per-event open/close (a crash must not eat the window
+            # that explains it)
+            f.flush()
+
+    def flush(self):
+        for f, _ in self._files.values():
+            try:
+                f.flush()
+                f.close()
+            except Exception:  # noqa: BLE001 - a dead handle must not stop flush
+                pass
+        self._files = {}
+
+    def __del__(self):  # best-effort durability on interpreter exit
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class JSONLMonitor(Monitor):
+    """Machine-readable sink: one JSON object per line. Scalar events are
+    written as ``{"type": "scalar", "name", "value", "step", "time"}``;
+    structured records (telemetry windows, anomaly events) verbatim plus a
+    timestamp — the format downstream alerting actually wants to tail."""
+
+    def __init__(self, path: str):
+        self.enabled = False
+        self.path = path
+        self._f = None
+        if not (path and _is_rank0()):
+            return
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.enabled = True
+
+    def _handle(self):
+        if self._f is None:
+            self._f = open(self.path, "a")
+        return self._f
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        f = self._handle()
+        for name, value, step in events:
+            f.write(json.dumps({"type": "scalar", "name": name,
+                                "value": _jsonable(float(value)),
+                                "step": int(step), "time": now}) + "\n")
+        f.flush()  # durable per boundary batch, not per interpreter exit
+
+    def write_records(self, records: List[Dict[str, Any]]) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        f = self._handle()
+        for r in records:
+            rec = _jsonable(dict(r))
+            rec.setdefault("time", now)
+            f.write(json.dumps(rec, default=str) + "\n")
+        f.flush()
+
+    def flush(self):
+        if self._f is not None:
+            try:
+                self._f.flush()
+                self._f.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._f = None
+
+    def __del__(self):
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class MonitorMaster(Monitor):
@@ -117,12 +263,32 @@ class MonitorMaster(Monitor):
             WandbMonitor(config.wandb),
             CSVMonitor(config.csv_monitor),
         ]
+        jsonl_path = None
+        jm = getattr(config, "json_monitor", None)
+        if jm is not None and jm.enabled:
+            jsonl_path = os.path.join(jm.output_path or "jsonl_logs",
+                                      (jm.job_name or "job") + ".jsonl")
+        else:
+            tel = getattr(config, "telemetry", None)
+            # telemetry.enabled is the documented master switch — jsonl_path
+            # alone must not activate the sink (use the json_monitor section
+            # for a standalone JSONL sink)
+            if tel is not None and getattr(tel, "enabled", False) \
+                    and getattr(tel, "jsonl_path", None):
+                jsonl_path = tel.jsonl_path
+        if jsonl_path:
+            self.sinks.append(JSONLMonitor(jsonl_path))
         self.enabled = any(s.enabled for s in self.sinks)
 
     def write_events(self, events: List[Event]) -> None:
         for s in self.sinks:
             if s.enabled:
                 s.write_events(events)
+
+    def write_records(self, records: List[Dict[str, Any]]) -> None:
+        for s in self.sinks:
+            if s.enabled:
+                s.write_records(records)
 
     def flush(self):
         for s in self.sinks:

@@ -82,15 +82,26 @@ class PrefetchLoader:
     batch production (collate) is burstier than one step. Batch ORDER is the
     wrapped loader's order — prefetch reorders nothing, including across
     epoch boundaries (``set_epoch``/``epoch`` proxy through).
+
+    ``tracer`` (a telemetry ``StepTracer``) records each device_put top-up
+    as a ``prefetch`` span in the step trace timeline.
     """
 
-    def __init__(self, loader, put_fn: Callable[[Any], Any], depth: int = 2):
+    def __init__(self, loader, put_fn: Callable[[Any], Any], depth: int = 2,
+                 tracer=None):
         if put_fn is None:
             raise ValueError("PrefetchLoader needs a device placement fn "
                              "(engine._device_batch)")
         self.loader = loader
         self.put_fn = put_fn
         self.depth = max(1, int(depth))
+        self.tracer = tracer
+
+    def _put(self, batch):
+        if self.tracer is not None:
+            with self.tracer.span("prefetch", cat="data"):
+                return self.put_fn(batch)
+        return self.put_fn(batch)
 
     def __len__(self):
         return len(self.loader)
@@ -108,7 +119,7 @@ class PrefetchLoader:
         buf = collections.deque()
         try:
             while len(buf) < self.depth:
-                buf.append(self.put_fn(next(it)))
+                buf.append(self._put(next(it)))
         except StopIteration:
             pass
         while buf:
@@ -116,7 +127,7 @@ class PrefetchLoader:
             # top up BEFORE yielding: the put of batch N+depth is queued
             # while the consumer still holds (and then steps on) batch N
             try:
-                buf.append(self.put_fn(next(it)))
+                buf.append(self._put(next(it)))
             except StopIteration:
                 pass
             yield out

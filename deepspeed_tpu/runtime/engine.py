@@ -20,6 +20,7 @@ API parity:
   engine.global_steps, get_lr, get_loss_scale, ...
 """
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -43,6 +44,7 @@ from deepspeed_tpu.runtime import fp16 as fp16_mod
 from deepspeed_tpu.runtime import zero as zero_mod
 from deepspeed_tpu.runtime import checkpointing as ckpt_mod
 from deepspeed_tpu.runtime.lr_schedules import get_scheduler
+from deepspeed_tpu.telemetry import accumulators as tel_acc
 from deepspeed_tpu.utils import logging as log_mod
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -532,6 +534,15 @@ class Engine:
             self._moq = build_moq(config.quantize_training,
                                   model.config.num_layers)
 
+        # --- telemetry (deepspeed_tpu/telemetry): the accumulator leaf lives
+        # in the donated jitted state so the jitted paths advance it in-graph;
+        # host-driven optimizer paths (NVMe swapper, layer-streamed executor)
+        # mirror it host-side — their metrics are host-resident by design
+        tcfg = config.telemetry
+        self._tel_cfg = tcfg if tcfg.enabled else None
+        self._tel_in_graph = (tcfg.enabled and not self._nvme_opt
+                              and not self._infinity)
+
         # --- state init (sharded at creation; reference: zero.Init equivalent)
         self.state_shardings = None
         if self._infinity:
@@ -560,6 +571,35 @@ class Engine:
         self._accum_count = 0
         self.monitor = self._build_monitor()
         self.losses = None
+        # --- telemetry host-side pieces (tracer, anomaly, window bookkeeping)
+        self._tracer = None
+        self._anomaly = None
+        self._tel_host = None
+        self._tel_prev = None        # last drained cumulative snapshot
+        self._tel_wall = None        # perf_counter at the last drain
+        self._tel_wall_steps = 0     # global_steps at the last drain
+        self._tel_last_window = None  # last drained window stats (host dict)
+        self._tel_static = None      # cached static-join cost ({} = failed)
+        self._tel_static_thread = None  # background lower/compile worker
+        self._tel_abs = None         # (jitted fn, abstract args, divisor)
+        if self._tel_cfg is not None:
+            from deepspeed_tpu.telemetry import (AnomalyDetector, HostWindow,
+                                                 StepTracer)
+            self._tracer = StepTracer(trace_cfg=self._tel_cfg.trace,
+                                      max_events=self._tel_cfg.max_trace_events)
+            if self._tel_cfg.anomaly.enabled:
+                self._anomaly = AnomalyDetector(self._tel_cfg.anomaly)
+            if not self._tel_in_graph:
+                self._tel_host = HostWindow(self._tel_cfg.gnorm_hist_buckets)
+        # comms-logger wiring (reference: the comms_logger config section
+        # configures the logger at engine init; its totals reach the monitor
+        # as comm/* events at steps_per_print boundaries — see _log_step)
+        if config.comms_logger.enabled:
+            from deepspeed_tpu.comm import comms_logger
+            comms_logger.configure(
+                enabled=True, verbose=config.comms_logger.verbose,
+                prof_ops=(() if config.comms_logger.prof_all
+                          else config.comms_logger.prof_ops))
         # --- data efficiency (reference: runtime/data_pipeline/*)
         self._curriculum = None
         if config.curriculum_learning.enabled:
@@ -658,6 +698,11 @@ class Engine:
                 # this on overflow so the host never fetches the overflow
                 # flag in the hot loop (engine.skipped_steps reads it lazily)
                 state["skipped"] = jnp.zeros((), jnp.int32)
+            if self._tel_in_graph:
+                # telemetry accumulators ride the donated state the same way:
+                # advanced in-graph, drained by _log_step's one batched fetch
+                state["telemetry"] = tel_acc.init_leaf(
+                    cfg.telemetry.gnorm_hist_buckets)
             return state
 
         # Determine opt-state sharding by matching leaves against params:
@@ -845,6 +890,9 @@ class Engine:
                 lambda s: NamedSharding(mesh, P()), state_shapes["loss_scale"])
         if "skipped" in state_shapes:
             out["skipped"] = NamedSharding(mesh, P())
+        if "telemetry" in state_shapes:
+            out["telemetry"] = jax.tree.map(
+                lambda s: NamedSharding(mesh, P()), state_shapes["telemetry"])
         return out
 
     # ------------------------------------------------------------------
@@ -913,6 +961,9 @@ class Engine:
 
         moq = self._moq
 
+        tel_on = self._tel_in_graph
+        tel_ratio = tel_on and cfg.telemetry.update_ratio
+
         def micro_grads(params, mb, rng, scale, step=None):
             def loss_fn(p):
                 if compression is not None:
@@ -975,6 +1026,16 @@ class Engine:
                 # steps_per_print boundaries
                 new_state["skipped"] = (state["skipped"]
                                         + overflow.astype(jnp.int32))
+            if tel_on:
+                # in-graph telemetry accumulators: scalar ops over values the
+                # step already computed (zero added syncs; the update/param
+                # norms are the only extra reductions, and only when
+                # telemetry.update_ratio is on)
+                ratio = (tel_acc.update_to_param_ratio(new_params, params)
+                         if tel_ratio else None)
+                new_state["telemetry"] = tel_acc.accumulate(
+                    state["telemetry"], loss=mean_loss, gnorm=gnorm,
+                    overflow=overflow, update_ratio=ratio)
             metrics = {"loss": mean_loss, "grad_norm": gnorm,
                        "overflow": overflow}
             if fp16:
@@ -1089,6 +1150,8 @@ class Engine:
         clip = cfg.gradient_clipping
         compression = self._compression
         moq = self._moq
+        tel_on = self._tel_in_graph
+        tel_ratio = tel_on and cfg.telemetry.update_ratio
 
         def per_device(state, batch, rng):
             params = state["params"]
@@ -1174,6 +1237,15 @@ class Engine:
                                            "hysteresis": new_ls.hysteresis}
                 new_state["skipped"] = (state["skipped"]
                                         + overflow.astype(jnp.int32))
+            if tel_on:
+                # inputs (pmean'd loss/gnorm, pmax'd overflow) and the
+                # replicated params are rank-identical, so the accumulated
+                # leaf stays rank-identical — its out_spec is P()
+                ratio = (tel_acc.update_to_param_ratio(new_params, params)
+                         if tel_ratio else None)
+                new_state["telemetry"] = tel_acc.accumulate(
+                    state["telemetry"], loss=mean_loss, gnorm=gnorm,
+                    overflow=overflow, update_ratio=ratio)
             metrics = {"loss": mean_loss, "grad_norm": gnorm,
                        "overflow": overflow}
             if fp16:
@@ -1191,6 +1263,9 @@ class Engine:
             state_spec["loss_scale"] = {k: P() for k in
                                         self.state["loss_scale"]}
             state_spec["skipped"] = P()
+        if tel_on:
+            state_spec["telemetry"] = {k: P() for k in
+                                       self.state["telemetry"]}
         out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
         if fp16:
             out_metrics_spec["loss_scale"] = P()
@@ -1227,6 +1302,10 @@ class Engine:
         also covers engine fwd/bwd/step loop for non-pipe)."""
         self._activate_context()
         self.tput_timer.start()
+        if self._tracer is not None:
+            # windowed jax.profiler capture (telemetry.trace) — a no-op
+            # outside the configured window
+            self._tracer.maybe_profile(self.global_steps)
         self._rng, sub = jax.random.split(self._rng)
         if self._act_quant and not self._act_quant_on and \
                 self.global_steps + 1 >= self._act_quant[1]:
@@ -1266,34 +1345,42 @@ class Engine:
             self.micro_steps += self.config.gradient_accumulation_steps
             if self._fp16 and bool(metrics.get("overflow")):
                 self._skipped_offset += 1
+            self._tel_anchor()
             self.tput_timer.stop(output=metrics)
             self._log_step(dict(metrics))
             return metrics
         batch = self._device_batch(batch)
-        if self._nvme_opt:
-            with self.mesh:
-                mean_loss, grads = self._batch_grads(self.state, batch, sub)
-            metrics = self._nvme_apply(grads, mean_loss)
-        elif self._onebit_comm:
-            phase = self.optimizer.phase_for(self._onebit_applied)
-            step_fn = self._get_onebit_step(phase, batch)
-            with self.mesh:
-                self.state, metrics = step_fn(self.state, batch, sub)
-            # EXPLICIT sync point: the warm->compressed phase switch is a
-            # host decision keyed on the applied-update count, so this path
-            # pays one overflow fetch per step by design (skip accounting
-            # itself stays in-graph — state["skipped"])
-            if not (self._fp16 and bool(metrics["overflow"])):
-                self._onebit_applied += 1  # overflow steps don't advance
-        else:
-            if self._offload_opt:
-                self.state["opt"] = self._opt_to_device(self.state["opt"])
-            with self.mesh:
-                self.state, metrics = self._train_step(self.state, batch, sub)
-            if self._offload_opt:
-                self.state["opt"] = self._opt_to_host(self.state["opt"])
+        with self._tel_span("dispatch"):
+            if self._nvme_opt:
+                with self.mesh:
+                    mean_loss, grads = self._batch_grads(self.state, batch,
+                                                         sub)
+                metrics = self._nvme_apply(grads, mean_loss)
+            elif self._onebit_comm:
+                phase = self.optimizer.phase_for(self._onebit_applied)
+                step_fn = self._get_onebit_step(phase, batch)
+                self._capture_static_args(step_fn, (self.state, batch, sub), 1)
+                with self.mesh:
+                    self.state, metrics = step_fn(self.state, batch, sub)
+                # EXPLICIT sync point: the warm->compressed phase switch is a
+                # host decision keyed on the applied-update count, so this
+                # path pays one overflow fetch per step by design (skip
+                # accounting itself stays in-graph — state["skipped"])
+                if not (self._fp16 and bool(metrics["overflow"])):
+                    self._onebit_applied += 1  # overflow steps don't advance
+            else:
+                if self._offload_opt:
+                    self.state["opt"] = self._opt_to_device(self.state["opt"])
+                self._capture_static_args(
+                    self._train_step, (self.state, batch, sub), 1)
+                with self.mesh:
+                    self.state, metrics = self._train_step(self.state, batch,
+                                                           sub)
+                if self._offload_opt:
+                    self.state["opt"] = self._opt_to_host(self.state["opt"])
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
+        self._tel_anchor()
         # no host overflow fetch here: skip accounting is in-graph for the
         # jitted paths (reference step:1635 does it eagerly; the eager bool()
         # was the per-step stall this engine removes), and _nvme_apply
@@ -1349,13 +1436,16 @@ class Engine:
         it = itertools.islice(iter(data_iter), num_steps)
         if not use_fused and pcfg.prefetch and not self._infinity:
             from deepspeed_tpu.runtime.dataloader import PrefetchLoader
-            it = iter(PrefetchLoader(it, put_fn=self._device_batch))
+            it = iter(PrefetchLoader(it, put_fn=self._device_batch,
+                                     tracer=self._tracer))
+        _span = self._tel_span
         window = collections.deque()
         metrics = None
         done = 0
         while done < num_steps:
             if use_fused and num_steps - done >= k:
-                chunk = list(itertools.islice(it, k))
+                with _span("data_wait"):
+                    chunk = list(itertools.islice(it, k))
                 if not chunk:
                     break
                 if len(chunk) < k:
@@ -1370,7 +1460,8 @@ class Engine:
                 done += k
             else:
                 try:
-                    batch = next(it)
+                    with _span("data_wait"):
+                        batch = next(it)
                 except StopIteration:
                     break
                 metrics = self.train_batch(batch)
@@ -1379,8 +1470,11 @@ class Engine:
             if len(window) > in_flight:
                 # bound host run-ahead: wait for the oldest in-flight step
                 # before dispatching further (backpressure, not a stall —
-                # in_flight-1 steps are still queued behind it)
-                jax.block_until_ready(window.popleft())
+                # in_flight-1 steps are still queued behind it). The tracer's
+                # "block" span is the dispatch-stall signal the anomaly
+                # detector watches.
+                with _span("block"):
+                    jax.block_until_ready(window.popleft())
         if done < num_steps:
             logger.warning(f"train_batches: iterator exhausted after {done} "
                            f"of {num_steps} steps")
@@ -1442,14 +1536,19 @@ class Engine:
         metrics are the last sub-step's, still device-resident."""
         k = len(batches)
         self.tput_timer.start()
+        if self._tracer is not None:
+            self._tracer.maybe_profile(self.global_steps)
         self._rng, sub = jax.random.split(self._rng)
         rngs = jax.random.split(sub, k)
         placed = self._device_batches(_stack_batches(batches))
-        with self.mesh:
-            self.state, metrics_k = self._get_fused_step(k)(
-                self.state, placed, rngs)
+        fused_fn = self._get_fused_step(k)
+        self._capture_static_args(fused_fn, (self.state, placed, rngs), k)
+        with self._tel_span("dispatch"):
+            with self.mesh:
+                self.state, metrics_k = fused_fn(self.state, placed, rngs)
         self.global_steps += k
         self.micro_steps += k * self.config.gradient_accumulation_steps
+        self._tel_anchor()
         metrics = jax.tree.map(lambda v: v[-1], metrics_k)  # lazy slice
         self.tput_timer.stop(output=metrics, steps=k)
         self._log_step(dict(metrics))
@@ -1696,6 +1795,11 @@ class Engine:
         if "grad_norm" in metrics:
             self._last_grad_norm = metrics["grad_norm"]
         cfg = self.config
+        if self._tel_host is not None:
+            # host-driven optimizer paths: queue the step's metric scalars
+            # UN-fetched; the boundary drain below folds them in with the
+            # same single device_get
+            self._tel_host.add(metrics)
         # window-crossing check, not `% == 0`: a fused K-step dispatch
         # advances global_steps by K and can stride over the exact multiple
         window = self.global_steps // max(1, cfg.steps_per_print)
@@ -1703,28 +1807,230 @@ class Engine:
             return
         self._last_log_window = window
         # the ONE steady-state sync point of the hot loop: every logged
-        # metric comes back in a single device_get instead of one blocking
-        # float() per metric
-        fetch = {k: metrics[k] for k in ("loss", "grad_norm", "loss_scale")
+        # metric AND the telemetry accumulator leaf come back in a single
+        # device_get instead of one blocking float() per metric
+        extra = {k: metrics[k] for k in ("loss", "grad_norm", "loss_scale")
                  if k in metrics}
-        vals = {k: float(np.asarray(v))
-                for k, v in jax.device_get(fetch).items()}
-        lr = self.get_lr()
+        need_skipped = (self._schedule is not None
+                        and isinstance(self.state, dict)
+                        and "skipped" in self.state)
+        if need_skipped:
+            # the LR schedule evaluates at the applied-update count, which
+            # needs the device skip counter — ride the same batched fetch
+            # instead of a second round trip through get_lr()
+            extra["_skipped"] = self.state["skipped"]
+        tel_cur, fetched = self._fetch_telemetry(extra=extra)
+        skipped_dev = fetched.pop("_skipped", None)
+        vals = {k: float(np.asarray(v)) for k, v in fetched.items()}
+        if self._schedule is not None:
+            skipped = self._skipped_offset + (
+                int(np.asarray(skipped_dev)) if skipped_dev is not None
+                else self._device_skipped())
+            lr = float(self._schedule(self.global_steps - skipped + 1))
+        else:
+            lr = self.get_lr()
         msg = (f"step={self.global_steps} loss={vals['loss']:.4f} "
                f"lr={lr:.3e} gnorm={vals.get('grad_norm', 0.0):.3f}")
         if "loss_scale" in vals:
             msg += f" scale={vals['loss_scale']:.0f}"
         logger.info(msg)
+        events = [("Train/loss", vals["loss"], self.global_steps),
+                  ("Train/lr", lr, self.global_steps)]
+        if "grad_norm" in vals:
+            events.append(("Train/grad_norm", vals["grad_norm"],
+                           self.global_steps))
+        if "loss_scale" in vals:
+            events.append(("Train/loss_scale", vals["loss_scale"],
+                           self.global_steps))
+        records = []
+        if self._tel_cfg is not None and tel_cur is not None:
+            tel_events, records = self._drain_telemetry(tel_cur)
+            events += tel_events
+        from deepspeed_tpu.comm import comms_logger
+        if comms_logger.enabled:
+            # CommsLogger totals reach the monitor as comm/* events instead
+            # of log-only text (trace-time counts/bytes + host_ms)
+            events += comms_logger.events(self.global_steps)
         if self.monitor is not None and self.monitor.enabled:
-            events = [("Train/loss", vals["loss"], self.global_steps),
-                      ("Train/lr", lr, self.global_steps)]
-            if "grad_norm" in vals:
-                events.append(("Train/grad_norm", vals["grad_norm"],
-                               self.global_steps))
-            if "loss_scale" in vals:
-                events.append(("Train/loss_scale", vals["loss_scale"],
-                               self.global_steps))
             self.monitor.write_events(events)  # one batched write
+            if records:
+                self.monitor.write_records(records)
+
+    # ------------------------------------------------------------------
+    # telemetry plumbing (deepspeed_tpu/telemetry)
+    # ------------------------------------------------------------------
+    def _tel_anchor(self):
+        """Anchor the first telemetry window AFTER the compile-bearing first
+        dispatch so window rates aren't compile-polluted. One place — every
+        dispatch path (dense/onebit/nvme, fused, infinity) calls it."""
+        if self._tel_cfg is not None and self._tel_wall is None:
+            self._tel_wall = time.perf_counter()
+            self._tel_wall_steps = self.global_steps
+
+    def _tel_span(self, name: str):
+        """Tracer span when telemetry is on, else a no-op context."""
+        return (self._tracer.span(name) if self._tracer is not None
+                else contextlib.nullcontext())
+
+    def _capture_static_args(self, fn, args, divisor: int):
+        """Remember the jitted step + abstract arg shapes ONCE so the lazy
+        static x runtime join can lower the same program off the hot path.
+        Abstractify BEFORE dispatch: donation invalidates the state arrays."""
+        if (self._tel_cfg is None or not self._tel_cfg.static_join
+                or self._tel_abs is not None):
+            return
+        try:
+            from deepspeed_tpu.analysis.program import abstractify
+            self._tel_abs = (fn, abstractify(args), divisor)
+        except Exception as e:  # noqa: BLE001 - telemetry never kills a run
+            logger.debug(f"telemetry: static arg capture failed: {e!r}")
+            self._tel_abs = ()   # falsy sentinel: don't retry every step
+
+    def _tel_static_cost(self, wait: bool = False):
+        """Cached per-step compiled costs (flops, modeled comm bytes) from
+        the static join. The AOT lower+compile does NOT reuse the jit
+        dispatch cache, so it runs in a daemon thread kicked off at the
+        first window boundary — the training thread never stalls on it.
+        Boundary drains poll (windows before it lands just lack the joined
+        rates); an explicit drain_telemetry passes wait=True and joins."""
+        if self._tel_static is not None:
+            return self._tel_static or None
+        if not self._tel_abs:
+            return None
+        if self._tel_static_thread is None:
+            import threading
+
+            def work():
+                from deepspeed_tpu.telemetry import static_step_cost
+                fn, abs_args, divisor = self._tel_abs
+                cost = static_step_cost(fn, abs_args, mesh=self.mesh,
+                                        divisor=divisor)
+                self._tel_static = cost or {}
+
+            self._tel_static_thread = threading.Thread(
+                target=work, name="telemetry-static-join", daemon=True)
+            self._tel_static_thread.start()
+        if wait:
+            self._tel_static_thread.join()
+        elif self._tel_static_thread.is_alive():
+            return None
+        if self._tel_static is None:  # worker died without a result
+            self._tel_static = {}
+        return self._tel_static or None
+
+    def _fetch_telemetry(self, extra=None):
+        """ONE batched device_get covering the caller's metric scalars, the
+        in-graph accumulator leaf, and any pending host-window scalars.
+        Returns (cumulative telemetry snapshot | None, fetched extras)."""
+        fetch = dict(extra or {})
+        if self._tel_in_graph and isinstance(self.state, dict) \
+                and "telemetry" in self.state:
+            fetch["_telemetry"] = self.state["telemetry"]
+        if self._tel_host is not None:
+            fetch["_tel_pending"] = self._tel_host.pending()
+        fetched = jax.device_get(fetch)
+        tel_cur = fetched.pop("_telemetry", None)
+        pending = fetched.pop("_tel_pending", None)
+        if self._tel_host is not None:
+            tel_cur = self._tel_host.drain(pending)
+        return tel_cur, fetched
+
+    def _drain_telemetry(self, tel_cur, wait_static: bool = False):
+        """Window statistics + events + structured records from one drained
+        cumulative snapshot. Pure host work — the device fetch already
+        happened in the caller's batched device_get."""
+        from deepspeed_tpu.telemetry import joined_rates, window_stats
+        now = time.perf_counter()
+        wall = (now - self._tel_wall) if self._tel_wall is not None else None
+        steps_in_window = self.global_steps - self._tel_wall_steps
+        self._tel_wall, self._tel_wall_steps = now, self.global_steps
+        win = window_stats(tel_cur, self._tel_prev)
+        self._tel_prev = tel_cur
+        if not (self._tel_in_graph and self._tel_cfg.update_ratio):
+            # no ratio data on this path (disabled, or a host-driven
+            # executor whose metrics carry no update norms) — a constant-0
+            # series would read as "updates stopped"
+            win.pop("update_ratio_mean", None)
+            win.pop("update_ratio_max", None)
+        if self._tracer is not None:
+            win.update(self._tracer.drain_window())
+            if "data_wait_ms" in win and "prefetch_ms" in win:
+                # the prefetch device_put runs INSIDE the data_wait span
+                # (PrefetchLoader tops up during next()); keep the nested
+                # spans in the Chrome trace but un-double-count the window
+                # total so data_wait_ms means "blocked on data, not placing"
+                win["data_wait_ms"] = max(
+                    0.0, win["data_wait_ms"] - win["prefetch_ms"])
+            if win["steps"]:
+                win["stall_ms_per_step"] = (win.get("block_ms", 0.0)
+                                            / win["steps"])
+        if wall and wall > 0 and steps_in_window > 0:
+            win["wall_s"] = wall
+            win["steps_per_sec"] = steps_in_window / wall
+            static = self._tel_static_cost(wait=wait_static)
+            if static is not None:
+                from deepspeed_tpu.accelerator import get_accelerator
+                peak = (get_accelerator().peak_flops_per_device("bf16")
+                        * max(1, jax.device_count()))
+                win.update(joined_rates(static, win["steps_per_sec"], peak))
+        self._tel_last_window = win
+        step = self.global_steps
+        events = [(f"telemetry/{k}", float(win[k]), step)
+                  for k in ("loss_mean", "loss_max", "gnorm_mean",
+                            "gnorm_max", "overflow_rate",
+                            "update_ratio_mean", "steps_per_sec",
+                            "window_mfu", "modeled_comm_bytes_per_sec",
+                            "stall_ms_per_step")
+                  if win.get(k) is not None]
+        records = [{"type": "telemetry_window", "step": step, **win}]
+        if self._anomaly is not None:
+            anomalies = self._anomaly.observe(win, step=step)
+            for a in anomalies:
+                logger.warning(f"anomaly[{a['severity']}] {a['rule']}: "
+                               f"{a['message']}")
+                if self._tracer is not None:
+                    self._tracer.instant(f"anomaly:{a['rule']}",
+                                         args={"severity": a["severity"]})
+            # anomalies travel as records ONLY: scalar sinks get their
+            # anomaly/<rule> projection from write_records (adding them to
+            # `events` too would double-write every scalar sink)
+            records += anomalies
+        return events, records
+
+    def drain_telemetry(self):
+        """Force a window drain outside a steps_per_print boundary (one
+        batched device fetch; events/records still fan out). Returns the
+        window stats dict, or None when telemetry is off."""
+        if self._tel_cfg is None:
+            return None
+        tel_cur, _ = self._fetch_telemetry()
+        if tel_cur is None:
+            return None
+        events, records = self._drain_telemetry(tel_cur, wait_static=True)
+        if self.monitor is not None and self.monitor.enabled:
+            if events:
+                self.monitor.write_events(events)
+            if records:
+                self.monitor.write_records(records)
+        return self._tel_last_window
+
+    def telemetry_window(self):
+        """Last drained telemetry window stats (None before the first
+        drain). Host dict — reading it costs nothing."""
+        return self._tel_last_window
+
+    def export_trace(self, path: Optional[str] = None) -> str:
+        """Write the host step-phase spans (dispatch/prefetch/data_wait/
+        block) as Chrome-trace JSON loadable by chrome://tracing or
+        Perfetto. Requires telemetry.enabled."""
+        if self._tracer is None:
+            raise RuntimeError("step tracing requires config "
+                               '{"telemetry": {"enabled": true}}')
+        if path is None:
+            out = self.config.telemetry.trace.output_dir
+            os.makedirs(out, exist_ok=True)
+            path = os.path.join(out, f"step_trace_{self.global_steps}.json")
+        return self._tracer.export_chrome_trace(path)
 
     # ------------------------------------------------------------------
     # info API (reference parity helpers)
@@ -1849,24 +2155,44 @@ class Engine:
                 load_dir, tag, template=self.state,
                 shardings=self.state_shardings)
         except Exception as orig:
-            if not (isinstance(self.state, dict) and "skipped" in self.state):
+            optional = [k for k in ("skipped", "telemetry")
+                        if isinstance(self.state, dict) and k in self.state]
+            if not optional:
                 raise
-            # fp16 checkpoints written before the device-resident skip
-            # counter have no "skipped" leaf: restore without it, then
-            # rebuild it as zero — the skipped_steps setter reconciles the
-            # host offset against client_state below. If the retry fails
-            # too, the failure wasn't the missing leaf: surface the
-            # ORIGINAL error, not the retry's
-            tmpl = {k: v for k, v in self.state.items() if k != "skipped"}
-            sh = {k: v for k, v in self.state_shardings.items()
-                  if k != "skipped"}
-            try:
-                state, client_state = ckpt_mod.load_checkpoint(
-                    load_dir, tag, template=tmpl, shardings=sh)
-            except Exception:
+            # checkpoints written before the device-resident skip counter /
+            # telemetry accumulators lack those leaves: retry without each
+            # combination, rebuild the dropped leaves fresh (the
+            # skipped_steps setter reconciles the host offset against
+            # client_state below). If every retry fails, the failure wasn't
+            # the missing leaves: surface the ORIGINAL error, not a retry's
+            import itertools as _it
+            state = None
+            dropped = ()
+            for r in range(1, len(optional) + 1):
+                for drop in _it.combinations(optional, r):
+                    tmpl = {k: v for k, v in self.state.items()
+                            if k not in drop}
+                    sh = {k: v for k, v in self.state_shardings.items()
+                          if k not in drop}
+                    try:
+                        state, client_state = ckpt_mod.load_checkpoint(
+                            load_dir, tag, template=tmpl, shardings=sh)
+                        dropped = drop
+                        break
+                    except Exception:
+                        continue
+                if state is not None:
+                    break
+            if state is None:
                 raise orig
-            state["skipped"] = jax.device_put(
-                jnp.zeros((), jnp.int32), self.state_shardings["skipped"])
+            if "skipped" in dropped:
+                state["skipped"] = jax.device_put(
+                    jnp.zeros((), jnp.int32), self.state_shardings["skipped"])
+            if "telemetry" in dropped:
+                state["telemetry"] = jax.device_put(
+                    tel_acc.init_leaf(
+                        self.config.telemetry.gnorm_hist_buckets),
+                    self.state_shardings["telemetry"])
         if not load_optimizer_states:
             state["opt"] = self.state["opt"]
         if self._offload_opt:
@@ -1883,6 +2209,11 @@ class Engine:
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
+        # restored cumulative telemetry counters: restart the window diff
+        # baseline so the first post-restore window isn't a cross-run delta
+        self._tel_prev = None
+        self._tel_wall = None
+        self._tel_wall_steps = self.global_steps
         if self._onebit_comm:
             # phase selection must track the OPTIMIZER's applied count, which
             # resets when load_optimizer_states=False while global_steps

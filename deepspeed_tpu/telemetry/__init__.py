@@ -1,0 +1,39 @@
+"""Unified telemetry: in-graph accumulators, step tracing, anomaly detection.
+
+The observability layer for the async hot loop (ROADMAP: production-scale
+serving with zero added steady-state syncs). Four pieces:
+
+  accumulators — cumulative device counters in the donated ``state
+                 ["telemetry"]`` leaf, advanced inside the jitted step and
+                 drained through ``engine._log_step``'s ONE batched
+                 device_get; windows are host-side snapshot diffs
+  tracing      — host span recorder around the dispatch/prefetch/block
+                 phases of ``engine.train_batches`` (Chrome-trace export)
+                 plus windowed ``jax.profiler`` capture
+  anomaly      — structured-severity events (loss spikes, grad-norm drift,
+                 overflow bursts, dispatch-stall regressions) from the
+                 drained window stats
+  join         — graft-lint's static collective census and XLA's compiled
+                 flops priced by the observed step rate: modeled comms
+                 bytes/sec and per-window MFU as monitor events
+
+Enable with config ``{"telemetry": {"enabled": true}}``; see the README
+"Observability" section for the full reference.
+"""
+
+from deepspeed_tpu.telemetry.accumulators import (HIST_BUCKETS, HIST_LOG2_MIN,
+                                                  HostWindow, accumulate,
+                                                  init_leaf,
+                                                  update_to_param_ratio,
+                                                  window_stats)
+from deepspeed_tpu.telemetry.anomaly import (SEVERITY_NUM, AnomalyDetector,
+                                             severity_num)
+from deepspeed_tpu.telemetry.join import joined_rates, static_step_cost
+from deepspeed_tpu.telemetry.tracing import StepTracer
+
+__all__ = [
+    "HIST_BUCKETS", "HIST_LOG2_MIN", "AnomalyDetector", "HostWindow",
+    "SEVERITY_NUM", "StepTracer", "accumulate", "init_leaf", "joined_rates",
+    "severity_num", "static_step_cost", "update_to_param_ratio",
+    "window_stats",
+]

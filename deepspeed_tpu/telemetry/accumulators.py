@@ -1,0 +1,203 @@
+"""In-graph metric accumulators for the telemetry subsystem.
+
+Reference analogue: none as one piece — DeepSpeed's monitoring is an eager
+fan-out of per-step host scalars (``monitor/monitor.py`` MonitorMaster fed by
+``engine._write_monitor_events``), which costs one device sync per metric per
+step. This runtime's hot loop (PR 2) performs NO steady-state host sync
+besides the single batched ``device_get`` in ``engine._log_step`` at
+``steps_per_print`` boundaries, so richer statistics must be computed *on
+device, inside the jitted step*.
+
+Design: the accumulators are CUMULATIVE counters living in a donated
+``state["telemetry"]`` leaf, advanced by :func:`accumulate` with a handful of
+scalar ops (plus one ``[n_buckets]`` one-hot add for the grad-norm
+log-histogram). There is no in-graph reset and no extra dispatch: the host
+derives per-window statistics by DIFFING two consecutive drained snapshots
+(:func:`window_stats`). Running maxima are cumulative by construction.
+
+Host-driven optimizer paths (NVMe swapper, layer-streamed ZeRO-Infinity)
+never run a jitted optimizer apply, so they mirror the same leaf host-side
+(:class:`HostWindow`): their per-step metric scalars queue *un-fetched* and
+are folded in by the same single batched ``device_get`` at the window
+boundary.
+"""
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# grad-norm log2 histogram: bucket 0 collects everything below 2**HIST_LOG2_MIN,
+# interior bucket k (1..n-2) covers [2^(HIST_LOG2_MIN+k-1), 2^(HIST_LOG2_MIN+k)),
+# and the last bucket everything >= 2**(HIST_LOG2_MIN + n_buckets - 2); with the
+# defaults (16 buckets) the interior spans [2^-8, 2^6). Overflow steps don't
+# contribute at all — their loss-scale-saturated norms carry no signal.
+HIST_BUCKETS = 16
+HIST_LOG2_MIN = -8
+
+_FLOAT_KEYS = ("loss_sum", "loss_max", "gnorm_sum", "gnorm_max",
+               "ratio_sum", "ratio_max")
+_INT_KEYS = ("steps", "overflows")
+
+
+def init_leaf(n_buckets: int = HIST_BUCKETS) -> Dict[str, Any]:
+    """Fresh cumulative accumulator leaf (all replicated scalars + one
+    ``[n_buckets]`` int32 histogram). Lives in the donated jitted state."""
+    import jax.numpy as jnp
+    return {
+        "steps": jnp.zeros((), jnp.int32),
+        "overflows": jnp.zeros((), jnp.int32),
+        "loss_sum": jnp.zeros((), jnp.float32),
+        "loss_max": jnp.full((), -jnp.inf, jnp.float32),
+        "gnorm_sum": jnp.zeros((), jnp.float32),
+        "gnorm_max": jnp.zeros((), jnp.float32),
+        "gnorm_hist": jnp.zeros((n_buckets,), jnp.int32),
+        "ratio_sum": jnp.zeros((), jnp.float32),
+        "ratio_max": jnp.zeros((), jnp.float32),
+    }
+
+
+def update_to_param_ratio(new_params, params):
+    """Global ||update|| / ||param|| of one optimizer step, in f32. On an
+    overflow-skipped step ``new_params == params`` and the ratio is 0."""
+    import jax
+    import jax.numpy as jnp
+    n_leaves = jax.tree.leaves(new_params)
+    o_leaves = jax.tree.leaves(params)
+    d2 = sum(jnp.sum(jnp.square(n.astype(jnp.float32) - o.astype(jnp.float32)))
+             for n, o in zip(n_leaves, o_leaves))
+    p2 = sum(jnp.sum(jnp.square(o.astype(jnp.float32))) for o in o_leaves)
+    return jnp.sqrt(d2) / (jnp.sqrt(p2) + 1e-12)
+
+
+def accumulate(tel: Dict[str, Any], *, loss, gnorm, overflow,
+               update_ratio=None) -> Dict[str, Any]:
+    """One jitted-step advance of the cumulative leaf. All inputs are traced
+    scalars the step already computed — no new reductions over model-sized
+    tensors happen here (``update_ratio`` is the caller's, see
+    :func:`update_to_param_ratio`). Overflow steps count into ``steps`` and
+    ``overflows`` but are excluded from the value statistics: their
+    loss/grads are loss-scale saturated garbage."""
+    import jax
+    import jax.numpy as jnp
+    loss = jnp.asarray(loss, jnp.float32)
+    gnorm = jnp.asarray(gnorm, jnp.float32)
+    ok = jnp.logical_not(overflow)
+    okf = ok.astype(jnp.float32)
+    n_buckets = tel["gnorm_hist"].shape[0]
+    bucket = jnp.clip(
+        jnp.floor(jnp.log2(jnp.maximum(gnorm, jnp.float32(1e-30))))
+        - (HIST_LOG2_MIN - 1),
+        0, n_buckets - 1).astype(jnp.int32)
+    new = dict(tel)
+    new["steps"] = tel["steps"] + 1
+    new["overflows"] = tel["overflows"] + overflow.astype(jnp.int32)
+    new["loss_sum"] = tel["loss_sum"] + okf * loss
+    new["loss_max"] = jnp.where(ok, jnp.maximum(tel["loss_max"], loss),
+                                tel["loss_max"])
+    new["gnorm_sum"] = tel["gnorm_sum"] + okf * gnorm
+    new["gnorm_max"] = jnp.where(ok, jnp.maximum(tel["gnorm_max"], gnorm),
+                                 tel["gnorm_max"])
+    new["gnorm_hist"] = tel["gnorm_hist"] + jnp.where(
+        ok, jax.nn.one_hot(bucket, n_buckets, dtype=jnp.int32),
+        jnp.zeros((n_buckets,), jnp.int32))
+    if update_ratio is not None:
+        ratio = jnp.asarray(update_ratio, jnp.float32)
+        new["ratio_sum"] = tel["ratio_sum"] + okf * ratio
+        new["ratio_max"] = jnp.where(
+            ok, jnp.maximum(tel["ratio_max"], ratio), tel["ratio_max"])
+    return new
+
+
+def window_stats(cur: Dict[str, Any],
+                 prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Per-window statistics from two consecutive drained (host) snapshots
+    of the cumulative leaf. ``prev=None`` means 'since start'. Maxima are
+    running (cumulative) — the ISSUE contract — sums/counts/histogram are
+    windowed deltas."""
+    def _i(snap, k):
+        return int(np.asarray(snap[k])) if snap is not None else 0
+
+    def _f(snap, k):
+        return float(np.asarray(snap[k])) if snap is not None else 0.0
+
+    steps = _i(cur, "steps") - _i(prev, "steps")
+    overflows = _i(cur, "overflows") - _i(prev, "overflows")
+    applied = max(0, steps - overflows)
+    # the leaf seeds loss_max at -inf; before any applied step that's "no
+    # data", not a value — None keeps it out of scalar sinks (events filter
+    # on `is not None`; the JSONL sink nulls non-finite floats anyway)
+    loss_max = _f(cur, "loss_max")
+    hist_cur = np.asarray(cur["gnorm_hist"], dtype=np.int64)
+    hist_prev = (np.asarray(prev["gnorm_hist"], dtype=np.int64)
+                 if prev is not None else np.zeros_like(hist_cur))
+    out = {
+        "steps": steps,
+        "applied": applied,
+        "overflows": overflows,
+        "overflow_rate": overflows / steps if steps else 0.0,
+        "loss_mean": ((_f(cur, "loss_sum") - _f(prev, "loss_sum")) / applied
+                      if applied else 0.0),
+        "loss_max": loss_max if math.isfinite(loss_max) else None,
+        "gnorm_mean": ((_f(cur, "gnorm_sum") - _f(prev, "gnorm_sum")) / applied
+                       if applied else 0.0),
+        "gnorm_max": _f(cur, "gnorm_max"),
+        "update_ratio_mean": ((_f(cur, "ratio_sum") - _f(prev, "ratio_sum"))
+                              / applied if applied else 0.0),
+        "update_ratio_max": _f(cur, "ratio_max"),
+        "gnorm_hist": (hist_cur - hist_prev).tolist(),
+    }
+    return out
+
+
+class HostWindow:
+    """Host-side mirror of the device accumulator leaf for the host-driven
+    executors (NVMe swapper, layer-streamed infinity). ``add`` queues the
+    step's metric scalars WITHOUT fetching them; the engine fetches the
+    pending list inside its one batched ``device_get`` and folds it in via
+    ``drain``, which returns a cumulative snapshot shaped exactly like a
+    drained device leaf — so ``window_stats`` works unchanged."""
+
+    def __init__(self, n_buckets: int = HIST_BUCKETS):
+        self.n_buckets = n_buckets
+        self._pending: List[Dict[str, Any]] = []
+        self._cum = {
+            "steps": 0, "overflows": 0,
+            "loss_sum": 0.0, "loss_max": -math.inf,
+            "gnorm_sum": 0.0, "gnorm_max": 0.0,
+            "gnorm_hist": np.zeros((n_buckets,), np.int64),
+            "ratio_sum": 0.0, "ratio_max": 0.0,
+        }
+
+    def add(self, metrics: Dict[str, Any]) -> None:
+        self._pending.append({k: metrics[k]
+                              for k in ("loss", "grad_norm", "overflow")
+                              if k in metrics})
+
+    def pending(self) -> List[Dict[str, Any]]:
+        """The un-fetched queue, for inclusion in the engine's batched
+        device_get (device scalars pass through jax.device_get; host floats
+        come back unchanged)."""
+        return list(self._pending)
+
+    def drain(self, fetched: Optional[List[Dict[str, Any]]]) -> Dict[str, Any]:
+        self._pending = []
+        c = self._cum
+        for m in fetched or []:
+            ov = bool(np.asarray(m.get("overflow", False)))
+            c["steps"] += 1
+            if ov:
+                c["overflows"] += 1
+                continue
+            loss = float(np.asarray(m.get("loss", 0.0)))
+            gnorm = float(np.asarray(m.get("grad_norm", 0.0)))
+            c["loss_sum"] += loss
+            c["loss_max"] = max(c["loss_max"], loss)
+            c["gnorm_sum"] += gnorm
+            c["gnorm_max"] = max(c["gnorm_max"], gnorm)
+            b = int(np.clip(math.floor(math.log2(max(gnorm, 1e-30)))
+                            - (HIST_LOG2_MIN - 1), 0, self.n_buckets - 1))
+            c["gnorm_hist"][b] += 1
+        # snapshot COPY: the caller diffs consecutive snapshots
+        return {k: (v.copy() if isinstance(v, np.ndarray) else v)
+                for k, v in c.items()}

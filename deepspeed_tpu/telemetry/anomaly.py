@@ -1,0 +1,132 @@
+"""Anomaly detection over drained telemetry windows.
+
+Reference analogue: none — DeepSpeed logs raw scalars and leaves spike
+hunting to the human reading TensorBoard. Here the window statistics the
+accumulators already produce (zero extra syncs) are compared against
+exponential moving baselines, and violations become STRUCTURED events with a
+severity, fanned out through MonitorMaster and the JSONL sink.
+
+Rules (all thresholds in config section ``telemetry.anomaly``):
+
+  * ``loss_spike``       — window loss mean > factor x EMA baseline
+                            (non-finite loss is always critical)
+  * ``gnorm_drift``      — window grad-norm mean drifts a factor above OR
+                            below its EMA baseline (non-finite -> critical)
+  * ``overflow_burst``   — fp16 overflow rate in the window >= burst rate
+                            (no warmup: a burst is a burst)
+  * ``dispatch_stall``   — host ``block`` time per step regresses a factor
+                            above its EMA baseline (the async pipeline lost
+                            its overlap: input starvation, a new sync, a
+                            slower program)
+
+Baselines update every window with EMA(alpha); the first ``warmup_windows``
+windows only seed baselines and never fire relative rules.
+"""
+
+import math
+from typing import Any, Dict, List
+
+SEVERITY_NUM = {"info": 0, "warning": 1, "critical": 2}
+
+
+def severity_num(severity: str) -> int:
+    return SEVERITY_NUM.get(severity, 1)
+
+
+class AnomalyDetector:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._ema: Dict[str, float] = {}
+        self._windows = 0
+
+    def _update(self, key: str, value: float) -> None:
+        if not math.isfinite(value):
+            return  # a poisoned baseline would mask every later anomaly
+        alpha = self.cfg.ema_alpha
+        prev = self._ema.get(key)
+        self._ema[key] = value if prev is None else \
+            alpha * value + (1.0 - alpha) * prev
+
+    def baseline(self, key: str):
+        return self._ema.get(key)
+
+    def observe(self, window: Dict[str, Any], step: int) -> List[Dict[str, Any]]:
+        """Evaluate every rule against one drained window; returns the
+        structured events (possibly empty) and folds the window into the
+        EMA baselines."""
+        cfg = self.cfg
+        events: List[Dict[str, Any]] = []
+        warm = self._windows >= cfg.warmup_windows
+
+        def fire(rule, severity, value, baseline, threshold, message):
+            events.append({
+                "type": "anomaly", "rule": rule, "severity": severity,
+                "step": int(step), "value": float(value),
+                "baseline": None if baseline is None else float(baseline),
+                "threshold": float(threshold), "message": message,
+            })
+
+        applied = int(window.get("applied", 0) or 0)
+        loss = float(window.get("loss_mean", 0.0) or 0.0)
+        gnorm = float(window.get("gnorm_mean", 0.0) or 0.0)
+
+        if applied > 0:
+            if not math.isfinite(loss):
+                fire("loss_spike", "critical", loss, self.baseline("loss"),
+                     float("inf"), f"window loss mean is non-finite ({loss})")
+            else:
+                base = self.baseline("loss")
+                if warm and base is not None:
+                    thr = cfg.loss_spike_factor * abs(base) + 1e-12
+                    if abs(loss) > thr:
+                        sev = ("critical"
+                               if abs(loss) > 2 * cfg.loss_spike_factor
+                               * abs(base) + 1e-12 else "warning")
+                        fire("loss_spike", sev, loss, base, thr,
+                             f"window loss mean {loss:.4g} exceeds "
+                             f"{cfg.loss_spike_factor:g}x baseline "
+                             f"{base:.4g}")
+
+            if not math.isfinite(gnorm):
+                fire("gnorm_drift", "critical", gnorm,
+                     self.baseline("gnorm"), float("inf"),
+                     f"window grad-norm mean is non-finite ({gnorm})")
+            else:
+                base = self.baseline("gnorm")
+                if warm and base is not None and base > 0:
+                    hi = cfg.gnorm_drift_factor * base
+                    lo = base / cfg.gnorm_drift_factor
+                    if gnorm > hi or (gnorm > 0 and gnorm < lo):
+                        fire("gnorm_drift", "warning", gnorm, base,
+                             hi if gnorm > hi else lo,
+                             f"window grad-norm mean {gnorm:.4g} drifted "
+                             f"{cfg.gnorm_drift_factor:g}x from baseline "
+                             f"{base:.4g}")
+
+        rate = float(window.get("overflow_rate", 0.0) or 0.0)
+        if int(window.get("steps", 0) or 0) > 0 \
+                and rate >= cfg.overflow_burst_rate:
+            fire("overflow_burst", "critical", rate, None,
+                 cfg.overflow_burst_rate,
+                 f"{window.get('overflows', 0)} overflow-skipped of "
+                 f"{window.get('steps', 0)} steps "
+                 f"({rate:.0%} >= {cfg.overflow_burst_rate:.0%}) — the loss "
+                 "scale is thrashing or the model diverged")
+
+        stall = window.get("stall_ms_per_step")
+        if stall is not None:
+            base = self.baseline("stall")
+            if warm and base is not None and \
+                    stall > cfg.stall_regression_factor * base + 1e-3:
+                fire("dispatch_stall", "warning", stall, base,
+                     cfg.stall_regression_factor * base,
+                     f"host blocked {stall:.2f} ms/step on in-flight steps "
+                     f"vs baseline {base:.2f} — the async pipeline lost its "
+                     "overlap")
+            self._update("stall", float(stall))
+
+        if applied > 0:
+            self._update("loss", loss)
+            self._update("gnorm", gnorm)
+        self._windows += 1
+        return events

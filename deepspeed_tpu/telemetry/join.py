@@ -1,0 +1,76 @@
+"""Static x runtime join: compiled-program costs priced by the observed rate.
+
+graft-lint (``deepspeed_tpu/analysis``) already reads the compiled step
+program's collective census statically; XLA's ``cost_analysis`` knows the
+program's post-fusion FLOPs. Neither says anything about TIME — and the
+runtime telemetry knows the observed step rate but nothing about what a step
+*is*. Multiplying the two yields first-class monitor events no single layer
+could produce:
+
+  * ``modeled_comm_bytes_per_sec`` — census bytes/step x steps/sec: the wire
+    load this config puts on ICI/DCN at the observed rate (the reference can
+    only estimate this by watching NCCL with the comms logger)
+  * ``window_mfu`` — compiled flops/step x steps/sec / chip peak: achieved
+    MFU per steps_per_print window, continuously, not just when the flops
+    profiler runs its one-shot report
+
+The static half is computed ONCE (lazily, at the first window boundary) from
+the same jitted callable the engine dispatches, lowered on the abstract args
+captured at dispatch time — off the steady-state path, no execution, no
+extra fetch.
+"""
+
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def static_step_cost(jitted, abstract_args, *, mesh=None,
+                     divisor: int = 1) -> Optional[Dict[str, Any]]:
+    """Lower+compile ``jitted`` on ``abstract_args`` and read XLA's cost
+    analysis plus the collective census. ``divisor`` normalizes a fused
+    K-step program back to per-step costs. Returns None when the backend
+    can't answer (no cost model, lowering failure)."""
+    import contextlib
+    try:
+        ctx = mesh if mesh is not None else contextlib.nullcontext()
+        with ctx:
+            compiled = jitted.lower(*abstract_args).compile()
+        flops = 0
+        bytes_accessed = 0
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            if ca:
+                flops = int(ca.get("flops", 0))
+                bytes_accessed = int(ca.get("bytes accessed", 0))
+        except Exception:  # noqa: BLE001 - cost model is backend-dependent
+            pass
+        from deepspeed_tpu.analysis.hlo_parse import (collective_census,
+                                                      parse_collectives)
+        census = collective_census(parse_collectives(compiled.as_text()))
+        comm_bytes = sum(c["bytes"] for c in census.values())
+        k = max(1, int(divisor))
+        return {
+            "flops_per_step": flops // k,
+            "bytes_accessed_per_step": bytes_accessed // k,
+            "comm_bytes_per_step": comm_bytes // k,
+            "census": {kind: dict(c) for kind, c in census.items()},
+            "fuse_steps": k,
+        }
+    except Exception as e:  # noqa: BLE001 - telemetry must never kill a run
+        logger.debug(f"telemetry: static step cost unavailable: {e!r}")
+        return None
+
+
+def joined_rates(static: Dict[str, Any], steps_per_sec: float,
+                 peak_flops: float) -> Dict[str, float]:
+    """Price the static per-step costs at the observed rate."""
+    out = {
+        "modeled_comm_bytes_per_sec":
+            static["comm_bytes_per_step"] * steps_per_sec,
+    }
+    if static.get("flops_per_step") and peak_flops > 0:
+        out["window_mfu"] = (static["flops_per_step"] * steps_per_sec
+                             / peak_flops)
+    return out

@@ -180,6 +180,7 @@ _CORPUS_RULES = {
     "replicated-budget": "replication-over-budget",
     "census-drift": "collective-census-drift",
     "fused-hoist": "collective-census-drift",
+    "telemetry-leak": "donation-missing",
 }
 
 
