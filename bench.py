@@ -326,6 +326,13 @@ def _telemetry_bench(size: str, S: int, B: int, base_step_s: float,
     if win.get("modeled_comm_bytes_per_sec") is not None:
         out["telemetry_comm_bytes_per_sec"] = round(
             win["modeled_comm_bytes_per_sec"], 1)
+    # overlap-audit join (scheduled-HLO census priced at the observed rate):
+    # exposed_comm_ms = modeled serial wire time the scheduler is NOT
+    # hiding; overlap_efficiency = overlapped bytes / total collective bytes
+    if win.get("exposed_comm_ms") is not None:
+        out["exposed_comm_ms"] = round(win["exposed_comm_ms"], 3)
+    if win.get("overlap_efficiency") is not None:
+        out["overlap_efficiency"] = round(win["overlap_efficiency"], 4)
     del engine
     gc.collect()
     if not ok:

@@ -171,6 +171,24 @@ class Accelerator:
             return 1e11
         return 197e12
 
+    def interconnect_bytes_per_sec(self) -> float:
+        """Best-effort aggregate per-chip ICI bandwidth (bytes/sec), used to
+        PRICE exposed collective bytes into modeled wire time
+        (telemetry ``exposed_comm_ms``). Rough published per-chip aggregates
+        — a modeling constant for trend tracking, not a measured number."""
+        kind = self.device_kind().lower()
+        table = {
+            # chip kind substring -> aggregate ICI bytes/sec
+            "v5 lite": 2.0e11, "v5e": 2.0e11, "v5litepod": 2.0e11,
+            "v5p": 6.0e11, "v4": 3.0e11, "v3": 2.0e11, "v6": 4.5e11,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        if self._platform == "cpu":
+            return 1e10
+        return 2.0e11
+
     def pin_memory(self, array):
         """Host staging; JAX host buffers are already DMA-capable — identity."""
         return array

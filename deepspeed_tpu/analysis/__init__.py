@@ -9,11 +9,11 @@ buffer donations, dtypes, and replication of every config are *assertable*:
     python -m deepspeed_tpu.analysis.lint --config ds_config.json   # CLI
 
 Modules:
-    hlo_parse     — collective/alias/convert/replication parsers
+    hlo_parse     — collective/alias/convert/replication/overlap parsers
     program       — abstract lowering to ProgramArtifacts + SPMD fd capture
     expectations  — per-config collective kind policy
-    analyzers     — CollectiveAudit, DonationLint, DtypePromotionLint,
-                    ReplicationBudget
+    analyzers     — CollectiveAudit, OverlapAudit, DonationLint,
+                    DtypePromotionLint, ReplicationBudget
     report        — Finding/Report, suppression, baselines
     corpus        — seeded known-bad programs the lint must flag
     lint          — runner + CLI (the CI gate)
@@ -22,13 +22,17 @@ Modules:
 from deepspeed_tpu.analysis.analyzers import (AnalysisSettings,
                                               CollectiveAudit, DonationLint,
                                               DtypePromotionLint,
+                                              OverlapAudit,
                                               ReplicationBudget,
                                               default_analyzers)
 from deepspeed_tpu.analysis.expectations import (CollectivePolicy,
                                                  expected_collectives)
-from deepspeed_tpu.analysis.hlo_parse import (CollectiveOp, collective_census,
+from deepspeed_tpu.analysis.hlo_parse import (CollectiveOp, OverlapOp,
+                                              collective_census,
+                                              overlap_summary,
                                               parse_collectives,
                                               parse_donated_params,
+                                              parse_overlap,
                                               parse_upcasts,
                                               replicated_tensor_bytes,
                                               shape_bytes)
@@ -44,12 +48,14 @@ from deepspeed_tpu.analysis.report import (Finding, Report, compare_census,
 
 __all__ = [
     "AnalysisSettings", "CollectiveAudit", "CollectiveOp", "CollectivePolicy",
-    "DonationLint", "DtypePromotionLint", "Finding", "ProgramArtifacts",
+    "DonationLint", "DtypePromotionLint", "Finding", "OverlapAudit",
+    "OverlapOp", "ProgramArtifacts",
     "Report", "ReplicationBudget", "abstractify", "analyze_programs",
     "assert_no_spmd_replication", "audit_engine", "capture_spmd_warnings",
     "collective_census", "compare_census", "default_analyzers",
     "expected_collectives", "jaxpr_primitive_census", "load_baseline",
-    "lower_engine_programs", "lower_program", "parse_collectives",
-    "parse_donated_params", "parse_upcasts", "replicated_tensor_bytes",
+    "lower_engine_programs", "lower_program", "overlap_summary",
+    "parse_collectives", "parse_donated_params", "parse_overlap",
+    "parse_upcasts", "replicated_tensor_bytes",
     "run_lint", "save_baseline", "shape_bytes",
 ]

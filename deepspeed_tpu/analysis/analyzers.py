@@ -1,4 +1,4 @@
-"""The four graft-lint analyzers.
+"""The five graft-lint analyzers.
 
 Each analyzer is ``analyze(artifacts, settings) -> [Finding]`` over one
 lowered program (analysis/program.py). They are pure text/structure passes —
@@ -11,12 +11,16 @@ lowering in CI and a 256-chip lowering on a real pod.
                         (config analysis.expect_collectives or a baseline).
                         Guards the reference's canonical silent failure: an
                         extra allreduce nobody notices until the bill.
-2. DonationLint       — every state buffer the step was given to donate must
+2. OverlapAudit       — classifies each collective of the *scheduled* HLO
+                        as overlapped (async start/done pair separated by
+                        compute) or exposed; gates on
+                        analysis.max_exposed_collectives when set.
+3. DonationLint       — every state buffer the step was given to donate must
                         alias an output; a missed donation is double memory
                         for that buffer at peak.
-3. DtypePromotionLint — bf16/f16 configs must not widen activation-sized
+4. DtypePromotionLint — bf16/f16 configs must not widen activation-sized
                         tensors to f32 beyond the configured floor.
-4. ReplicationBudget  — explicitly-replicated float tensors above the floor
+5. ReplicationBudget  — explicitly-replicated float tensors above the floor
                         must fit the per-config byte budget (promotes the
                         old utils/hlo_check.replicated_tensor_bytes scan).
 """
@@ -45,6 +49,12 @@ class AnalysisSettings:
     # replication: smallest replicated tensor scanned / total budget allowed
     min_replicated_bytes: int = 1 << 20
     max_replicated_bytes: int = 0
+    # overlap audit: max exposed (synchronous or back-to-back-scheduled)
+    # collectives tolerated before "collective-exposed" fires. None =
+    # report-only (the overlap census still lands in the report) — CPU
+    # lowerings never emit async pairs, so the gate is opt-in.
+    max_exposed_collectives: Optional[int] = None
+    min_exposed_bytes: int = 1024
     # rule ids / finding-key prefixes to suppress
     suppress: List[str] = dataclasses.field(default_factory=list)
     baseline: Optional[str] = None
@@ -60,6 +70,8 @@ class AnalysisSettings:
                    min_upcast_bytes=a.min_upcast_bytes,
                    min_replicated_bytes=a.min_replicated_bytes,
                    max_replicated_bytes=a.max_replicated_bytes,
+                   max_exposed_collectives=a.max_exposed_collectives,
+                   min_exposed_bytes=a.min_exposed_bytes,
                    suppress=list(a.suppress),
                    baseline=a.baseline)
 
@@ -125,6 +137,52 @@ class CollectiveAudit:
                 full, expected, art.name,
                 source="config analysis.expect_collectives"
                        + (f" (x{k} fused steps)" if k > 1 else "")))
+        return findings
+
+
+class OverlapAudit:
+    """Overlap classification of the *scheduled* step HLO: every collective
+    is either overlapped (async start/done pair separated by scheduled
+    compute — the wire runs under the math) or exposed (synchronous, or a
+    pair scheduled back-to-back). The latency-hiding scheduler is the whole
+    reason ZeRO-3's per-use all-gathers are affordable; this pins that it
+    actually fired. Findings only when ``analysis.max_exposed_collectives``
+    is set (CPU lowerings never async-lower, so the default is
+    report-only — the overlap census still reaches the report/JSON)."""
+
+    rule_exposed = "collective-exposed"
+
+    def analyze(self, art, settings: AnalysisSettings,
+                overlap_ops=None) -> List[Finding]:
+        if settings.max_exposed_collectives is None:
+            return []
+        if overlap_ops is None:
+            overlap_ops = hlo_parse.parse_overlap(art.optimized_hlo)
+        exposed = [op for op in overlap_ops
+                   if not op.overlapped
+                   and op.nbytes >= settings.min_exposed_bytes]
+        if len(exposed) <= settings.max_exposed_collectives:
+            return []
+        by_kind: Dict[str, List] = {}
+        for op in exposed:
+            by_kind.setdefault(op.kind, []).append(op)
+        findings = []
+        for kind, ops in sorted(by_kind.items()):
+            nbytes = sum(op.nbytes for op in ops)
+            sync = sum(1 for op in ops if not op.is_async)
+            findings.append(Finding(
+                rule=self.rule_exposed, program=art.name, ident=kind,
+                nbytes=nbytes,
+                message=(f"{len(ops)} exposed {kind} op(s) moving {nbytes} "
+                         f"bytes ({sync} synchronous, "
+                         f"{len(ops) - sync} async-but-back-to-back) — "
+                         f"the config allows at most "
+                         f"{settings.max_exposed_collectives} exposed "
+                         "collective(s); the scheduler is not hiding this "
+                         "latency behind compute"),
+                data={"count": len(ops), "sync": sync,
+                      "budget": settings.max_exposed_collectives,
+                      "lines": [op.line[:160] for op in ops[:4]]}))
         return findings
 
 
@@ -240,5 +298,5 @@ class ReplicationBudget:
 
 
 def default_analyzers(policy: CollectivePolicy):
-    return [CollectiveAudit(policy), DonationLint(), DtypePromotionLint(),
-            ReplicationBudget()]
+    return [CollectiveAudit(policy), OverlapAudit(), DonationLint(),
+            DtypePromotionLint(), ReplicationBudget()]

@@ -181,15 +181,10 @@ def fused_loop_hoist(devices=None):
             w = w - 1e-3 * g
         return lax.pmean(w, "data")   # 1 all-reduce where K belong
 
-    try:  # jax>=0.5 spelling, else the experimental module
-        fn = jax.shard_map(per_device, mesh=mesh,
-                           in_specs=(P(), P(None, "data")), out_specs=P(),
-                           axis_names={"data"}, check_vma=False)
-    except (AttributeError, TypeError):
-        from jax.experimental.shard_map import shard_map as _sm
-        fn = _sm(per_device, mesh=mesh,
-                 in_specs=(P(), P(None, "data")), out_specs=P(),
-                 check_rep=False)
+    from deepspeed_tpu.comm.schedule import shard_map_compat
+    fn = shard_map_compat(per_device, mesh,
+                          in_specs=(P(), P(None, "data")), out_specs=P(),
+                          manual_axes=("data",))
     art = lower_program(jax.jit(fn), w_abs, xs_abs, name="fused_step",
                         mesh=mesh, donatable=None, donation_expected=False,
                         meta={"skip_required": True, "fuse_steps": K})
@@ -241,6 +236,59 @@ def telemetry_leak(devices=None):
         settings=AnalysisSettings(expect_collectives={"all-reduce": 1}))
 
 
+def deferred_sync_regression(devices=None):
+    """Deferred-sync regression: a stage-2-style gas=4 microbatch loop whose
+    accumulator spec forces a reduce-scatter EVERY microbatch — the per-
+    microbatch sync `comm.deferred_grad_sync` exists to remove. The census
+    pin expects the deferred shape (ONE boundary reduce-scatter per step),
+    so the audit must flag the gas x collective inflation; and because the
+    per-microbatch reductions are synchronous, the overlap audit (gated at
+    max_exposed_collectives=0) must report them as exposed."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    GAS = 4
+    mesh = _mesh2(devices)
+    repl = NamedSharding(mesh, P())
+    w_abs = jax.ShapeDtypeStruct((256, 128), jnp.float32, sharding=repl)
+    xs_abs = jax.ShapeDtypeStruct((GAS, 8, 128), jnp.float32,
+                                  sharding=NamedSharding(mesh,
+                                                         P(None, "data")))
+
+    def per_device(w, xs):
+        # the defect: the dp-sharded accumulator spec makes every unrolled
+        # microbatch reduce-scatter its grads; the deferred path accumulates
+        # locally and scatters ONCE at the boundary
+        acc = jnp.zeros((w.shape[0] // 2, w.shape[1]), jnp.float32)
+        for i in range(GAS):
+            g = jax.grad(lambda w_: jnp.sum((xs[i] @ w_.T) ** 2))(w)
+            acc = acc + lax.psum_scatter(g, "data", scatter_dimension=0,
+                                         tiled=True) / GAS
+        return acc
+
+    from deepspeed_tpu.comm.schedule import shard_map_compat
+    fn = shard_map_compat(per_device, mesh,
+                          in_specs=(P(), P(None, "data")),
+                          out_specs=P("data"), manual_axes=("data",))
+    art = lower_program(jax.jit(fn), w_abs, xs_abs, name="deferred_step",
+                        mesh=mesh, donatable=None, donation_expected=False,
+                        meta={"skip_required": True})
+    from deepspeed_tpu.config import Config
+    cfg = Config.load({"train_batch_size": 4,
+                       "optimizer": {"type": "adamw",
+                                     "params": {"lr": 1e-3}},
+                       "bf16": {"enabled": False},
+                       "zero_optimization": {"stage": 2}})
+    # the deferred shape is ONE boundary reduce-scatter per step; the audit
+    # sees GAS of them (+ the overlap gate sees them all exposed)
+    return analyze_programs(
+        [art], cfg, _FakePlan(),
+        settings=AnalysisSettings(
+            expect_collectives={"reduce-scatter": 1},
+            max_exposed_collectives=0, min_exposed_bytes=1))
+
+
 class NoisyLossModel:
     """A model wrapper whose loss adds a term that forces one extra dense
     cross-replica reduction — the classic silently-added allreduce, planted
@@ -270,6 +318,7 @@ CORPUS = {
     "census-drift": census_drift,
     "fused-hoist": fused_loop_hoist,
     "telemetry-leak": telemetry_leak,
+    "deferred-sync-regression": deferred_sync_regression,
 }
 
 

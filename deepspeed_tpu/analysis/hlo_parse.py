@@ -65,17 +65,28 @@ class CollectiveOp:
     is_async: bool = False
 
 
+def _collective_nbytes(result_text: str, is_async: bool) -> int:
+    """Result bytes of one collective definition. ``-start`` ops return a
+    tuple wrapping the in-flight operand alongside the result (plus u32
+    contexts for permutes), so for those the op size is the LARGEST tuple
+    element, not the sum — summing would double-count every async
+    collective. Plain variadic ops (an all-reduce over N grad buffers) do
+    sum their elements. The ONE place this rule lives: parse_collectives
+    and parse_overlap both price ops through it, so the collective census
+    and the overlap census can never disagree on sizes."""
+    sizes = [shape_bytes(dt, dims)
+             for dt, dims in _SHAPE_RE.findall(result_text)]
+    if not sizes:
+        return 0
+    return max(sizes) if is_async and len(sizes) > 1 else sum(sizes)
+
+
 def parse_collectives(optimized_hlo: str) -> List[CollectiveOp]:
     """Every collective op in a compiled module, with result byte sizes.
 
     Async pairs count once (the ``-start`` carries the shape; the ``-done``
-    is skipped). ``-start`` ops return a tuple wrapping the in-flight
-    operand alongside the result (plus u32 contexts for permutes), so for
-    those the op size is the LARGEST tuple element, not the sum — summing
-    would double-count every async collective. Plain variadic ops (an
-    all-reduce over N grad buffers) do sum their elements. Ops inside
-    fusions/while bodies appear in the text and are counted — an op in a
-    scanned loop body is ONE static site.
+    is skipped). Ops inside fusions/while bodies appear in the text and are
+    counted — an op in a scanned loop body is ONE static site.
     """
     out = []
     for line in optimized_hlo.splitlines():
@@ -85,12 +96,8 @@ def parse_collectives(optimized_hlo: str) -> List[CollectiveOp]:
         head = line[:m.start()]
         if "=" not in head:
             continue  # operand continuation line, not a definition
-        result_text = head.split("=", 1)[1]
         is_async = m.group(2) == "-start"
-        sizes = [shape_bytes(dt, dims)
-                 for dt, dims in _SHAPE_RE.findall(result_text)]
-        nbytes = (max(sizes) if is_async and len(sizes) > 1
-                  else sum(sizes)) if sizes else 0
+        nbytes = _collective_nbytes(head.split("=", 1)[1], is_async)
         out.append(CollectiveOp(kind=m.group(1), nbytes=nbytes,
                                 line=line.strip()[:240], is_async=is_async))
     return out
@@ -107,6 +114,117 @@ def collective_census(ops: List[CollectiveOp],
         c["count"] += 1
         c["bytes"] += op.nbytes
     return census
+
+
+# --------------------------------------------------------------------------
+# Overlap classification (scheduled HLO)
+# --------------------------------------------------------------------------
+
+@dataclass
+class OverlapOp:
+    """One collective, classified against the scheduled instruction order."""
+    kind: str
+    nbytes: int
+    line: str
+    computation: str = ""
+    is_async: bool = False     # lowered as a start/done pair at all
+    overlapped: bool = False   # async AND compute scheduled between the pair
+    gap_ops: int = 0           # heavyweight ops between start and done
+
+
+# ops that represent real device work between a start/done pair; everything
+# else (gtes, bitcasts, copies, parameters) is bookkeeping that the
+# latency-hiding scheduler can place anywhere for free. The result type may
+# be a parenthesized TUPLE (multi-output kOutput fusions, every while loop)
+# — the first alternative covers those.
+_COMPUTE_OP_RE = re.compile(
+    r"=\s*(?:\([^()=]*\)|[\w\[\],{}\s]*)\s(fusion|dot|convolution|while|"
+    r"conditional|custom-call|reduce|reduce-window|sort|scatter|gather|"
+    r"select-and-scatter|cholesky|triangular-solve|rng|pad|transpose|"
+    r"concatenate)\(")
+
+# the '%' sigil is optional: some XLA dump styles print instruction names
+# without it — the done-matcher below uses boundary-anchored search so a
+# sigil-less name cannot substring-match a longer one
+_RESULT_VAR_RE = re.compile(r"^\s*(?:ROOT\s+)?(%?[\w.\-]+)\s*=")
+
+
+def parse_overlap(optimized_hlo: str) -> List[OverlapOp]:
+    """Classify every collective in a (scheduled) compiled module as
+    overlapped or exposed.
+
+    XLA's latency-hiding scheduler emits asynchronous collectives as
+    ``-start``/``-done`` pairs; the module text after scheduling lists
+    instructions in schedule order, so a pair with real compute between the
+    two halves is *overlapped* (the wire runs under that compute) and a
+    pair scheduled back-to-back is *exposed* latency. Synchronous
+    collectives (no ``-start``) block by construction and are always
+    exposed — which is also what every collective looks like on backends
+    that never async-lower (CPU test meshes): the overlap gate is therefore
+    opt-in (``analysis.max_exposed_collectives``).
+    """
+    out: List[OverlapOp] = []
+    computation = ""
+    # per-computation: open start var -> (index into out, compute count)
+    open_async: Dict[str, Tuple[int, int]] = {}
+    compute_seen = 0
+    for line in optimized_hlo.splitlines():
+        if line and not line.startswith(" "):
+            m = _COMPUTATION_HEADER_RE.match(line)
+            if m:
+                computation = m.group(2)
+                open_async = {}
+                compute_seen = 0
+            continue
+        cm = _COLLECTIVE_RE.search(line)
+        if cm is None:
+            if _COMPUTE_OP_RE.search(line):
+                compute_seen += 1
+            continue
+        head = line[:cm.start()]
+        if "=" not in head:
+            continue  # operand continuation, not a definition
+        kind, suffix = cm.group(1), cm.group(2)
+        if suffix == "-done":
+            # match the start by the operand var it consumes
+            # (boundary-anchored: a name must not substring-match a longer
+            # one, with or without the '%' sigil)
+            done = None
+            for var, (idx, started_at) in list(open_async.items()):
+                if re.search(r"(?<![\w.\-])" + re.escape(var)
+                             + r"(?![\w.\-])", line):
+                    done = var
+                    break
+            if done is not None:
+                idx, started_at = open_async.pop(done)
+                gap = compute_seen - started_at
+                out[idx].gap_ops = gap
+                out[idx].overlapped = gap > 0
+            continue
+        is_async = suffix == "-start"
+        nbytes = _collective_nbytes(head.split("=", 1)[1], is_async)
+        op = OverlapOp(kind=kind, nbytes=nbytes, line=line.strip()[:240],
+                       computation=computation, is_async=is_async)
+        out.append(op)
+        if is_async:
+            vm = _RESULT_VAR_RE.match(line)
+            if vm:
+                open_async[vm.group(1)] = (len(out) - 1, compute_seen)
+    return out
+
+
+def overlap_summary(ops: List[OverlapOp],
+                    min_bytes: int = 0) -> Dict[str, Dict[str, int]]:
+    """Aggregate {overlapped|exposed: {count, bytes}} over ops >= min_bytes."""
+    summary = {"overlapped": {"count": 0, "bytes": 0},
+               "exposed": {"count": 0, "bytes": 0}}
+    for op in ops:
+        if op.nbytes < min_bytes:
+            continue
+        bucket = summary["overlapped" if op.overlapped else "exposed"]
+        bucket["count"] += 1
+        bucket["bytes"] += op.nbytes
+    return summary
 
 
 # --------------------------------------------------------------------------

@@ -22,11 +22,12 @@ import sys
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.analysis.analyzers import (AnalysisSettings,
-                                              CollectiveAudit,
+                                              CollectiveAudit, OverlapAudit,
                                               default_analyzers)
 from deepspeed_tpu.analysis.expectations import expected_collectives
 from deepspeed_tpu.analysis.hlo_parse import (collective_census,
-                                              parse_collectives)
+                                              overlap_summary,
+                                              parse_overlap)
 from deepspeed_tpu.analysis.program import (ProgramArtifacts, abstractify,
                                             lower_program)
 from deepspeed_tpu.analysis.report import (Report, compare_census,
@@ -58,13 +59,25 @@ def analyze_programs(artifacts: List[ProgramArtifacts], config, plan,
     for art in artifacts:
         policy = expected_collectives(
             config, plan, onebit_phase=art.meta.get("onebit_phase"))
-        ops = parse_collectives(art.optimized_hlo)  # parsed ONCE per program
+        # parsed ONCE per program: OverlapOp carries kind/nbytes/is_async (a
+        # superset of CollectiveOp), so the same pass feeds the collective
+        # census, the kind policy, and the overlap classification
+        overlap_ops = parse_overlap(art.optimized_hlo)
+        ops = overlap_ops
         for analyzer in default_analyzers(policy):
             if isinstance(analyzer, CollectiveAudit):
                 report.extend(analyzer.analyze(art, settings, ops=ops))
+            elif isinstance(analyzer, OverlapAudit):
+                report.extend(analyzer.analyze(art, settings,
+                                               overlap_ops=overlap_ops))
             else:
                 report.extend(analyzer.analyze(art, settings))
         report.census[art.name] = collective_census(ops)
+        # UNFILTERED overlap census: min_exposed_bytes only exempts
+        # control-plane ops from the OverlapAudit gate — the recorded
+        # census must match the telemetry join's (min_bytes=0) so
+        # dryrun_multichip and bench.py report comparable numbers
+        report.overlap[art.name] = overlap_summary(overlap_ops)
         if baseline and art.name in baseline.get("census", {}):
             report.extend(compare_census(
                 report.census[art.name], baseline["census"][art.name],

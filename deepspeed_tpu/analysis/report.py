@@ -44,6 +44,10 @@ class Report:
     # {program_name: {kind: {"count": n, "bytes": b}}}
     census: Dict[str, Dict[str, Dict[str, int]]] = \
         dataclasses.field(default_factory=dict)
+    # {program_name: {"overlapped"|"exposed": {"count": n, "bytes": b}}}
+    # — scheduled-HLO overlap classification (analyzers.OverlapAudit)
+    overlap: Dict[str, Dict[str, Dict[str, int]]] = \
+        dataclasses.field(default_factory=dict)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -96,6 +100,7 @@ class Report:
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [f.to_dict() for f in self.suppressed],
             "census": self.census,
+            "overlap": self.overlap,
             "meta": self.meta,
         }
 
@@ -113,6 +118,14 @@ class Report:
             else:
                 parts = "none"
             lines.append(f"[{prog}] collectives: {parts}")
+            ov = self.overlap.get(prog)
+            if ov and (ov["overlapped"]["count"] or ov["exposed"]["count"]):
+                lines.append(
+                    f"[{prog}] overlap: "
+                    f"{ov['overlapped']['count']} overlapped "
+                    f"({_fmt_bytes(ov['overlapped']['bytes'])}), "
+                    f"{ov['exposed']['count']} exposed "
+                    f"({_fmt_bytes(ov['exposed']['bytes'])})")
         for f in self.findings:
             lines.append(f"{f.severity.upper()} {f.key}: {f.message}")
         if self.suppressed:

@@ -5,3 +5,4 @@ from deepspeed_tpu.comm.comm import (
     psum, pmean, pmax,
     log_summary, comms_logger,
 )
+from deepspeed_tpu.comm import schedule

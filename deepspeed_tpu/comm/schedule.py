@@ -1,0 +1,204 @@
+"""Collective scheduling: deferred gradient sync + hierarchical reduction.
+
+Reference: ``runtime/zero/stage_1_and_2.py`` — DeepSpeed's headline ZeRO
+throughput comes as much from *when* collectives run as from sharding
+itself: ``overlap_comm`` overlaps grad reduction with backward compute,
+``no_sync`` defers it across accumulation boundaries, and the hierarchical
+all-reduce splits a flat ring into intra-node + inter-node phases.
+
+TPU-native design: GSPMD owns collective *placement*, so scheduling policy
+is expressed structurally —
+
+* **deferred sync** (``comm.deferred_grad_sync``): the microbatch grad
+  accumulation runs inside a ``shard_map`` that is *manual* over the
+  ``data`` mesh axis (every other axis stays auto/GSPMD). Per-device grads
+  accumulate locally across the whole scan — no data-axis collective can
+  exist inside the loop because the axis is manual and nothing asks for
+  one — and a single explicit ``psum``/``psum_scatter`` at the step
+  boundary produces exactly the reduction the eager path spreads over every
+  microbatch. Stage-1/2 dp-sync collective counts become independent of
+  ``gradient_accumulation_steps`` (DeepSpeed ``no_sync`` semantics).
+
+* **hierarchical reduction** (``comm.hierarchical_grad_reduce``): on
+  ``data x fsdp`` meshes the dp grad mean decomposes into an fsdp-axis
+  reduce-scatter (inner, fast ICI ring, full payload) followed by a
+  data-axis all-reduce of the *sharded* buffer (outer ring, 1/fsdp of the
+  bytes). Expressed as sharding-constraint hints: the accumulator is pinned
+  to an fsdp-sharded spec before the data-axis reduction, so GSPMD must
+  realize the two phases separately. The analysis census pins the result
+  exactly for the MULTICHIP mesh plans.
+
+Everything here is pure spec/tree surgery plus the in-``shard_map``
+boundary reduction; the engine wires it into the dense GSPMD step, the
+fused K-step program, and (trivially — it is already deferred by
+construction) the 1-bit shard_map step.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+
+
+def shard_map_compat(f, mesh, *, in_specs, out_specs, manual_axes):
+    """Partial-auto shard_map across jax versions: `jax.shard_map` with
+    axis_names (>= 0.6 spelling) or the experimental module with
+    `auto=` (the 0.4.x spelling). Only `manual_axes` become manual; every
+    other mesh axis stays auto — GSPMD keeps partitioning the body over
+    them (param all-gathers, TP reductions, fsdp constraints)."""
+    import jax
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             axis_names=set(manual_axes), check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        auto = frozenset(mesh.axis_names) - frozenset(manual_axes)
+        return _sm(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False, auto=auto)
+
+
+def _entries(spec: P):
+    """PartitionSpec -> list of per-dim axis tuples."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(entry))
+        else:
+            out.append((entry,))
+    return out
+
+
+def _from_entries(entries) -> P:
+    out = [tuple(e) if len(e) > 1 else (e[0] if e else None) for e in entries]
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def spec_axes(spec: P):
+    """All mesh axis names a spec references."""
+    return {a for e in _entries(spec) for a in e}
+
+
+def axis_dim(spec: P, axis: str) -> Optional[int]:
+    """Dim index carrying `axis`, or None."""
+    for i, e in enumerate(_entries(spec)):
+        if axis in e:
+            return i
+    return None
+
+
+def drop_axis(spec: P, axis: str) -> P:
+    """Remove every reference to `axis` from a spec (the LOCAL view of a
+    tensor inside a region that is manual over `axis`)."""
+    return _from_entries([tuple(a for a in e if a != axis)
+                          for e in _entries(spec)])
+
+
+def local_tree(spec_tree, axis: str = DATA_AXIS):
+    """grad_specs -> their local (manual-over-`axis`) counterparts."""
+    return jax.tree.map(lambda s: drop_axis(s, axis), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def hierarchical_spec(grad_spec: P, shape: Tuple[int, ...], plan) -> P:
+    """Intermediate fsdp-sharded spec for one grad leaf: the buffer the
+    data-axis phase of the hierarchical reduction operates on.
+
+    Leaves already fsdp-sharded (stage 3) keep their spec — the
+    decomposition is inherent there. Otherwise shard the largest dim that
+    is unsharded and divisible by the fsdp degree; leaves where nothing
+    divides stay as-is (tiny tensors ride the flat reduction).
+    """
+    if plan.fsdp <= 1 or FSDP_AXIS in spec_axes(grad_spec):
+        return grad_spec
+    sizes = plan.axis_sizes()
+    entries = _entries(grad_spec)
+    while len(entries) < len(shape):
+        entries.append(())
+    best_dim, best_size = -1, 0
+    for i, dim in enumerate(shape):
+        denom = int(np.prod([sizes.get(a, 1) for a in entries[i]])) \
+            if entries[i] else 1
+        local = dim // denom if denom and dim % denom == 0 else 0
+        if local and local % plan.fsdp == 0 and local > best_size:
+            best_dim, best_size = i, local
+    if best_dim < 0:
+        return grad_spec
+    entries[best_dim] = entries[best_dim] + (FSDP_AXIS,)
+    return _from_entries(entries)
+
+
+def hierarchical_tree(grad_specs, shape_tree, plan):
+    return jax.tree.map(
+        lambda s, sh: hierarchical_spec(s, tuple(sh), plan),
+        grad_specs, shape_tree, is_leaf=lambda x: isinstance(x, P))
+
+
+def deferred_supported(plan) -> Tuple[bool, str]:
+    """Whether the deferred-sync shard_map region composes with this mesh.
+
+    The region is manual over `data` only — params are never data-sharded,
+    so they enter replicated and the model body runs unmodified (fsdp/
+    tensor stay auto: GSPMD still inserts the per-use param all-gathers and
+    TP reductions inside). Axes that restructure the step itself can't
+    nest: pipeline's manual region, ring attention's seq collectives, and
+    MoE's expert-data routing.
+    """
+    if plan.pipe > 1:
+        return False, "pipeline parallelism wraps the step in its own " \
+                      "manual mesh region"
+    if plan.seq > 1:
+        return False, "ring attention's seq-axis collectives cannot nest " \
+                      "inside a manual-data region"
+    if plan.expert > 1:
+        return False, "expert-data routing folds the data axis at dispatch " \
+                      "time"
+    return True, ""
+
+
+def boundary_reduce(grads, grad_specs, plan, *, mean: bool = True):
+    """The ONE data-axis reduction of the deferred path, applied to the
+    locally-accumulated grad tree inside the manual-over-`data` region.
+
+    Per leaf: grad specs carrying `data` on a dim get a ``psum_scatter``
+    (reduce-scatter) on that dim — the output lands exactly where ZeRO
+    stage >= 2 wants it; replicated-over-data leaves get a ``psum``
+    (all-reduce). ``mean=True`` folds the 1/data normalization in after the
+    sum (an exponent-only scale for power-of-two meshes), matching the
+    eager path's global-mean gradient bit-for-bit when the sums themselves
+    are exact.
+    """
+    inv = np.float32(1.0 / plan.data)
+
+    def one(g, spec):
+        dim = axis_dim(spec, DATA_AXIS)
+        if dim is None:
+            g = lax.psum(g, DATA_AXIS)
+        else:
+            g = lax.psum_scatter(g, DATA_AXIS, scatter_dimension=dim,
+                                 tiled=True)
+        return g * inv if mean else g
+
+    return jax.tree.map(one, grads, grad_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def manual_out_spec(grad_specs):
+    """shard_map out_specs for the reduced grad tree: only the manual
+    (`data`) placement is named; auto-axis sharding (fsdp/tensor) rides
+    through from the constraints inside the body."""
+    def one(spec):
+        dim = axis_dim(spec, DATA_AXIS)
+        if dim is None:
+            return P()
+        return P(*([None] * dim + [DATA_AXIS]))
+    return jax.tree.map(one, grad_specs, is_leaf=lambda x: isinstance(x, P))

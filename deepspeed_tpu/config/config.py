@@ -218,6 +218,35 @@ class FlopsProfilerConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class CommConfig(ConfigModel):
+    """Collective *scheduling* policy (TPU-native). The reference controls
+    when collectives run imperatively (``overlap_comm`` /
+    ``contiguous_gradients`` in ``runtime/zero/stage_1_and_2.py``); here
+    GSPMD places them, and this section controls the structure the engine
+    hands the compiler (deepspeed_tpu/comm/schedule.py)."""
+    # accumulate microbatch grads in a per-device LOCAL (unreduced) buffer
+    # inside the scan and issue ONE data-axis reduction at the step boundary
+    # (DeepSpeed no_sync semantics): dp-sync collective counts become
+    # independent of gradient_accumulation_steps. Costs a full-size (not
+    # 1/dp) grad accumulator per device under stage 2.
+    deferred_grad_sync: bool = False
+    # on data x fsdp meshes, decompose the dp grad mean into an fsdp-axis
+    # reduce-scatter followed by a data-axis all-reduce of the SHARDED
+    # buffer: the big payload stays on the inner (fast) axis, the outer
+    # axis moves 1/fsdp of the bytes
+    hierarchical_grad_reduce: bool = False
+    # 0 = lax.scan microbatch loop (one static collective site, compile time
+    # independent of gas); K >= gas = fully unrolled microbatches (the
+    # latency-hiding scheduler can overlap microbatch i's reduction with
+    # microbatch i+1's compute; compile time and census scale with gas)
+    microbatch_unroll: int = 0
+
+    def validate(self):
+        if self.microbatch_unroll < 0:
+            raise ConfigError("comm.microbatch_unroll must be >= 0")
+
+
+@dataclasses.dataclass
 class CommsLoggerConfig(ConfigModel):
     """Reference: ``deepspeed/comm/config.py`` + ``utils/comms_logging.py:58``."""
     enabled: bool = False
@@ -339,6 +368,15 @@ class AnalysisConfig(ConfigModel):
     min_upcast_bytes: int = 1 << 20
     min_replicated_bytes: int = 1 << 20
     max_replicated_bytes: int = 0
+    # overlap audit (scheduled-HLO): max synchronous/exposed collectives the
+    # compiled step may contain before the "collective-exposed" finding
+    # fires. None (default) = report-only — the overlap census still lands
+    # in the report/JSON, but CPU lowerings (which never emit async
+    # collective pairs) don't fail the gate.
+    max_exposed_collectives: Optional[int] = None
+    # exposed collectives smaller than this are control-plane sync and
+    # exempt from the overlap gate
+    min_exposed_bytes: int = 1024
     # finding keys / rule ids to suppress (accepted exceptions)
     suppress: List[str] = config_field([])
     # path to a baseline JSON (analysis.report.save_baseline): known
@@ -444,6 +482,7 @@ class Config(ConfigModel):
     json_monitor: MonitorSinkConfig = config_field(MonitorSinkConfig)
     telemetry: TelemetryConfig = config_field(TelemetryConfig)
     flops_profiler: FlopsProfilerConfig = config_field(FlopsProfilerConfig)
+    comm: CommConfig = config_field(CommConfig)
     comms_logger: CommsLoggerConfig = config_field(CommsLoggerConfig)
     aio: AIOConfig = config_field(AIOConfig)
     checkpoint: CheckpointConfig = config_field(CheckpointConfig)

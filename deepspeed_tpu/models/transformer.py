@@ -393,9 +393,21 @@ def _constrain_batch_axes(x):
             return x
     except Exception:
         pass
+    # partial-manual shard_map (the deferred-grad-sync region is manual over
+    # `data`, everything else auto): constraining a MANUAL axis is an error,
+    # and the body sees per-shard views on that axis anyway — drop bound
+    # axes from the constraint and keep pinning the auto ones (fsdp/seq).
+    # jax 0.4.x spelling; newer jax is covered by the AxisType check above.
+    bound = set()
+    try:
+        from jax._src import core as _core
+        bound = set(getattr(_core.get_axis_env(), "axis_sizes", {}) or {})
+    except Exception:
+        pass
     from deepspeed_tpu.parallel.mesh import BATCH_AXES
     shape = dict(env_mesh.shape)
-    batch = tuple(a for a in BATCH_AXES if shape.get(a, 1) > 1)
+    batch = tuple(a for a in BATCH_AXES
+                  if shape.get(a, 1) > 1 and a not in bound)
     if not batch:
         return x
     dp = 1
@@ -403,7 +415,7 @@ def _constrain_batch_axes(x):
         dp *= shape[a]
     if x.shape[0] % dp:  # ad-hoc small batches (inference) stay unsharded
         return x
-    seq_ax = "seq" if shape.get("seq", 1) > 1 else None
+    seq_ax = "seq" if shape.get("seq", 1) > 1 and "seq" not in bound else None
     if seq_ax and x.shape[1] % shape["seq"]:
         seq_ax = None
     return jax.lax.with_sharding_constraint(x, P(batch, seq_ax))

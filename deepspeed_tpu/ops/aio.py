@@ -114,9 +114,14 @@ class AIOHandle:
         return arr
 
     def close(self):
-        if self._h:
-            self._lib.dstpu_aio_close(self._h)
-            self._h = None
+        # guard with getattr: when _load()/__init__ failed mid-init the
+        # instance has no _h/_lib, and __del__ still runs on it — close()
+        # must be a no-op there, not an AttributeError (which would surface
+        # as "Exception ignored in: __del__" noise at interpreter shutdown)
+        h = getattr(self, "_h", None)
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.dstpu_aio_close(h)
+        self._h = None
 
     def __del__(self):
         try:

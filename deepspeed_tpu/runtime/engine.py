@@ -199,6 +199,7 @@ class Engine:
         self._rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
         param_shapes = jax.eval_shape(model.init, self._rng)
         shape_tree = jax.tree.map(lambda s: s.shape, param_shapes)
+        self._shape_tree = shape_tree  # comm.schedule needs divisibility info
         self.param_specs = jax.tree.map(
             lambda spec, sh: zero_mod.zero_param_spec(spec, sh, self.plan, zero_cfg),
             base_specs, shape_tree, is_leaf=lambda x: isinstance(x, P))
@@ -906,11 +907,17 @@ class Engine:
 
     @staticmethod
     def _accum_micro_grads(micro_fn, params, batch, gas: int, rng,
-                           postprocess=None):
+                           postprocess=None, unroll: int = 0):
         """Gradient accumulation over `gas` microbatches, shared by the dense
-        GSPMD step and the 1-bit shard_map step. micro_fn(params, mb, rng) ->
-        (loss, grads); postprocess (e.g. a sharding constraint) is applied to
-        the running accumulator. Returns (summed grads / gas, mean loss)."""
+        GSPMD step, the deferred-sync shard_map body, and the 1-bit shard_map
+        step. micro_fn(params, mb, rng) -> (loss, grads); postprocess (e.g. a
+        sharding constraint) is applied to the running accumulator. The 1/gas
+        mean scaling is FOLDED into the accumulator update (one fused
+        multiply-add inside the loop) instead of a separate post-scan sweep
+        over the full grad tree. unroll >= gas fully unrolls the microbatch
+        loop (comm.microbatch_unroll: per-microbatch collectives become
+        distinct schedulable sites). Returns (summed grads / gas, mean
+        loss)."""
         if gas == 1:
             loss, grads = micro_fn(params, batch, rng)
             return grads, loss
@@ -929,18 +936,21 @@ class Engine:
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         if postprocess is not None:
             zeros = postprocess(zeros)
+        inv_gas = np.float32(1.0 / gas)
 
         def body(acc, mb_rng):
             mb, r = mb_rng
             loss, g = micro_fn(params, mb, r)
-            acc = jax.tree.map(jnp.add, acc, g)
+            acc = jax.tree.map(lambda a, gg: a + gg * inv_gas, acc, g)
             if postprocess is not None:
                 acc = postprocess(acc)
             return acc, loss
 
         rngs = jax.random.split(rng, gas)
-        grads, losses = jax.lax.scan(body, zeros, (mbs, rngs))
-        return jax.tree.map(lambda g: g / gas, grads), jnp.mean(losses)
+        grads, losses = jax.lax.scan(
+            body, zeros, (mbs, rngs),
+            unroll=True if unroll >= gas else max(1, int(unroll)))
+        return grads, jnp.mean(losses)
 
     def _compile_steps(self):
         cfg = self.config
@@ -964,7 +974,75 @@ class Engine:
         tel_on = self._tel_in_graph
         tel_ratio = tel_on and cfg.telemetry.update_ratio
 
-        def micro_grads(params, mb, rng, scale, step=None):
+        # --- communication scheduling (comm.schedule: deferred grad sync +
+        # hierarchical 2D-mesh reduction; reference: overlap_comm /
+        # contiguous_gradients / no_sync in runtime/zero/stage_1_and_2.py)
+        from deepspeed_tpu.comm import schedule as comm_sched
+        ccfg = cfg.comm
+        unroll = max(0, int(ccfg.microbatch_unroll))
+        self._microbatch_unroll = unroll  # one derivation; onebit reads it
+        self._deferred_sync = False
+        self._hier_reduce = False
+        if ccfg.hierarchical_grad_reduce:
+            if self.plan.data > 1 and self.plan.fsdp > 1:
+                self._hier_reduce = True
+            else:
+                logger.info("comm.hierarchical_grad_reduce is a no-op: needs "
+                            "a 2D data x fsdp mesh "
+                            f"(have {self.plan.describe()})")
+        if ccfg.deferred_grad_sync:
+            if self._onebit_comm:
+                logger.info(
+                    "comm.deferred_grad_sync: the 1-bit shard_map step is "
+                    "already deferred by construction (grads accumulate "
+                    "per-device local; only the phase collective crosses "
+                    "the wire at the boundary)")
+            elif self._nvme_opt or self._infinity or self._pp_mode:
+                logger.warning(
+                    "comm.deferred_grad_sync ignored: host-driven optimizer "
+                    "paths and pipeline mode keep their own step structure")
+            else:
+                ok, why = comm_sched.deferred_supported(self.plan)
+                if not ok:
+                    logger.warning(f"comm.deferred_grad_sync ignored: {why}")
+                elif self.plan.data <= 1:
+                    logger.info(
+                        "comm.deferred_grad_sync: no `data` axis to defer "
+                        "over (dp rides fsdp; per-use reductions are ZeRO-3 "
+                        "semantics) — eager path unchanged")
+                else:
+                    self._deferred_sync = True
+                    logger.info(
+                        "comm.deferred_grad_sync: microbatch grads "
+                        "accumulate in a per-device local buffer; ONE "
+                        f"data-axis sync per step (gas={gas})"
+                        + (", hierarchical fsdp-phase reduction"
+                           if self._hier_reduce else ""))
+        # accumulator target specs: the hierarchical hint pins the fsdp-
+        # sharded intermediate the data-axis phase operates on
+        acc_specs = self.grad_specs
+        if self._hier_reduce:
+            acc_specs = comm_sched.hierarchical_tree(
+                self.grad_specs, self._shape_tree, self.plan)
+        deferred = self._deferred_sync
+        hier = self._hier_reduce
+        plan = self.plan
+        local_acc_specs = None
+        deferred_unroll = unroll
+        if deferred:
+            local_ = comm_sched.local_tree(acc_specs)
+            if any(len(s) for s in jax.tree.leaves(
+                    local_, is_leaf=lambda x: isinstance(x, P))):
+                local_acc_specs = local_
+            # a lax.scan INSIDE the manual-over-data region trips an XLA
+            # SPMD check (hlo_sharding_util IsManualSubgroup) whenever a
+            # size>1 AUTO axis exists (fsdp/tensor 2D meshes) — unroll the
+            # microbatch loop there; pure-data meshes keep the scan
+            if any(v > 1 for a, v in plan.axis_sizes().items()
+                   if a != "data"):
+                deferred_unroll = max(unroll, gas)
+
+        def micro_grads(params, mb, rng, scale, step=None, specs="grad"):
             def loss_fn(p):
                 if compression is not None:
                     p = compression.apply(p, step if step is not None else 0)
@@ -975,9 +1053,11 @@ class Engine:
                     loss = loss * scale.astype(loss.dtype)
                 return loss
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            grads = jax.lax.with_sharding_constraint(
-                jax.tree.map(lambda g: g.astype(jnp.float32), grads),
-                self.grad_specs)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            if specs == "grad":
+                specs = self.grad_specs
+            if specs is not None:
+                grads = jax.lax.with_sharding_constraint(grads, specs)
             return loss, grads
 
         def apply_grads(state, grads, mean_loss):
@@ -1042,17 +1122,63 @@ class Engine:
                 metrics["loss_scale"] = state["loss_scale"]["scale"]
             return new_state, metrics
 
+        def deferred_batch_grads(params, batch, rng, scale, step):
+            """Deferred sync: grad accumulation runs manual over `data`
+            (everything else stays auto/GSPMD). Each device accumulates the
+            LOCAL (unreduced) grad sum across all `gas` microbatches — no
+            data-axis collective can exist inside the scan — and
+            comm.schedule.boundary_reduce issues the ONE reduction at the
+            step boundary (psum_scatter onto dp-sharded grad specs, psum
+            for replicated leaves). DeepSpeed no_sync semantics: dp-sync
+            collective counts are independent of gas."""
+            def local_body(params, batch, rng, scale, step):
+                grads, mean_loss = self._accum_micro_grads(
+                    lambda p, mb, r: micro_grads(p, mb, r, scale, step=step,
+                                                 specs=local_acc_specs),
+                    params, batch, gas, rng,
+                    postprocess=(None if local_acc_specs is None else
+                                 lambda t: jax.lax.with_sharding_constraint(
+                                     t, local_acc_specs)),
+                    unroll=deferred_unroll)
+                grads = comm_sched.boundary_reduce(grads, self.grad_specs,
+                                                   plan)
+                mean_loss = jax.lax.pmean(mean_loss, "data")
+                return grads, mean_loss
+
+            fn = comm_sched.shard_map_compat(
+                local_body, mesh,
+                in_specs=(jax.tree.map(lambda _: P(), params),
+                          _manual_batch_specs(batch), P(), P(), P()),
+                out_specs=(comm_sched.manual_out_spec(self.grad_specs), P()),
+                manual_axes=("data",))
+            grads, mean_loss = fn(params, batch, rng, scale, step)
+            # pin the final placement: the scattered data dim plus whatever
+            # auto-axis sharding rode out of the region lands on grad_specs
+            grads = jax.lax.with_sharding_constraint(grads, self.grad_specs)
+            return grads, mean_loss
+
         def batch_grads(state, batch, rng):
             """Averaged grads + mean loss over `gas` microbatches.
             batch leaves: [global_batch, ...], sharded over (data, fsdp)."""
             params = state["params"]
             scale = state["loss_scale"]["scale"] if fp16 else jnp.float32(1.0)
-            grads, mean_loss = self._accum_micro_grads(
-                lambda p, mb, r: micro_grads(p, mb, r, scale,
-                                             step=state["step"]),
-                params, batch, gas, rng,
-                postprocess=lambda t: jax.lax.with_sharding_constraint(
-                    t, self.grad_specs))
+            if deferred:
+                grads, mean_loss = deferred_batch_grads(
+                    params, batch, rng, scale, state["step"])
+            else:
+                grads, mean_loss = self._accum_micro_grads(
+                    lambda p, mb, r: micro_grads(p, mb, r, scale,
+                                                 step=state["step"],
+                                                 specs=acc_specs),
+                    params, batch, gas, rng,
+                    postprocess=lambda t: jax.lax.with_sharding_constraint(
+                        t, acc_specs),
+                    unroll=unroll)
+                if hier:
+                    # phase 2 hint: the fsdp-sharded buffer resharded onto
+                    # the final grad placement
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, self.grad_specs)
             if fp16:
                 mean_loss = mean_loss / scale
             return mean_loss, grads
@@ -1178,8 +1304,12 @@ class Engine:
                     return loss * scale.astype(loss.dtype) if fp16 else loss
                 return jax.value_and_grad(loss_fn)(p)
 
+            # already deferred by construction: grads stay per-device local
+            # across the whole accumulation; comm.microbatch_unroll still
+            # applies (schedulable per-microbatch compute sites)
             grads, loss = self._accum_micro_grads(
-                lambda p, mb, r: micro(p, mb, r), params, batch, gas, rng)
+                lambda p, mb, r: micro(p, mb, r), params, batch, gas, rng,
+                unroll=self._microbatch_unroll)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             if fp16:
                 grads = fp16_mod.unscale_grads(
@@ -1269,19 +1399,7 @@ class Engine:
         out_metrics_spec = {"loss": P(), "grad_norm": P(), "overflow": P()}
         if fp16:
             out_metrics_spec["loss_scale"] = P()
-        # per-leaf batch specs: side-channels and scalars replicate,
-        # data rows shard
-        if batch is None:
-            batch_spec = P("data")
-        elif isinstance(batch, dict):
-            batch_spec = {
-                k: (P() if _is_side_channel(k)
-                    or getattr(v, "ndim", 0) < 1 else P("data"))
-                for k, v in batch.items()}
-        else:
-            batch_spec = jax.tree.map(
-                lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(),
-                batch)
+        batch_spec = _manual_batch_specs(batch)
         fn = jax.shard_map(
             per_device, mesh=mesh,
             in_specs=(state_spec, batch_spec, P()),
@@ -1970,9 +2088,13 @@ class Engine:
             static = self._tel_static_cost(wait=wait_static)
             if static is not None:
                 from deepspeed_tpu.accelerator import get_accelerator
-                peak = (get_accelerator().peak_flops_per_device("bf16")
+                accel = get_accelerator()
+                peak = (accel.peak_flops_per_device("bf16")
                         * max(1, jax.device_count()))
-                win.update(joined_rates(static, win["steps_per_sec"], peak))
+                win.update(joined_rates(
+                    static, win["steps_per_sec"], peak,
+                    interconnect_bytes_per_sec=
+                    accel.interconnect_bytes_per_sec()))
         self._tel_last_window = win
         step = self.global_steps
         events = [(f"telemetry/{k}", float(win[k]), step)
@@ -1980,6 +2102,7 @@ class Engine:
                             "gnorm_max", "overflow_rate",
                             "update_ratio_mean", "steps_per_sec",
                             "window_mfu", "modeled_comm_bytes_per_sec",
+                            "exposed_comm_ms", "overlap_efficiency",
                             "stall_ms_per_step")
                   if win.get(k) is not None]
         records = [{"type": "telemetry_window", "step": step, **win}]
@@ -2343,6 +2466,21 @@ def _flatten_dict(tree, prefix=""):
         elif v is not None:
             out[key] = v
     return out
+
+
+def _manual_batch_specs(batch):
+    """Per-leaf shard_map in_specs for a batch tree entering a region that
+    is manual over `data`: side-channels and scalars replicate, data rows
+    shard. The ONE place the rule lives — the deferred-sync region and the
+    1-bit step both consult it."""
+    if batch is None:
+        return P("data")
+    if isinstance(batch, dict):
+        return {k: (P() if _is_side_channel(k)
+                    or getattr(v, "ndim", 0) < 1 else P("data"))
+                for k, v in batch.items()}
+    return jax.tree.map(
+        lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(), batch)
 
 
 def _is_side_channel(key) -> bool:

@@ -47,15 +47,25 @@ def static_step_cost(jitted, abstract_args, *, mesh=None,
         except Exception:  # noqa: BLE001 - cost model is backend-dependent
             pass
         from deepspeed_tpu.analysis.hlo_parse import (collective_census,
-                                                      parse_collectives)
-        census = collective_census(parse_collectives(compiled.as_text()))
+                                                      overlap_summary,
+                                                      parse_overlap)
+        # ONE parse feeds both: the collective census (kind/bytes) and the
+        # scheduled-HLO overlap classification (how much of that wire load
+        # is hidden under compute vs exposed step latency)
+        overlap_ops = parse_overlap(compiled.as_text())
+        census = collective_census(overlap_ops)
         comm_bytes = sum(c["bytes"] for c in census.values())
+        overlap = overlap_summary(overlap_ops)
         k = max(1, int(divisor))
         return {
             "flops_per_step": flops // k,
             "bytes_accessed_per_step": bytes_accessed // k,
             "comm_bytes_per_step": comm_bytes // k,
+            "exposed_comm_bytes_per_step": overlap["exposed"]["bytes"] // k,
+            "overlapped_comm_bytes_per_step":
+                overlap["overlapped"]["bytes"] // k,
             "census": {kind: dict(c) for kind, c in census.items()},
+            "overlap": overlap,
             "fuse_steps": k,
         }
     except Exception as e:  # noqa: BLE001 - telemetry must never kill a run
@@ -64,7 +74,8 @@ def static_step_cost(jitted, abstract_args, *, mesh=None,
 
 
 def joined_rates(static: Dict[str, Any], steps_per_sec: float,
-                 peak_flops: float) -> Dict[str, float]:
+                 peak_flops: float,
+                 interconnect_bytes_per_sec: float = 0.0) -> Dict[str, float]:
     """Price the static per-step costs at the observed rate."""
     out = {
         "modeled_comm_bytes_per_sec":
@@ -73,4 +84,13 @@ def joined_rates(static: Dict[str, Any], steps_per_sec: float,
     if static.get("flops_per_step") and peak_flops > 0:
         out["window_mfu"] = (static["flops_per_step"] * steps_per_sec
                              / peak_flops)
+    exposed = static.get("exposed_comm_bytes_per_step")
+    if exposed is not None and interconnect_bytes_per_sec > 0:
+        # modeled serial wire time of the exposed collectives per step —
+        # the comm the scheduler is NOT hiding behind compute
+        out["exposed_comm_ms"] = exposed / interconnect_bytes_per_sec * 1e3
+    total = static.get("comm_bytes_per_step") or 0
+    if total and "overlapped_comm_bytes_per_step" in static:
+        out["overlap_efficiency"] = (
+            static["overlapped_comm_bytes_per_step"] / total)
     return out

@@ -181,6 +181,7 @@ _CORPUS_RULES = {
     "census-drift": "collective-census-drift",
     "fused-hoist": "collective-census-drift",
     "telemetry-leak": "donation-missing",
+    "deferred-sync-regression": "collective-census-drift",
 }
 
 
@@ -192,6 +193,18 @@ class TestSeededCorpus:
         assert not report.ok, f"{name}: seeded violation not flagged"
         rules = {f.rule for f in report.findings}
         assert _CORPUS_RULES[name] in rules, (name, rules)
+
+    def test_deferred_sync_regression_reports_exposed(self, devices8):
+        """The gas=4 per-microbatch reduce-scatter corpus entry must be
+        flagged BOTH ways: census drift (gas x inflation vs the deferred
+        1-per-step pin) AND exposed collectives from the overlap audit."""
+        from deepspeed_tpu.analysis.corpus import run_corpus
+        report = run_corpus("deferred-sync-regression", devices=devices8[:2])
+        rules = {f.rule for f in report.findings}
+        assert "collective-census-drift" in rules
+        assert "collective-exposed" in rules
+        ov = report.overlap["deferred_step"]
+        assert ov["exposed"]["count"] == 4 and ov["overlapped"]["count"] == 0
 
     def test_suppression_accepts_known_finding(self, devices8):
         from deepspeed_tpu.analysis.corpus import run_corpus

@@ -25,9 +25,12 @@ def test_all_ops_run(mesh8):
         assert r["world"] == 8
         assert r["latency_us"] > 0
         assert r["alg_bw_gbps"] > 0
-        # both fields are independently rounded to 4 decimals
+        # both fields are independently rounded to 4 decimals — on a loaded
+        # box the measured bandwidths can be ~1e-3 Gbps, where the rounding
+        # quantum (2x 1e-4) exceeds any relative tolerance: allow it
+        # absolutely so the convention check doesn't flake under load
         assert r["bus_bw_gbps"] == pytest.approx(
-            r["alg_bw_gbps"] * _bus_factor(op, 8), rel=5e-2)
+            r["alg_bw_gbps"] * _bus_factor(op, 8), rel=5e-2, abs=3e-4)
 
 
 def test_bus_factor_convention():
