@@ -21,18 +21,24 @@ Modules:
 
 from deepspeed_tpu.analysis.analyzers import (AnalysisSettings,
                                               CollectiveAudit, DonationLint,
-                                              DtypePromotionLint,
-                                              OverlapAudit,
+                                              DtypePromotionLint, MemoryLint,
+                                              OverlapAudit, RematAudit,
                                               ReplicationBudget,
                                               default_analyzers)
-from deepspeed_tpu.analysis.expectations import (CollectivePolicy,
-                                                 expected_collectives)
-from deepspeed_tpu.analysis.hlo_parse import (CollectiveOp, OverlapOp,
+from deepspeed_tpu.analysis.expectations import (CollectivePolicy, MemoryLaw,
+                                                 expected_collectives,
+                                                 expected_memory_law)
+from deepspeed_tpu.analysis.hlo_parse import (CollectiveOp, EntryParam,
+                                              MemoryEstimate, OverlapOp,
                                               collective_census,
+                                              estimate_peak_hbm,
                                               overlap_summary,
                                               parse_collectives,
                                               parse_donated_params,
+                                              parse_entry_params,
                                               parse_overlap,
+                                              parse_remat_census,
+                                              parse_spmd_remat_warning,
                                               parse_upcasts,
                                               replicated_tensor_bytes,
                                               shape_bytes)
@@ -48,14 +54,17 @@ from deepspeed_tpu.analysis.report import (Finding, Report, compare_census,
 
 __all__ = [
     "AnalysisSettings", "CollectiveAudit", "CollectiveOp", "CollectivePolicy",
-    "DonationLint", "DtypePromotionLint", "Finding", "OverlapAudit",
-    "OverlapOp", "ProgramArtifacts",
+    "DonationLint", "DtypePromotionLint", "EntryParam", "Finding",
+    "MemoryEstimate", "MemoryLaw", "MemoryLint", "OverlapAudit",
+    "OverlapOp", "ProgramArtifacts", "RematAudit",
     "Report", "ReplicationBudget", "abstractify", "analyze_programs",
     "assert_no_spmd_replication", "audit_engine", "capture_spmd_warnings",
     "collective_census", "compare_census", "default_analyzers",
-    "expected_collectives", "jaxpr_primitive_census", "load_baseline",
+    "estimate_peak_hbm", "expected_collectives", "expected_memory_law",
+    "jaxpr_primitive_census", "load_baseline",
     "lower_engine_programs", "lower_program", "overlap_summary",
-    "parse_collectives", "parse_donated_params", "parse_overlap",
+    "parse_collectives", "parse_donated_params", "parse_entry_params",
+    "parse_overlap", "parse_remat_census", "parse_spmd_remat_warning",
     "parse_upcasts", "replicated_tensor_bytes",
     "run_lint", "save_baseline", "shape_bytes",
 ]

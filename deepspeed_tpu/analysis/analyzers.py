@@ -1,4 +1,4 @@
-"""The five graft-lint analyzers.
+"""The seven graft-lint analyzers.
 
 Each analyzer is ``analyze(artifacts, settings) -> [Finding]`` over one
 lowered program (analysis/program.py). They are pure text/structure passes —
@@ -23,6 +23,15 @@ lowering in CI and a 256-chip lowering on a real pod.
 5. ReplicationBudget  — explicitly-replicated float tensors above the floor
                         must fit the per-config byte budget (promotes the
                         old utils/hlo_check.replicated_tensor_bytes scan).
+6. MemoryLint         — static peak-HBM liveness over the scheduled module
+                        (params/grads/opt/activations breakdown, gated by
+                        analysis.max_hbm_bytes) + the ZeRO memory law: the
+                        per-device bytes of each persistent state class must
+                        be ~logical/dp per the configured stage.
+7. RematAudit         — rematerialization: flags involuntary SPMD full
+                        rematerialization captured at compile time, and a
+                        configured-but-inert remat policy (no recomputed ops
+                        in the scheduled backward).
 """
 
 import dataclasses
@@ -55,6 +64,17 @@ class AnalysisSettings:
     # lowerings never emit async pairs, so the gate is opt-in.
     max_exposed_collectives: Optional[int] = None
     min_exposed_bytes: int = 1024
+    # memory lint: statically-modeled peak HBM a program may reach before
+    # "memory-peak" fires. None = report-only (the estimate still lands in
+    # Report.memory) — absolute peaks are model/mesh-specific.
+    max_hbm_bytes: Optional[int] = None
+    # memory law: a state class expected to shard 1/dp may exceed
+    # logical/dp by this factor (small unshardable leaves, persistence
+    # thresholds, padding) before "memory-law" fires...
+    memory_law_tolerance: float = 1.5
+    # ...and the absolute excess must also clear this floor (tiny test
+    # models never trip the law by rounding)
+    min_law_bytes: int = 1 << 20
     # rule ids / finding-key prefixes to suppress
     suppress: List[str] = dataclasses.field(default_factory=list)
     baseline: Optional[str] = None
@@ -72,6 +92,9 @@ class AnalysisSettings:
                    max_replicated_bytes=a.max_replicated_bytes,
                    max_exposed_collectives=a.max_exposed_collectives,
                    min_exposed_bytes=a.min_exposed_bytes,
+                   max_hbm_bytes=a.max_hbm_bytes,
+                   memory_law_tolerance=a.memory_law_tolerance,
+                   min_law_bytes=a.min_law_bytes,
                    suppress=list(a.suppress),
                    baseline=a.baseline)
 
@@ -297,6 +320,191 @@ class ReplicationBudget:
                   "budget": settings.max_replicated_bytes})]
 
 
-def default_analyzers(policy: CollectivePolicy):
+class MemoryLint:
+    """Static peak-HBM liveness + the ZeRO memory law.
+
+    The liveness pass (hlo_parse.estimate_peak_hbm) models every scheduled
+    top-level buffer's live range and reports the peak with a per-class
+    breakdown: entry parameters are classified by their state-tree path
+    (/params vs /opt vs other state), temporaries by shape provenance
+    (state-shaped temps are gradients/moment updates, the rest are
+    activations). The memory law compares the per-device (post-SPMD) bytes
+    of each persistent class against logical/dp for the configured ZeRO
+    stage: a silently replicated opt-state leaf in a stage>=1 config shows
+    up here even when no explicit sharding annotation names it."""
+
+    rule_peak = "memory-peak"
+    rule_law = "memory-law"
+
+    def __init__(self, law):
+        self.law = law   # expectations.MemoryLaw
+
+    @staticmethod
+    def measure(art) -> Dict[str, Any]:
+        """The per-program memory summary recorded in Report.memory —
+        computed once per program, shared by analyze() and the report."""
+        entry = hlo_parse.parse_entry_params(art.optimized_hlo)
+        n_state = len(art.donatable_paths)
+        param_classes: Dict[int, str] = {}
+        temp_shapes: Dict[str, str] = {}
+        per_device: Dict[str, int] = {}
+        logical: Dict[str, int] = {}
+        for p in entry:
+            if n_state and p.number < n_state:
+                path = art.donatable_paths[p.number]
+                cls = ("params" if path.startswith("/params")
+                       else "opt" if path.startswith("/opt") else "state")
+                temp_shapes[f"{p.dtype}[{p.dims}]"] = "grads"
+                logical[cls] = (logical.get(cls, 0)
+                                + art.donatable_bytes[p.number])
+            else:
+                # batch/rng/scalar inputs: data, not state
+                cls = "activations"
+            param_classes[p.number] = cls
+            per_device[cls] = per_device.get(cls, 0) + p.nbytes
+        est = hlo_parse.estimate_peak_hbm(
+            art.optimized_hlo, param_classes=param_classes,
+            temp_class_shapes=temp_shapes)
+        breakdown = {c: est.breakdown.get(c, 0)
+                     for c in ("params", "grads", "opt", "activations")}
+        for c, b in est.breakdown.items():   # extra classes (misc state)
+            if c not in breakdown:
+                breakdown[c] = b
+        out: Dict[str, Any] = {
+            "peak_hbm_bytes": est.peak_bytes,
+            "peak_breakdown": breakdown,
+            "state_bytes": {
+                cls: {"logical": logical.get(cls, 0),
+                      "per_device": per_device.get(cls, 0)}
+                for cls in sorted(set(logical) | set(per_device)
+                                  - {"activations"})},
+            "boundary_activation_bytes": est.boundary_bytes,
+            "remat": hlo_parse.parse_remat_census(art.optimized_hlo),
+            "largest_at_peak": [
+                {"bytes": b, "class": c, "line": l} for b, c, l in
+                est.largest[:4]],
+        }
+        if art.meta.get("xla_memory"):
+            out["xla_memory"] = dict(art.meta["xla_memory"])
+        return out
+
+    def analyze(self, art, settings: AnalysisSettings,
+                memory: Optional[Dict[str, Any]] = None) -> List[Finding]:
+        if memory is None:
+            memory = self.measure(art)
+        findings = []
+        peak = memory["peak_hbm_bytes"]
+        if settings.max_hbm_bytes is not None \
+                and peak > settings.max_hbm_bytes:
+            bd = ", ".join(f"{c}={b}" for c, b in
+                           memory["peak_breakdown"].items())
+            worst = memory["largest_at_peak"][:2]
+            findings.append(Finding(
+                rule=self.rule_peak, program=art.name,
+                ident=f"peak={peak}", nbytes=peak,
+                message=(f"statically modeled peak HBM {peak} bytes exceeds "
+                         f"analysis.max_hbm_bytes={settings.max_hbm_bytes} "
+                         f"(at peak: {bd}; largest live: "
+                         + "; ".join(f"{w['bytes']}B {w['class']} "
+                                     f"`{w['line'][:80]}`" for w in worst)
+                         + ")"),
+                data={"breakdown": memory["peak_breakdown"],
+                      "budget": settings.max_hbm_bytes,
+                      "largest": memory["largest_at_peak"]}))
+        # the memory law needs the donation contract to know which entry
+        # params are which state class; programs without one opt out
+        if not art.donatable_paths:
+            return findings
+        for cls, factor in (("params", self.law.params),
+                            ("opt", self.law.opt)):
+            if factor <= 1:
+                continue
+            sb = memory["state_bytes"].get(cls)
+            if not sb or not sb["logical"]:
+                continue
+            expected = sb["logical"] / factor
+            excess = sb["per_device"] - expected
+            if sb["per_device"] > expected * settings.memory_law_tolerance \
+                    and excess >= settings.min_law_bytes:
+                findings.append(Finding(
+                    rule=self.rule_law, program=art.name, ident=cls,
+                    nbytes=int(excess),
+                    message=(f"{cls} state holds {sb['per_device']} bytes "
+                             f"per device but the ZeRO memory law expects "
+                             f"~{int(expected)} (logical {sb['logical']} / "
+                             f"{factor}; {self.law.reason}) — a leaf this "
+                             "config should shard is replicated"),
+                    data={"per_device": sb["per_device"],
+                          "logical": sb["logical"],
+                          "expected_factor": factor,
+                          "measured_factor": round(
+                              sb["logical"] / max(1, sb["per_device"]), 3)}))
+        return findings
+
+
+class RematAudit:
+    """Rematerialization audit of the scheduled module.
+
+    Involuntary remat: the SPMD partitioner's 'Involuntary full
+    rematerialization' fallback (captured on fd 2 during compile,
+    structured in meta["spmd_warnings"]) means a tensor is replicated+
+    recomputed in the hot loop at every step — an error at any scale.
+    Inert policy: the config asked for activation checkpointing but the
+    compiled backward contains no rematerialized op (jax stamps recomputed
+    regions with /rematted_computation/ metadata) — the activations the
+    policy was meant to drop are being carried across the fwd/bwd boundary
+    instead (the liveness pass prices exactly that set as
+    Report.memory[...]["boundary_activation_bytes"])."""
+
+    rule_involuntary = "involuntary-remat"
+    rule_inert = "remat-policy-inert"
+
+    def analyze(self, art, settings: AnalysisSettings,
+                memory: Optional[Dict[str, Any]] = None) -> List[Finding]:
+        findings = []
+        for w in art.meta.get("spmd_warnings", ()):
+            findings.append(Finding(
+                rule=self.rule_involuntary, program=art.name,
+                ident=str(w.get("op", w.get("raw", ""))[:80]),
+                nbytes=int(w.get("nbytes", 0)),
+                message=("XLA SPMD fell back to involuntary full "
+                         "rematerialization"
+                         + (f" of {w['shape']}" if "shape" in w else "")
+                         + (f" at {w['source_file']}:{w['source_line']}"
+                            if "source_file" in w else "")
+                         + (f" (resharding {w['from_sharding']} -> "
+                            f"{w['to_sharding']})"
+                            if "from_sharding" in w else "")
+                         + " — the tensor is replicated and recomputed "
+                         "every step; enrich its sharding annotations"),
+                data=dict(w)))
+        policy = art.meta.get("remat_policy")
+        if policy and policy != "none":
+            census = (memory or {}).get("remat") \
+                or hlo_parse.parse_remat_census(art.optimized_hlo)
+            if census["bwd_ops"] and not census["remat_ops"]:
+                boundary = (memory or {}).get("boundary_activation_bytes", 0)
+                findings.append(Finding(
+                    rule=self.rule_inert, program=art.name, ident=policy,
+                    severity="warning", nbytes=int(boundary),
+                    message=(f"remat policy '{policy}' is configured but "
+                             "the compiled backward recomputes nothing "
+                             f"(0 rematerialized ops, {census['bwd_ops']} "
+                             "backward ops) — checkpointed activations "
+                             + (f"({boundary} bytes) " if boundary else "")
+                             + "are carried across the fwd/bwd boundary "
+                             "instead of being recomputed"),
+                    data={"remat_census": census,
+                          "boundary_activation_bytes": boundary}))
+        return findings
+
+
+def default_analyzers(policy: CollectivePolicy, law=None):
+    if law is None:
+        # standalone callers (tests, corpus) default to "nothing sharded":
+        # the law gate stays quiet unless the caller supplies expectations
+        from deepspeed_tpu.analysis.expectations import MemoryLaw
+        law = MemoryLaw(params=1, opt=1, reason="no law expectations")
     return [CollectiveAudit(policy), OverlapAudit(), DonationLint(),
-            DtypePromotionLint(), ReplicationBudget()]
+            DtypePromotionLint(), ReplicationBudget(), MemoryLint(law),
+            RematAudit()]

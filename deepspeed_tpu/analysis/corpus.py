@@ -289,6 +289,100 @@ def deferred_sync_regression(devices=None):
             max_exposed_collectives=0, min_exposed_bytes=1))
 
 
+def _long_scan_program(remat: bool, devices=None):
+    """A 16-deep scanned residual stack with a fat intermediate per layer —
+    the shape whose activation liveness blows up without checkpointing.
+    Shared weights keep params/grads small so the fwd/bwd activation carry
+    dominates the peak: ~24 MiB modeled without remat (the stacked
+    [L,64,2048] residuals live across the whole backward) vs ~12 MiB with
+    the body checkpointed — the 18 MiB budget sits between the two, so
+    only the missing-checkpoint variant fires (measured on jax 0.4.37;
+    re-measure BOTH variants before retuning the budget)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    L = 16
+
+    def layer(h, w1, w2):
+        mid = jnp.tanh(h @ w1)           # [64,2048] — the fat intermediate
+        return h + jnp.tanh(mid @ w2)    # back to [64,256]
+
+    def loss(ws, x):
+        body = lambda h, _: (layer(h, ws[0], ws[1]), None)
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        h, _ = lax.scan(body, x, None, length=L)
+        return jnp.sum(h ** 2)
+
+    ws = (jax.ShapeDtypeStruct((256, 2048), jnp.float32),
+          jax.ShapeDtypeStruct((2048, 256), jnp.float32))
+    x = jax.ShapeDtypeStruct((64, 256), jnp.float32)
+    return lower_program(
+        jax.jit(jax.grad(loss)), ws, x,
+        name="long_scan_step", donatable=None, donation_expected=False,
+        meta={"skip_required": True})
+
+
+def remat_missing(devices=None):
+    """Memory lint: the long-scan config with its remat policy OFF — every
+    layer's fat intermediate is saved across the fwd/bwd boundary and the
+    static activation liveness blows past the budget (`memory-peak` must
+    fire). The same program WITH jax.checkpoint on the body stays under
+    the identical budget (tests assert both directions)."""
+    art = _long_scan_program(remat=False, devices=devices)
+    return analyze_programs(
+        [art], _stage0_config(), _FakePlan(),
+        settings=AnalysisSettings(max_hbm_bytes=18 << 20))
+
+
+def stage3_replicated_opt(devices=None):
+    """Memory law: a stage-3-style step whose params shard over dp but
+    whose Adam moments were left REPLICATED — per-device opt bytes are 2x
+    what the ZeRO memory law allows on the 2-device mesh. `memory-law`
+    must fire, and the explicit replicated shardings also blow the
+    replication budget (`replication-budget`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh2(devices)
+    repl = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    state = {
+        "opt": {   # the defect: moments pinned to a replicated sharding
+            "m": jax.ShapeDtypeStruct((1024, 1024), jnp.float32,
+                                      sharding=repl),
+            "v": jax.ShapeDtypeStruct((1024, 1024), jnp.float32,
+                                      sharding=repl)},
+        "params": {"w": jax.ShapeDtypeStruct((1024, 1024), jnp.float32,
+                                             sharding=row)}}
+
+    def step(state, lr):
+        w, m, v = state["params"]["w"], state["opt"]["m"], state["opt"]["v"]
+        g = w * 2.0
+        m2 = 0.9 * m + 0.1 * g
+        v2 = 0.99 * v + 0.01 * g * g
+        w2 = w - lr * m2 / (jnp.sqrt(v2) + 1e-8)
+        return {"opt": {"m": m2, "v": v2}, "params": {"w": w2}}
+
+    # donation_expected=False: this entry plants exactly ONE defect (the
+    # replicated moments); whether XLA honors the donation of a replicated
+    # buffer on this backend is not the seeded failure. The memory-law
+    # check reads donatable_paths (the state-class map) either way.
+    jitted = jax.jit(step, donate_argnums=(0,))
+    art = lower_program(jitted, state,
+                        jax.ShapeDtypeStruct((), jnp.float32),
+                        name="stage3_step", mesh=mesh, donatable=state,
+                        donation_expected=False,
+                        meta={"skip_required": True, "world_size": 2})
+    from deepspeed_tpu.config import Config
+    cfg = Config.load({"train_batch_size": 4,
+                       "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                       "bf16": {"enabled": False},
+                       "zero_optimization": {"stage": 3}})
+    return analyze_programs([art], cfg, _FakePlan(),
+                            settings=AnalysisSettings())
+
+
 class NoisyLossModel:
     """A model wrapper whose loss adds a term that forces one extra dense
     cross-replica reduction — the classic silently-added allreduce, planted
@@ -319,6 +413,8 @@ CORPUS = {
     "fused-hoist": fused_loop_hoist,
     "telemetry-leak": telemetry_leak,
     "deferred-sync-regression": deferred_sync_regression,
+    "remat-missing": remat_missing,
+    "stage3-replicated-opt": stage3_replicated_opt,
 }
 
 

@@ -109,3 +109,41 @@ def expected_collectives(config, plan, *, onebit_phase=None) -> CollectivePolicy
     return CollectivePolicy(allowed=frozenset(allowed),
                             required=tuple(required),
                             reason="; ".join(why) or "no parallel axes")
+
+
+# --------------------------------------------------------------------------
+# ZeRO memory law (Rajbhandari et al. 2020, Table 1)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MemoryLaw:
+    """Expected shard factor per persistent state class: per-device bytes of
+    a class must be ~logical/factor. Factor 1 = replicated by design."""
+    params: int
+    opt: int
+    reason: str
+
+
+def expected_memory_law(config, plan) -> MemoryLaw:
+    """The ZeRO memory law as shard factors over the dp dimension.
+
+    stage 0: everything replicated (factor 1). stage 1/2: optimizer state
+    (master + moments) sharded 1/dp, params still replicated. stage 3:
+    params sharded too. Tensor parallelism also shards the matmul weights,
+    but not every leaf (norms, biases stay replicated), so the law is only
+    asserted over the dp product — the tensor factor shows up as slack in
+    the measured ratio, never as a violation.
+    """
+    dp = plan.data * plan.fsdp
+    stage = config.zero_optimization.stage
+    if plan.world_size <= 1 or dp <= 1:
+        return MemoryLaw(params=1, opt=1,
+                         reason="no data-parallel axis: nothing to shard")
+    return MemoryLaw(
+        params=dp if stage >= 3 else 1,
+        opt=dp if stage >= 1 else 1,
+        reason=f"ZeRO stage {stage} over dp={dp}: "
+               + {0: "params/grads/opt replicated",
+                  1: "opt sharded 1/dp",
+                  2: "opt sharded 1/dp (grads reduce-scattered)",
+                  3: "params AND opt sharded 1/dp"}[min(stage, 3)])

@@ -20,7 +20,7 @@ Three program representations matter (analysis/program.py produces them):
 
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # HLO primitive byte widths (token/opaque types are skipped).
 ITEMSIZE = {
@@ -321,6 +321,444 @@ def parse_upcasts(hlo_text: str, min_bytes: int = 0) -> List[ConvertOp]:
         out.append(ConvertOp(to_dtype=to_dt, from_dtype=from_dt, nbytes=nb,
                              shape=f"{to_dt}[{dims}]",
                              line=line.strip()[:240]))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Static peak-HBM liveness (scheduled HLO)
+# --------------------------------------------------------------------------
+#
+# ``compiled.as_text()`` of a compiled module carries ``is_scheduled=true``:
+# the instruction order IS the schedule, so def/last-use over that order is a
+# faithful live-range model. Each top-level instruction allocates its result
+# bytes; view-like ops (gte/tuple/bitcast/while/...-done/dynamic-update-slice)
+# alias their operands instead of allocating — the same ops XLA's buffer
+# assignment treats as in-place updates or pointer bookkeeping. While/
+# conditional bodies contribute their own internal temp peak at the call site
+# (the carry is charged once, at the caller). Entry parameters are caller-
+# owned and live for the whole program; a donated output (input_output_alias)
+# writes into its parameter's buffer instead of allocating a second one —
+# which is exactly why a missed donation shows up here as double memory.
+# The estimate is cross-checkable against ``compiled.memory_analysis()``
+# where the backend provides one (analysis/program.py records it in meta).
+
+# ops whose result is a view/in-place update of an operand — no new buffer.
+# (`-done` halves of async pairs land here via the suffix check below.)
+_ALIAS_OPS = frozenset((
+    "get-tuple-element", "tuple", "bitcast", "while", "optimization-barrier",
+    "dynamic-update-slice", "add-dependency", "after-all",
+))
+
+_INSTR_RE = re.compile(r"^\s+(ROOT\s+)?(%?[\w.\-]+)\s*=\s*(.*)$")
+# first lowercase word directly followed by '(' after the result type
+_OPCODE_RE = re.compile(r"(?:^|\s)([a-z][\w\-]*)\(")
+# operand refs: %var not preceded by '=' (excludes attr refs like body=%b)
+_OPERAND_RE = re.compile(r"(?<![=\w])%([\w.\-]+)")
+_CALLED_RE = re.compile(
+    r"(?:body|true_computation|false_computation|to_apply)"
+    r"=%?([\w.\-]+)|branch_computations=\{([^}]*)\}")
+_PARAM_NUM_RE = re.compile(r"parameter\((\d+)\)")
+# header alias entries WITH the output index: {out}: (param, {path}, kind)
+_ALIAS_PAIR_RE = re.compile(
+    r"\{(\d+)[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*"
+    r"(?:may-alias|must-alias)\)")
+
+
+def shape_key(result_text: str) -> str:
+    """Normalized "dtype[dims]" of a (non-tuple) result type, or ""."""
+    m = _SHAPE_RE.search(result_text)
+    return f"{m.group(1)}[{m.group(2)}]" if m else ""
+
+
+@dataclass
+class EntryParam:
+    """One ENTRY-computation parameter of the compiled (post-SPMD) module —
+    its shape is the PER-DEVICE shard, not the logical array."""
+    number: int
+    var: str
+    dtype: str
+    dims: str
+    nbytes: int
+
+
+def parse_entry_params(optimized_hlo: str) -> List[EntryParam]:
+    """Entry parameters with their per-device shapes, sorted by number."""
+    comps, entry = _split_computations(optimized_hlo)
+    out = []
+    for line in comps.get(entry, ()):
+        if " parameter(" not in line:
+            continue
+        pm = _PARAM_NUM_RE.search(line)
+        m = _INSTR_RE.match(line)
+        if not pm or not m:
+            continue
+        rhs = m.group(3)
+        sm = _SHAPE_RE.search(rhs)
+        dtype, dims = (sm.group(1), sm.group(2)) if sm else ("", "")
+        out.append(EntryParam(number=int(pm.group(1)),
+                              var=m.group(2).lstrip("%"),
+                              dtype=dtype, dims=dims,
+                              nbytes=shape_bytes(dtype, dims)))
+    return sorted(out, key=lambda p: p.number)
+
+
+@dataclass
+class _Buffer:
+    """One allocated buffer in one computation's schedule."""
+    var: str
+    nbytes: int
+    cls: str
+    first: int
+    last: int
+    line: str
+    is_param: bool = False
+
+
+@dataclass
+class MemoryEstimate:
+    """Static peak-HBM model of one scheduled module."""
+    peak_bytes: int = 0
+    peak_index: int = 0            # entry instruction index of the peak
+    # live bytes per class AT the peak point (body peaks included)
+    breakdown: Dict[str, int] = field(default_factory=dict)
+    # total entry-parameter bytes per class (per-device, post-SPMD)
+    param_bytes: Dict[str, int] = field(default_factory=dict)
+    # largest live buffers at the peak: (bytes, class, line)
+    largest: List[Tuple[int, str, str]] = field(default_factory=list)
+    # activation bytes carried across the fwd/bwd boundary (-1 = no
+    # backward-stamped instruction found in the entry computation)
+    boundary_index: int = -1
+    boundary_bytes: int = 0
+
+
+def _split_computations(text: str) -> Tuple[Dict[str, List[str]], str]:
+    """{computation_name: [instruction lines]}, entry computation name."""
+    comps: Dict[str, List[str]] = {}
+    cur: Optional[List[str]] = None
+    entry = ""
+    for line in text.splitlines():
+        if line and not line.startswith(" ") and not line.startswith("}"):
+            m = _COMPUTATION_HEADER_RE.match(line)
+            if m and "{" in line:
+                name = m.group(2)
+                if line.startswith("ENTRY"):
+                    entry = name
+                cur = comps.setdefault(name, [])
+            continue
+        if cur is not None and line.strip().startswith(("%", "ROOT")):
+            cur.append(line)
+    return comps, entry
+
+
+def _strip_attrs(rhs: str) -> str:
+    """Drop metadata/backend_config payloads before operand scanning."""
+    for marker in (", metadata={", ", backend_config="):
+        k = rhs.find(marker)
+        if k != -1:
+            rhs = rhs[:k]
+    return rhs
+
+
+class _Liveness:
+    """One liveness analysis over a parsed module: computation map, memoized
+    per-body temp peaks, and the shape->class temp classifier."""
+
+    def __init__(self, comps: Dict[str, List[str]],
+                 temp_class_shapes: Optional[Dict[str, str]] = None):
+        self.comps = comps
+        self.temp_shapes = temp_class_shapes or {}
+        self._body_peak: Dict[str, Tuple[int, Dict[str, int]]] = {}
+
+    # -- one computation scan ---------------------------------------------
+    def _scan(self, lines: List[str],
+              param_classes: Optional[Dict[int, str]]):
+        """Def/last-use over one computation's scheduled instructions.
+
+        Returns (buffers: {var: _Buffer}, body_at: {idx: (bytes, breakdown)},
+        param_var: {param_number: var}, root: (idx, out_vars) | None,
+        boundary: first backward-stamped instruction index | -1, n_instr).
+        param_classes None = body computation: parameters are caller-owned
+        views and contribute nothing here.
+        """
+        bufs: Dict[str, _Buffer] = {}
+        # var -> ("ref", v) | ("tuple", (v...)) | ("elt", tuple_var, index)
+        # — element-level aliasing matters: a gte selecting ONE element of
+        # a fat while carry must not keep every carry buffer alive
+        alias: Dict[str, Tuple] = {}
+        body_at: Dict[int, Tuple[int, Dict[str, int]]] = {}
+        param_var: Dict[int, str] = {}
+        root = None
+        boundary = -1
+        i = 0
+
+        def roots(var: str, _depth: int = 0) -> List[str]:
+            if var in bufs:
+                return [var]
+            a = alias.get(var)
+            if a is None or _depth > 64:
+                return []
+            if a[0] == "ref":
+                return roots(a[1], _depth + 1)
+            if a[0] == "tuple":
+                out: List[str] = []
+                for v in a[1]:
+                    out.extend(roots(v, _depth + 1))
+                return out
+            # ("elt", tv, k): chase refs until a tuple structure resolves,
+            # then select element k; anything opaque falls back to coarse
+            tv, k = a[1], a[2]
+            cur = tv
+            for _ in range(64):
+                if cur in bufs:
+                    return [cur]   # materialized tuple buffer
+                aa = alias.get(cur)
+                if aa is None:
+                    return []
+                if aa[0] == "ref":
+                    cur = aa[1]
+                    continue
+                if aa[0] == "tuple":
+                    elems = aa[1]
+                    if k < len(elems):
+                        return roots(elems[k], _depth + 1)
+                    return roots(cur, _depth + 1)
+                return roots(cur, _depth + 1)   # nested elt: coarse
+            return []
+
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if not m:
+                continue
+            is_root = bool(m.group(1))
+            var, rhs = m.group(2).lstrip("%"), m.group(3)
+            if boundary < 0 and _BWD_MARK_RE.search(line):
+                boundary = i
+            stripped = _strip_attrs(rhs)
+            om = _OPCODE_RE.search(stripped)
+            opcode = om.group(1) if om else ""
+            type_text = stripped[:om.start()] if om else stripped
+            operands = tuple(_OPERAND_RE.findall(stripped))
+            for op_var in operands:
+                for r in roots(op_var):
+                    bufs[r].last = max(bufs[r].last, i)
+            if opcode == "parameter":
+                if param_classes is not None:
+                    pm = _PARAM_NUM_RE.search(stripped)
+                    num = int(pm.group(1)) if pm else -1
+                    bufs[var] = _Buffer(
+                        var=var, nbytes=result_bytes(type_text),
+                        cls=param_classes.get(num, "params"),
+                        first=0, last=i, line=line.strip()[:200],
+                        is_param=True)
+                    param_var[num] = var
+                else:
+                    # body carry: owned by the caller
+                    alias[var] = ("tuple", ())
+            elif opcode in _ALIAS_OPS or opcode.endswith("-done"):
+                # view of the operand(s): dynamic-update-slice updates in
+                # place; while reuses its carry; tuple/gte are pointers
+                if opcode == "tuple":
+                    alias[var] = ("tuple", operands)
+                elif opcode == "get-tuple-element":
+                    im = re.search(r"index=(\d+)", stripped)
+                    alias[var] = ("elt", operands[0] if operands else "",
+                                  int(im.group(1)) if im else 0)
+                elif operands:
+                    # while/dus/barrier/done: view of the first operand
+                    alias[var] = ("ref", operands[0])
+                else:
+                    alias[var] = ("tuple", ())
+            else:
+                bufs[var] = _Buffer(
+                    var=var, nbytes=result_bytes(type_text),
+                    cls=self.temp_shapes.get(shape_key(type_text),
+                                             "activations"),
+                    first=i, last=i, line=line.strip()[:200])
+            if opcode in ("while", "conditional", "call"):
+                # bodies run at this instruction; conditions/reducers are
+                # scalar math and peak at ~0, so max() lands on the body
+                peaks = [self.body_peak(nm)
+                         for cm in _CALLED_RE.finditer(rhs)
+                         for nm in ([cm.group(1)] if cm.group(1)
+                                    else re.findall(r"%?([\w.\-]+)",
+                                                    cm.group(2) or ""))
+                         if nm in self.comps]
+                if peaks:
+                    body_at[i] = max(peaks, key=lambda p: p[0])
+            if is_root:
+                out_vars = (list(operands) if opcode == "tuple" else [var])
+                root = (i, out_vars)
+            i += 1
+
+        if root is not None:
+            for v in root[1]:
+                for r in roots(v):
+                    bufs[r].last = i
+        for v in param_var.values():
+            bufs[v].last = i   # caller-owned: resident for the whole step
+        return bufs, body_at, param_var, root, boundary, i
+
+    # -- body peaks --------------------------------------------------------
+    def body_peak(self, name: str) -> Tuple[int, Dict[str, int]]:
+        """Internal temp peak of a non-entry computation (its carry is
+        charged at the call site)."""
+        if name in self._body_peak:
+            return self._body_peak[name]
+        self._body_peak[name] = (0, {})   # cycle guard
+        if name in self.comps:
+            est = self._sweep(self.comps[name], param_classes=None)
+            self._body_peak[name] = (est.peak_bytes, est.breakdown)
+        return self._body_peak[name]
+
+    # -- peak sweep --------------------------------------------------------
+    def _sweep(self, lines: List[str],
+               param_classes: Optional[Dict[int, str]],
+               alias_pairs: Tuple[Tuple[int, int], ...] = ()
+               ) -> MemoryEstimate:
+        bufs, body_at, param_var, root, boundary, n = self._scan(
+            lines, param_classes)
+        if root is not None and param_classes is not None:
+            # donated outputs write into their parameter's buffer: the
+            # producing op is not a second allocation
+            pvars = set(param_var.values())
+            for out_idx, pnum in alias_pairs:
+                if out_idx < len(root[1]) and pnum in param_var:
+                    for b in bufs.values():
+                        if b.var == root[1][out_idx].lstrip("%") \
+                                and b.var not in pvars:
+                            b.nbytes = 0
+        elif root is not None:
+            # while/conditional BODY: XLA requires the body root to share
+            # the carry's shape/layout and buffer-assigns them in place —
+            # the updated-carry producers are not second allocations (this
+            # is what keeps a fused K-step program's peak ~1x one step's:
+            # the inter-step state stays in the carry slot)
+            for v in root[1]:
+                b = bufs.get(v.lstrip("%"))
+                if b is not None:
+                    b.nbytes = 0
+
+        est = MemoryEstimate()
+        if param_classes is not None:
+            for b in bufs.values():
+                if b.is_param:
+                    est.param_bytes[b.cls] = \
+                        est.param_bytes.get(b.cls, 0) + b.nbytes
+
+        # one O(n) sweep finds the peak index; the per-class breakdown and
+        # largest-buffer list are reconstructed in a single linear pass at
+        # that index afterwards (rebuilding them inside the sweep is
+        # quadratic on the forward ramp of a real pod's module, where
+        # almost every allocation raises the running peak)
+        delta: Dict[int, int] = {}
+        for b in bufs.values():
+            delta[b.first] = delta.get(b.first, 0) + b.nbytes
+            delta[b.last + 1] = delta.get(b.last + 1, 0) - b.nbytes
+        live = 0
+        for i in range(n + 1):
+            live += delta.get(i, 0)
+            body_b = body_at.get(i, (0, {}))[0]
+            if live + body_b > est.peak_bytes:
+                est.peak_bytes = live + body_b
+                est.peak_index = i
+        i_peak = est.peak_index
+        at_peak = [b for b in bufs.values() if b.first <= i_peak <= b.last]
+        bd: Dict[str, int] = {}
+        for b in at_peak:
+            bd[b.cls] = bd.get(b.cls, 0) + b.nbytes
+        for c, by in body_at.get(i_peak, (0, {}))[1].items():
+            bd[c] = bd.get(c, 0) + by
+        est.breakdown = bd
+        est.largest = sorted(((b.nbytes, b.cls, b.line)
+                              for b in at_peak if b.nbytes),
+                             key=lambda t: -t[0])[:8]
+
+        est.boundary_index = boundary
+        if boundary >= 0:
+            est.boundary_bytes = sum(
+                b.nbytes for b in bufs.values()
+                if not b.is_param and b.cls == "activations"
+                and b.first < boundary <= b.last)
+        return est
+
+
+def estimate_peak_hbm(optimized_hlo: str,
+                      param_classes: Optional[Dict[int, str]] = None,
+                      temp_class_shapes: Optional[Dict[str, str]] = None
+                      ) -> MemoryEstimate:
+    """Static peak-HBM estimate of one scheduled module.
+
+    param_classes: entry-param number -> class ("params"/"opt"/...);
+    unmapped params default to "params".
+    temp_class_shapes: normalized "dtype[dims]" -> class for temporaries
+    whose shape provenance is known (state-shaped temps are grads);
+    unmatched temps are "activations".
+    """
+    comps, entry = _split_computations(optimized_hlo)
+    if not entry:
+        return MemoryEstimate()
+    header_end = optimized_hlo.find("\n")
+    header = optimized_hlo[:header_end] if header_end != -1 else optimized_hlo
+    pairs: Tuple[Tuple[int, int], ...] = ()
+    if _ALIAS_BLOCK_RE.search(header):
+        pairs = tuple((int(o), int(p))
+                      for o, p in _ALIAS_PAIR_RE.findall(header))
+    lv = _Liveness(comps, temp_class_shapes)
+    return lv._sweep(comps[entry], param_classes=param_classes or {},
+                     alias_pairs=pairs)
+
+
+# --------------------------------------------------------------------------
+# Remat census (scheduled HLO + jax metadata)
+# --------------------------------------------------------------------------
+
+# jax.checkpoint regions stamp recomputed ops with /rematted_computation/ in
+# their op_name metadata; autodiff backward ops carry transpose(jvp(...)).
+_REMAT_MARK_RE = re.compile(r'op_name="[^"]*rematted_computation[^"]*"')
+_BWD_MARK_RE = re.compile(r'op_name="[^"]*transpose\(jvp[^"]*"')
+
+
+def parse_remat_census(optimized_hlo: str) -> Dict[str, int]:
+    """{"remat_ops": recomputed-in-backward ops, "bwd_ops": ops stamped as
+    autodiff transpose, "total_ops": all metadata-carrying ops} over the
+    whole module text (fusion bodies included — remat survives fusion in
+    the metadata)."""
+    return {"remat_ops": len(_REMAT_MARK_RE.findall(optimized_hlo)),
+            "bwd_ops": len(_BWD_MARK_RE.findall(optimized_hlo)),
+            "total_ops": optimized_hlo.count('op_name="')}
+
+
+# --------------------------------------------------------------------------
+# SPMD partitioner warnings (involuntary full rematerialization)
+# --------------------------------------------------------------------------
+
+_SPMD_WARN_RE = re.compile(
+    r"from sharding (\{[^}]*\}[^ ]*) to (\{[^}]*\}[^ ]*) without")
+_SPMD_OP_RE = re.compile(
+    r"HLO operation:\s*(%?[\w.\-]+)\s*=\s*(\w+\[[\d,]*\])")
+_SPMD_SRC_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
+_SPMD_OPNAME_RE = re.compile(r'op_name="([^"]+)"')
+
+
+def parse_spmd_remat_warning(line: str) -> Dict[str, object]:
+    """Structure one spmd_partitioner.cc 'Involuntary full
+    rematerialization' log line into a machine-readable diagnosis."""
+    out: Dict[str, object] = {"raw": line.strip()[:500]}
+    m = _SPMD_WARN_RE.search(line)
+    if m:
+        out["from_sharding"], out["to_sharding"] = m.group(1), m.group(2)
+    m = _SPMD_OP_RE.search(line)
+    if m:
+        out["op"], out["shape"] = m.group(1), m.group(2)
+        sm = _SHAPE_RE.search(m.group(2))
+        if sm:
+            out["nbytes"] = shape_bytes(sm.group(1), sm.group(2))
+    m = _SPMD_SRC_RE.search(line)
+    if m:
+        out["source_file"], out["source_line"] = m.group(1), int(m.group(2))
+    m = _SPMD_OPNAME_RE.search(line)
+    if m:
+        out["op_name"] = m.group(1)
     return out
 
 

@@ -22,9 +22,11 @@ import sys
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.analysis.analyzers import (AnalysisSettings,
-                                              CollectiveAudit, OverlapAudit,
+                                              CollectiveAudit, MemoryLint,
+                                              OverlapAudit, RematAudit,
                                               default_analyzers)
-from deepspeed_tpu.analysis.expectations import expected_collectives
+from deepspeed_tpu.analysis.expectations import (expected_collectives,
+                                                 expected_memory_law)
 from deepspeed_tpu.analysis.hlo_parse import (collective_census,
                                               overlap_summary,
                                               parse_overlap)
@@ -56,6 +58,7 @@ def analyze_programs(artifacts: List[ProgramArtifacts], config, plan,
     baseline = None
     if settings.baseline:
         baseline = load_baseline(settings.baseline)
+    law = expected_memory_law(config, plan) if plan is not None else None
     for art in artifacts:
         policy = expected_collectives(
             config, plan, onebit_phase=art.meta.get("onebit_phase"))
@@ -64,15 +67,21 @@ def analyze_programs(artifacts: List[ProgramArtifacts], config, plan,
         # census, the kind policy, and the overlap classification
         overlap_ops = parse_overlap(art.optimized_hlo)
         ops = overlap_ops
-        for analyzer in default_analyzers(policy):
+        # the memory summary is likewise computed once: MemoryLint,
+        # RematAudit and the report all read the same measurement
+        memory = MemoryLint.measure(art)
+        for analyzer in default_analyzers(policy, law=law):
             if isinstance(analyzer, CollectiveAudit):
                 report.extend(analyzer.analyze(art, settings, ops=ops))
             elif isinstance(analyzer, OverlapAudit):
                 report.extend(analyzer.analyze(art, settings,
                                                overlap_ops=overlap_ops))
+            elif isinstance(analyzer, (MemoryLint, RematAudit)):
+                report.extend(analyzer.analyze(art, settings, memory=memory))
             else:
                 report.extend(analyzer.analyze(art, settings))
         report.census[art.name] = collective_census(ops)
+        report.memory[art.name] = memory
         # UNFILTERED overlap census: min_exposed_bytes only exempts
         # control-plane ops from the OverlapAudit gate — the recorded
         # census must match the telemetry join's (min_bytes=0) so
@@ -112,8 +121,19 @@ def lower_engine_programs(engine, batch=None) -> List[ProgramArtifacts]:
     rng_abs = jax.ShapeDtypeStruct(engine._rng.shape, engine._rng.dtype)
     dtag = _dtype_tag(engine.compute_dtype)
     stage = engine.config.zero_optimization.stage
+    # the effective remat policy (for RematAudit's inert-policy check):
+    # transformer.py wraps the layer body in jax.checkpoint whenever remat
+    # is on or a named policy is set ("none"+remat=True = full checkpoint)
+    mcfg = getattr(engine.model, "config", None)
+    remat_policy = None
+    if mcfg is not None and (getattr(mcfg, "remat", False)
+                             or getattr(mcfg, "remat_policy", "none")
+                             not in ("none", None)):
+        rp = getattr(mcfg, "remat_policy", "none")
+        remat_policy = rp if rp not in ("none", None) else "full"
     meta = {"params_replicated_by_design": stage < 3,
-            "world_size": engine.plan.world_size}
+            "world_size": engine.plan.world_size,
+            "remat_policy": remat_policy}
     arts = []
     if engine._onebit_comm:
         for phase in ("warm", "comp"):

@@ -79,11 +79,30 @@ def lower_program(jitted, *abstract_args, name: str = "program",
 
     donatable: optional pytree (usually the state argument's abstract tree)
     whose leaves the program is expected to donate.
+
+    Compilation runs under the SPMD-warning capture: any involuntary full
+    rematerialization the partitioner logs on fd 2 lands structured in
+    ``meta["spmd_warnings"]`` (RematAudit turns them into findings). XLA's
+    own buffer-assignment stats, where the backend exposes them, land in
+    ``meta["xla_memory"]`` as a cross-check for the textual liveness model.
     """
     ctx = mesh if mesh is not None else contextlib.nullcontext()
-    with ctx:
+    spmd_matches: list = []
+    with ctx, capture_spmd_warnings(spmd_matches):
         lowered = jitted.lower(*abstract_args)
         compiled = lowered.compile()
+    xla_memory = {}
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            xla_memory = {
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "alias_bytes": int(ma.alias_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+            }
+    except Exception:  # pragma: no cover - backend-dependent surface
+        pass
     stablehlo = ""
     pre_hlo = ""
     try:
@@ -98,6 +117,13 @@ def lower_program(jitted, *abstract_args, name: str = "program",
     sizes: Tuple[int, ...] = ()
     if donatable is not None:
         paths, sizes = tree_leaf_paths(donatable)
+    full_meta = dict(meta or {})
+    if spmd_matches:
+        from deepspeed_tpu.analysis.hlo_parse import parse_spmd_remat_warning
+        full_meta["spmd_warnings"] = [parse_spmd_remat_warning(w)
+                                      for w in spmd_matches]
+    if xla_memory:
+        full_meta["xla_memory"] = xla_memory
     return ProgramArtifacts(
         name=name,
         optimized_hlo=compiled.as_text(),
@@ -107,7 +133,7 @@ def lower_program(jitted, *abstract_args, name: str = "program",
         donatable_bytes=sizes,
         donation_expected=donation_expected,
         compute_dtype=compute_dtype,
-        meta=dict(meta or {}))
+        meta=full_meta)
 
 
 # --------------------------------------------------------------------------

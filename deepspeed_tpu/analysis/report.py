@@ -48,6 +48,11 @@ class Report:
     # — scheduled-HLO overlap classification (analyzers.OverlapAudit)
     overlap: Dict[str, Dict[str, Dict[str, int]]] = \
         dataclasses.field(default_factory=dict)
+    # {program_name: {"peak_hbm_bytes", "peak_breakdown", "state_bytes",
+    #                 "boundary_activation_bytes", "remat", ...}}
+    # — static peak-HBM liveness + memory-law measurement (MemoryLint)
+    memory: Dict[str, Dict[str, Any]] = \
+        dataclasses.field(default_factory=dict)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -101,6 +106,7 @@ class Report:
             "suppressed": [f.to_dict() for f in self.suppressed],
             "census": self.census,
             "overlap": self.overlap,
+            "memory": self.memory,
             "meta": self.meta,
         }
 
@@ -126,6 +132,14 @@ class Report:
                     f"({_fmt_bytes(ov['overlapped']['bytes'])}), "
                     f"{ov['exposed']['count']} exposed "
                     f"({_fmt_bytes(ov['exposed']['bytes'])})")
+        for prog, mem in sorted(self.memory.items()):
+            if not mem.get("peak_hbm_bytes"):
+                continue
+            bd = ", ".join(f"{c} {_fmt_bytes(b)}" for c, b in
+                           mem.get("peak_breakdown", {}).items())
+            lines.append(f"[{prog}] peak HBM (modeled): "
+                         f"{_fmt_bytes(mem['peak_hbm_bytes'])}"
+                         + (f" ({bd})" if bd else ""))
         for f in self.findings:
             lines.append(f"{f.severity.upper()} {f.key}: {f.message}")
         if self.suppressed:

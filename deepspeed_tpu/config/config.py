@@ -377,6 +377,18 @@ class AnalysisConfig(ConfigModel):
     # exposed collectives smaller than this are control-plane sync and
     # exempt from the overlap gate
     min_exposed_bytes: int = 1024
+    # memory lint (scheduled-HLO liveness): statically modeled peak HBM a
+    # compiled step may reach before "memory-peak" fires. None (default) =
+    # report-only — peak_hbm_bytes still lands in the report/JSON with its
+    # params/grads/opt/activations breakdown, but absolute budgets are
+    # model- and mesh-specific so the gate is opt-in.
+    max_hbm_bytes: Optional[int] = None
+    # ZeRO memory law: a state class expected to shard 1/dp may exceed
+    # logical/dp by this factor (unshardable small leaves, persistence
+    # thresholds, padding) before "memory-law" fires, and the absolute
+    # excess must also clear min_law_bytes
+    memory_law_tolerance: float = 1.5
+    min_law_bytes: int = 1 << 20
     # finding keys / rule ids to suppress (accepted exceptions)
     suppress: List[str] = config_field([])
     # path to a baseline JSON (analysis.report.save_baseline): known

@@ -2095,6 +2095,13 @@ class Engine:
                     static, win["steps_per_sec"], peak,
                     interconnect_bytes_per_sec=
                     accel.interconnect_bytes_per_sec()))
+                if win.get("modeled_peak_hbm"):
+                    # measured allocator high-water next to the static
+                    # model (a cheap host call; 0 on transports that
+                    # expose no memory_stats)
+                    measured = accel.max_memory_allocated()
+                    if measured:
+                        win["measured_peak_hbm"] = float(measured)
         self._tel_last_window = win
         step = self.global_steps
         events = [(f"telemetry/{k}", float(win[k]), step)
@@ -2103,6 +2110,7 @@ class Engine:
                             "update_ratio_mean", "steps_per_sec",
                             "window_mfu", "modeled_comm_bytes_per_sec",
                             "exposed_comm_ms", "overlap_efficiency",
+                            "modeled_peak_hbm", "measured_peak_hbm",
                             "stall_ms_per_step")
                   if win.get(k) is not None]
         records = [{"type": "telemetry_window", "step": step, **win}]

@@ -47,17 +47,26 @@ def static_step_cost(jitted, abstract_args, *, mesh=None,
         except Exception:  # noqa: BLE001 - cost model is backend-dependent
             pass
         from deepspeed_tpu.analysis.hlo_parse import (collective_census,
+                                                      estimate_peak_hbm,
                                                       overlap_summary,
                                                       parse_overlap)
-        # ONE parse feeds both: the collective census (kind/bytes) and the
-        # scheduled-HLO overlap classification (how much of that wire load
-        # is hidden under compute vs exposed step latency)
-        overlap_ops = parse_overlap(compiled.as_text())
+        # ONE text dump feeds everything: the collective census
+        # (kind/bytes), the scheduled-HLO overlap classification (how much
+        # of that wire load is hidden under compute vs exposed step
+        # latency), and the static peak-HBM liveness model
+        text = compiled.as_text()
+        overlap_ops = parse_overlap(text)
         census = collective_census(overlap_ops)
         comm_bytes = sum(c["bytes"] for c in census.values())
         overlap = overlap_summary(overlap_ops)
+        # NOT divided by k: a correctly-fused K-step program carries its
+        # inter-step state at boundary shardings, so its peak stays ~1x
+        # the single step's — dividing would claim K-fused uses 1/K the
+        # memory of one step, which is exactly backwards
+        peak_hbm = estimate_peak_hbm(text).peak_bytes
         k = max(1, int(divisor))
         return {
+            "modeled_peak_hbm": peak_hbm,
             "flops_per_step": flops // k,
             "bytes_accessed_per_step": bytes_accessed // k,
             "comm_bytes_per_step": comm_bytes // k,
@@ -81,6 +90,10 @@ def joined_rates(static: Dict[str, Any], steps_per_sec: float,
         "modeled_comm_bytes_per_sec":
             static["comm_bytes_per_step"] * steps_per_sec,
     }
+    if static.get("modeled_peak_hbm"):
+        # not a rate, but it rides the same window join so every consumer
+        # (bench, dryrun, monitors) sees modeled peak next to measured
+        out["modeled_peak_hbm"] = float(static["modeled_peak_hbm"])
     if static.get("flops_per_step") and peak_flops > 0:
         out["window_mfu"] = (static["flops_per_step"] * steps_per_sec
                              / peak_flops)

@@ -284,10 +284,15 @@ class TestDeferredCensus:
         got = {k: c["count"] for k, c in rep.census["train_step"].items()}
         assert got["all-reduce"] == STAGE2_EAGER_GAS1_AR + EAGER_AR_PER_MB
 
+    @pytest.mark.slow
     def test_fused_deferred_census_scales_by_k(self, devices8):
         """The fused K-step program threads the deferred shard_map region K
         times: its census must be exactly K x the deferred single-step pin
-        (CollectiveAudit scales expect_collectives by meta fuse_steps)."""
+        (CollectiveAudit scales expect_collectives by meta fuse_steps).
+        Slow tier: the K-step lowering was the quick tier's single most
+        expensive compile (~13s on a 1-core box); the fuse_steps pin
+        scaling it exercises is also covered (slow) by test_analysis's
+        test_fused_program_census_scales_by_k."""
         rep = census_of(2, {"data": 2}, devices8[:2], 2, deferred=True,
                         expect=STAGE2_DEFERRED_CENSUS, fuse=2)
         assert rep.ok, rep.summary()

@@ -136,6 +136,26 @@ class TestInfinityExecutor:
         assert np.isfinite(ev)
         engine._infinity_exec.close()
 
+    def test_measure_decomposition_reports_positive_times(self, tmp_path):
+        """The capacity rung's transfer-vs-compute decomposition (bench.py
+        emits it as offload_dma_ms/offload_compute_ms + overlap fraction):
+        both probes measure real work and the per-step scaling is 2L chunk
+        DMAs (fwd+bwd fetch) x L layer fwd+bwd computations."""
+        engine, *_ = deepspeed_tpu.initialize(model=_model(),
+                                              config=_cfg_dict(tmp_path))
+        batch = _batch()
+        engine.train_batch(batch)   # compile + populate the store
+        d = engine._infinity_exec.measure_decomposition(batch, reps=1)
+        for k in ("offload_chunk_dma_ms", "offload_layer_ms",
+                  "offload_dma_ms", "offload_compute_ms"):
+            assert d[k] > 0, d
+        L = engine._infinity_exec.cfg.num_layers
+        assert d["offload_dma_ms"] == pytest.approx(
+            d["offload_chunk_dma_ms"] * 2 * L, rel=0.02, abs=0.1)
+        assert d["offload_compute_ms"] == pytest.approx(
+            d["offload_layer_ms"] * L, rel=0.02, abs=0.1)
+        engine._infinity_exec.close()
+
     def test_grad_accumulation(self, tmp_path):
         model = _model()
         engine, *_ = deepspeed_tpu.initialize(

@@ -522,6 +522,10 @@ class TestEngineTelemetryEndToEnd:
         # ZeRO-2 on an 8-way mesh moves real collective bytes every step
         assert win["modeled_comm_bytes_per_sec"] > 0
         assert 0 <= win.get("window_mfu", 0.0) < 1.0
+        # the memory-lint join rides the same static cost: modeled peak
+        # next to the allocator's measured high-water (when the transport
+        # exposes memory_stats — CPU does)
+        assert win["modeled_peak_hbm"] > 0
 
     def test_comms_logger_events_reach_monitor(self, tmp_path):
         jsonl = str(tmp_path / "comm.jsonl")
