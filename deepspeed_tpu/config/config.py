@@ -273,6 +273,21 @@ class CheckpointConfig(ConfigModel):
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = config_field({})
     async_save: bool = False
+    # --- integrity chain (deepspeed_tpu/robustness/integrity.py) ---
+    # write a per-tag manifest + atomic COMMITTED marker; load_checkpoint
+    # (tag=None) validates and walks back past torn/corrupt saves
+    integrity: bool = True
+    # re-hash file contents on validate (catches bitrot, not just
+    # truncation); sizes are always checked
+    integrity_checksums: bool = True
+    # bounded retention: keep the newest K *valid* tags, prune older good
+    # ones after each committed save (0 = unlimited; the tag `latest`
+    # names is never pruned)
+    keep_last_k: int = 0
+
+    def validate(self):
+        if self.keep_last_k < 0:
+            raise ConfigError("checkpoint.keep_last_k must be >= 0")
 
 
 @dataclasses.dataclass
@@ -335,6 +350,37 @@ class ElasticityConfig(ConfigModel):
     version: float = 0.2
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+
+
+@dataclasses.dataclass
+class FaultsConfig(ConfigModel):
+    """Deterministic fault injection (deepspeed_tpu/robustness/faults.py).
+    Entries fire at exact step / operation indices; `seed` feeds the
+    rate-based entries so a schedule replays identically. Reference
+    analogue: none — the reference's elasticity is only exercised by real
+    cluster failures."""
+    enabled: bool = False
+    seed: int = 0
+    # list of fault dicts: {"kind": "device_fault"|"io_error"|"torn_save"|
+    # "corrupt_payload"|"preempt"|"step_fault"|"clock_skew", ...} — see
+    # robustness.FaultSchedule for the per-kind keys
+    entries: List[Dict[str, Any]] = config_field([])
+
+    def validate(self):
+        if self.enabled:
+            from deepspeed_tpu.robustness.faults import FaultSchedule
+            try:
+                FaultSchedule(self.entries, self.seed)
+            except ValueError as e:  # config surface raises ConfigError
+                raise ConfigError(f"robustness.faults: {e}") from e
+
+
+@dataclasses.dataclass
+class RobustnessConfig(ConfigModel):
+    """Fault-tolerance knobs (deepspeed_tpu/robustness). Checkpoint
+    integrity/retention live under the `checkpoint` section for key parity
+    with the reference; this section owns what has no reference analogue."""
+    faults: FaultsConfig = config_field(FaultsConfig)
 
 
 @dataclasses.dataclass
@@ -509,6 +555,7 @@ class Config(ConfigModel):
     elasticity: ElasticityConfig = config_field(ElasticityConfig)
     autotuning: AutotuningConfig = config_field(AutotuningConfig)
     analysis: AnalysisConfig = config_field(AnalysisConfig)
+    robustness: RobustnessConfig = config_field(RobustnessConfig)
 
     # ---------------------------------------------------------------------
     @classmethod

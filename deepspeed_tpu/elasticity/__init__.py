@@ -2,3 +2,6 @@ from deepspeed_tpu.elasticity.elasticity import (
     ElasticityError, compute_elastic_config, get_compatible_gpus)
 from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
 from deepspeed_tpu.elasticity.rendezvous import FileRendezvous, reform_step
+# re-exported for the preemption-recovery loop (README "Fault tolerance"):
+# install a PreemptionHandler, pass it to DSElasticAgent, catch Preempted
+from deepspeed_tpu.robustness.preemption import Preempted, PreemptionHandler

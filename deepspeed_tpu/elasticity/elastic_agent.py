@@ -20,6 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.preemption import Preempted
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -65,13 +68,19 @@ class DSElasticAgent:
                  *, checkpoint_interval: int = 10,
                  device_count_fn: Optional[Callable[[], int]] = None,
                  probe_interval: Optional[int] = 100,
-                 health_fn: Optional[Callable[[], List]] = None):
+                 health_fn: Optional[Callable[[], List]] = None,
+                 fault_injector=None, preemption=None):
         """probe_interval: run the device-health probe every N steps
         (default 100; the probe is ALSO the only path that scales the
         world back UP after a recovery — None disables it and the agent
         then only reacts to shrinks and failed steps). health_fn:
         override for tests / fault injection; returns the healthy
-        devices."""
+        devices. fault_injector: a robustness.FaultInjector driving the
+        step/probe seams (defaults to the process-global injector armed by
+        the `robustness.faults` config). preemption: a PreemptionHandler;
+        when its SIGTERM latch is set, the next train_batch saves a final
+        checkpoint and raises Preempted (the checkpoint-and-exit
+        contract)."""
         if not config.get("elasticity", {}).get("enabled"):
             raise ValueError("DSElasticAgent requires an enabled "
                              "'elasticity' config section")
@@ -83,17 +92,29 @@ class DSElasticAgent:
         self._health_fn = health_fn
         self._probe_interval = probe_interval
         self._steps_since_probe = 0
+        self._injector = fault_injector
+        self._preemption = preemption
         self.engine = None
         self.world = 0
         self.scale_events = 0
         self.failure_events = 0
+        self.ckpt_failures = 0
         self._ensure_engine()
 
     # ------------------------------------------------------------------
+    def _fault_injector(self):
+        return self._injector if self._injector is not None \
+            else rb_faults.active()
+
     def _healthy_devices(self) -> List:
         if self._health_fn is not None:
-            return list(self._health_fn())
-        return probe_devices(jax.devices()[:int(self._device_fn())])
+            devices = list(self._health_fn())
+        else:
+            devices = probe_devices(jax.devices()[:int(self._device_fn())])
+        inj = self._fault_injector()
+        if inj is not None:
+            devices = inj.cull(devices)
+        return devices
 
     # ------------------------------------------------------------------
     def _ensure_engine(self, probe: bool = False) -> bool:
@@ -158,6 +179,16 @@ class DSElasticAgent:
         from the latest checkpoint, and the step is retried ONCE. `batch`
         may be a callable(batch_size) -> batch so the agent can request
         the right global batch after a rescale."""
+        if self._preemption is not None and self._preemption.requested:
+            # SIGTERM latched: checkpoint-and-exit. The save is the whole
+            # point — let a save failure propagate rather than exiting
+            # with unsaved work
+            path = self.engine.save_checkpoint(self._ckpt_dir)
+            self._preemption.acknowledge(self.engine.global_steps, path)
+            raise Preempted(
+                f"preempted: checkpointed at step "
+                f"{self.engine.global_steps}; exiting",
+                step=self.engine.global_steps, ckpt_path=path)
         probe_due = (self._probe_interval is not None
                      and self._steps_since_probe >= self._probe_interval)
         if probe_due:
@@ -166,6 +197,12 @@ class DSElasticAgent:
         for attempt in (0, 1):
             b = batch(self.batch_size) if callable(batch) else batch
             try:
+                inj = self._fault_injector()
+                if inj is not None:
+                    # the step seam: scheduled device faults surface here
+                    # exactly like a chip loss (a raised step); scheduled
+                    # preemptions deliver a real SIGTERM
+                    inj.step(self.engine.global_steps + 1)
                 metrics = self.engine.train_batch(b)
                 break
             except Exception as e:  # noqa: BLE001 - chip faults surface
@@ -194,9 +231,25 @@ class DSElasticAgent:
                 self._ensure_engine(probe=True)
                 if self.world != prev_world:
                     self.scale_events += 1  # fault-driven shrink counts too
+                rb_events.emit("fault_recovered", kind="device",
+                               step=self.engine.global_steps,
+                               prev_world=prev_world, world=self.world,
+                               error=str(e))
         self._steps_since_probe += 1
         if self.engine.global_steps % self._interval == 0:
-            self.engine.save_checkpoint(self._ckpt_dir)
+            try:
+                self.engine.save_checkpoint(self._ckpt_dir)
+            except OSError as e:
+                # a failed PERIODIC save must not kill training: the
+                # previous good tag still bounds the replay window, and
+                # the integrity chain guarantees the torn attempt is never
+                # loaded. Leave the failure on the telemetry stream.
+                self.ckpt_failures += 1
+                logger.warning("elastic agent: periodic checkpoint failed "
+                               f"({e}); continuing — previous good tag "
+                               "still bounds replay")
+                rb_events.emit("ckpt_save_failed",
+                               step=self.engine.global_steps, error=str(e))
         return metrics
 
     def save(self):

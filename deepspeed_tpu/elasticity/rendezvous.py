@@ -107,13 +107,19 @@ class FileRendezvous:
         # leader republish generation 0 over existing history
         gens = sorted(fn for fn in os.listdir(self.store)
                       if fn.startswith("gen_") and ".tmp." not in fn)
-        if not gens:
-            return None
-        try:
-            with open(os.path.join(self.store, gens[-1])) as f:
-                return json.load(f)
-        except (OSError, ValueError):  # pragma: no cover - torn write
-            return None
+        # a torn/unreadable NEWEST manifest must not erase history either:
+        # returning None there would let the leader republish generation 0
+        # over existing generations (and every follower's _seen_gen
+        # bookkeeping with it) — fall back to the next-newest readable one
+        for fn in reversed(gens):
+            try:
+                with open(os.path.join(self.store, fn)) as f:
+                    return json.load(f)
+            except (OSError, ValueError):  # torn write: try the previous
+                logger.warning(f"rendezvous: manifest {fn} unreadable; "
+                               "falling back to the previous generation")
+                continue
+        return None
 
     def is_leader(self) -> bool:
         live = self.live_hosts()

@@ -159,7 +159,11 @@ def main(argv=None) -> int:
     if node_rank is None:
         node_rank = int(os.environ.get(
             "PROCESS_ID", os.environ.get("NODE_RANK", 0)))
-    agent = LaunchAgent(cmd, a.world_info, node_rank)
+    # the SIGTERM->SIGKILL grace window is the user process' preemption
+    # budget: a PreemptionHandler-driven training loop has exactly this
+    # long to checkpoint-and-exit (README "Fault tolerance")
+    grace = float(os.environ.get("DSTPU_KILL_GRACE_S", 5.0))
+    agent = LaunchAgent(cmd, a.world_info, node_rank, kill_grace_s=grace)
     logger.info(f"launch agent: node {agent.env.get('PROCESS_ID', '?')}/"
                 f"{agent.env.get('NUM_PROCESSES', '?')} coordinator="
                 f"{agent.env.get('COORDINATOR_ADDRESS', '?')} "

@@ -7,6 +7,7 @@ next to the source.
 """
 
 import ctypes
+import errno
 import hashlib
 import os
 import subprocess
@@ -14,6 +15,8 @@ from typing import Optional
 
 import numpy as np
 
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.retry import retry_io
 from deepspeed_tpu.utils.logging import logger
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -96,22 +99,33 @@ class AIOHandle:
 
     def pwrite(self, path: str, array: np.ndarray, file_offset: int = 0,
                direct: bool = False) -> None:
+        # bounded retry: a transient EIO/EAGAIN from the ring is retried
+        # with backoff; the terminal error names file, offset and attempt
+        # count (robustness/retry.py) instead of an anonymous IOError
         arr = np.ascontiguousarray(array)
-        rc = self._lib.dstpu_aio_pwrite(
-            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            arr.nbytes, file_offset, int(direct))
-        if rc != 0:
-            raise IOError(f"aio pwrite failed: {path}")
+
+        def do():
+            rb_faults.io_seam("aio_write", path, file_offset)
+            rc = self._lib.dstpu_aio_pwrite(
+                self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                arr.nbytes, file_offset, int(direct))
+            if rc != 0:
+                raise OSError(errno.EIO, f"aio pwrite rc={rc}")
+        retry_io(do, what="aio pwrite", path=path, offset=file_offset)
 
     def pread(self, path: str, shape, dtype, file_offset: int = 0,
               direct: bool = False, out: Optional[np.ndarray] = None) -> np.ndarray:
         arr = out if out is not None else np.empty(shape, dtype)
-        rc = self._lib.dstpu_aio_pread(
-            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            arr.nbytes, file_offset, int(direct))
-        if rc != 0:
-            raise IOError(f"aio pread failed: {path}")
-        return arr
+
+        def do():
+            rb_faults.io_seam("aio_read", path, file_offset)
+            rc = self._lib.dstpu_aio_pread(
+                self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                arr.nbytes, file_offset, int(direct))
+            if rc != 0:
+                raise OSError(errno.EIO, f"aio pread rc={rc}")
+            return arr
+        return retry_io(do, what="aio pread", path=path, offset=file_offset)
 
     def close(self):
         # guard with getattr: when _load()/__init__ failed mid-init the

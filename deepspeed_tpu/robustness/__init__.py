@@ -1,0 +1,24 @@
+"""Fault-tolerance subsystem: deterministic fault injection, checkpoint
+integrity, bounded-retry I/O, graceful preemption.
+
+See README "Fault tolerance" for the config reference and the elastic
+preemption-recovery rung (`__graft_entry__.dryrun_multichip`).
+"""
+
+from deepspeed_tpu.robustness import events
+from deepspeed_tpu.robustness.faults import (FaultInjector, FaultSchedule,
+                                             active, clear, install,
+                                             install_from_config, io_seam,
+                                             mutate_seam)
+from deepspeed_tpu.robustness.integrity import (newest_valid_tag, prune_tags,
+                                                validate_tag, write_commit_marker,
+                                                write_manifest)
+from deepspeed_tpu.robustness.preemption import Preempted, PreemptionHandler
+from deepspeed_tpu.robustness.retry import retry_io
+
+__all__ = [
+    "FaultInjector", "FaultSchedule", "Preempted", "PreemptionHandler",
+    "active", "clear", "events", "install", "install_from_config", "io_seam",
+    "mutate_seam", "newest_valid_tag", "prune_tags", "retry_io",
+    "validate_tag", "write_commit_marker", "write_manifest",
+]

@@ -1,0 +1,62 @@
+"""Structured robustness events (``ckpt_fallback``, ``fault_recovered``, …).
+
+Reference analogue: none — the reference logs recovery prose and loses it in
+stdout. Here every recovery decision (a checkpoint fallback, a retried I/O
+op, a device-fault rebuild, a preemption save) becomes a structured record
+that rides the PR-3 telemetry stream: ``engine._log_step`` drains the
+pending queue into ``MonitorMaster.write_records`` (JSONL sink included) at
+the same window boundary as every other telemetry record, so fault handling
+is observable with ZERO added steady-state syncs.
+
+The module is deliberately leaf-level (stdlib only): ``runtime/
+checkpointing``, ``elasticity/elastic_agent`` and ``robustness/retry`` all
+emit through it without import cycles. ``history()`` keeps a bounded copy of
+everything ever emitted for tests and post-mortems, independent of whether a
+monitor drained it.
+"""
+
+import threading
+import time
+from typing import Any, Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+_LOCK = threading.Lock()
+_PENDING: List[Dict[str, Any]] = []
+_HISTORY: List[Dict[str, Any]] = []
+_MAX_HISTORY = 4096
+
+
+def emit(event_type: str, **fields) -> Dict[str, Any]:
+    """Record one robustness event. Returns the record (already queued)."""
+    rec = {"type": event_type, "ts": time.time(), **fields}
+    with _LOCK:
+        _PENDING.append(rec)
+        _HISTORY.append(rec)
+        del _HISTORY[:-_MAX_HISTORY]
+    logger.warning(f"robustness: {event_type} "
+                   + " ".join(f"{k}={v}" for k, v in fields.items()))
+    return rec
+
+
+def drain() -> List[Dict[str, Any]]:
+    """Pop every pending event (the engine's window-boundary drain)."""
+    with _LOCK:
+        out, _PENDING[:] = list(_PENDING), []
+    return out
+
+
+def history(event_type: str = None) -> List[Dict[str, Any]]:
+    """Everything emitted this process (drained or not), newest last."""
+    with _LOCK:
+        out = list(_HISTORY)
+    if event_type is not None:
+        out = [r for r in out if r["type"] == event_type]
+    return out
+
+
+def clear() -> None:
+    """Reset both queues (test isolation)."""
+    with _LOCK:
+        _PENDING[:] = []
+        _HISTORY[:] = []

@@ -1,0 +1,301 @@
+"""Deterministic, seedable fault injection at the runtime's existing seams.
+
+Reference analogue: none — the reference's elasticity (DSElasticAgent,
+``elasticity/elastic_agent.py:25``) is only ever exercised by real cluster
+failures. Here a ``FaultSchedule`` (config section ``robustness.faults``)
+drives a ``FaultInjector`` that fires *exactly reproducible* faults at the
+seams the production code already exposes:
+
+  step seam       — ``DSElasticAgent.train_batch`` calls ``step(n)`` before
+                    dispatching global step n; a ``device_fault`` raises
+                    there (a chip loss surfaces as a failed step) and arms
+                    the health-probe cull below
+  probe seam      — ``DSElasticAgent._healthy_devices`` passes the probed
+                    device list through ``cull``; an armed device fault
+                    hides ``survivors``.. devices for the next ``probes``
+                    consults (1 = a transient blip the rebuild out-waits,
+                    big = a permanent shrink)
+  I/O seams       — ``io_seam(category, path, offset)`` inside
+                    checkpointing / swap_tensor / infinity / aio raises
+                    scheduled ``OSError``s (EIO, ENOSPC, …); transient ones
+                    are absorbed by ``retry_io``, terminal ones exercise the
+                    caller's degradation path
+  commit seam     — a ``torn_save`` raises at the ``ckpt_commit`` seam:
+                    payload durable, COMMITTED never written — exactly the
+                    crash-between-write-and-commit shape
+  corrupt seam    — ``corrupt_payload`` truncates a manifest-listed file
+                    after the manifest is written (bitrot: committed but
+                    checksum-invalid)
+  preemption      — delivers a real SIGTERM to this process at step n,
+                    exercising the ``PreemptionHandler`` path end-to-end
+  clock           — ``make_clock(base)`` wraps the rendezvous' injectable
+                    clock with scheduled skew (a skewed host reads its peers
+                    as dead / itself as live: heartbeat loss without
+                    touching the store)
+
+Schedules are deterministic by construction: explicit entries fire at exact
+step/op indices, and the optional ``seed`` only feeds probabilistic rates
+through a private ``numpy`` Generator — same seed, same faults, every run.
+"""
+
+import errno as _errno
+import os
+import signal
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.robustness import events
+from deepspeed_tpu.utils.logging import logger
+
+_ERRNO_BY_NAME = {"EIO": _errno.EIO, "ENOSPC": _errno.ENOSPC,
+                  "EAGAIN": _errno.EAGAIN, "EBUSY": _errno.EBUSY,
+                  "ETIMEDOUT": _errno.ETIMEDOUT}
+
+KINDS = ("device_fault", "step_fault", "io_error", "torn_save",
+         "corrupt_payload", "preempt", "clock_skew")
+
+
+class FaultSchedule:
+    """Normalized list of fault entries + a seeded RNG for rate-based ones.
+
+    Entry keys (dicts, from config ``robustness.faults.entries``):
+      kind            one of KINDS (required)
+      step            1-based global optimizer step (step/device faults,
+                      preempt)
+      op              I/O seam category the fault targets (io_error;
+                      default matches any category)
+      at              0-based operation index within that category
+                      (io_error / torn_save / corrupt_payload; torn and
+                      corrupt count ``ckpt_commit`` seam hits, i.e. saves)
+      times           consecutive operations affected (io_error; default 1 —
+                      with retry attempts > times the fault is transient)
+      errno           symbolic ("EIO", "ENOSPC", …) or int (default EIO)
+      survivors       device count the armed cull reports (device_fault)
+      probes          health consults the cull stays armed for
+                      (device_fault; default 1 = transient blip)
+      skew_s / after  clock_skew: add skew_s seconds after `after` reads
+      rate            instead of step/at: per-opportunity probability drawn
+                      from the schedule seed (still deterministic)
+    """
+
+    def __init__(self, entries: Sequence[Dict[str, Any]] = (), seed: int = 0):
+        self.seed = int(seed)
+        self.entries: List[Dict[str, Any]] = []
+        for i, raw in enumerate(entries):
+            e = dict(raw)
+            kind = e.get("kind")
+            if kind not in KINDS:
+                raise ValueError(f"faults.entries[{i}]: unknown kind {kind!r}"
+                                 f" (choose from {KINDS})")
+            # an entry with no trigger would validate and then never fire —
+            # a chaos schedule that silently tests nothing
+            if kind in ("device_fault", "step_fault", "preempt") \
+                    and "step" not in e:
+                raise ValueError(f"faults.entries[{i}] ({kind}): needs "
+                                 "'step' (1-based global step)")
+            if kind in ("io_error", "torn_save", "corrupt_payload") \
+                    and "at" not in e and "rate" not in e:
+                raise ValueError(f"faults.entries[{i}] ({kind}): needs 'at' "
+                                 "(0-based op index) or 'rate'")
+            err = e.get("errno", "EIO")
+            e["errno"] = _ERRNO_BY_NAME.get(err, err) if isinstance(err, str) \
+                else int(err)
+            e.setdefault("times", 1)
+            self.entries.append(e)
+
+    @classmethod
+    def from_config(cls, cfg) -> "FaultSchedule":
+        """cfg: a FaultsConfig (config section ``robustness.faults``)."""
+        return cls(entries=cfg.entries, seed=cfg.seed)
+
+
+class FaultInjector:
+    """Executes a FaultSchedule against the instrumented seams. Counters and
+    the fired-fault log make every run's fault sequence auditable."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self.counters: Dict[str, int] = {}
+        self.fired: List[Dict[str, Any]] = []
+        self._armed_culls: List[Dict[str, Any]] = []
+        self._rng = np.random.default_rng(schedule.seed)
+
+    # -- bookkeeping ---------------------------------------------------
+    def _fire(self, entry: Dict[str, Any], seam: str, **ctx):
+        rec = {"kind": entry["kind"], "seam": seam, **ctx}
+        self.fired.append(rec)
+        events.emit("fault_injected", **rec)
+
+    def _count(self, category: str) -> int:
+        n = self.counters.get(category, 0)
+        self.counters[category] = n + 1
+        return n
+
+    def _matches_index(self, e: Dict[str, Any], idx: int) -> bool:
+        if "at" in e:
+            return e["at"] <= idx < e["at"] + e["times"]
+        rate = e.get("rate")
+        return rate is not None and self._rng.random() < rate
+
+    # -- step seam (elastic agent) -------------------------------------
+    def step(self, global_step: int) -> None:
+        """Called with the 1-based step about to be dispatched. Raises for
+        scheduled device/step faults; delivers scheduled preemptions."""
+        for e in self.schedule.entries:
+            if e.get("step") != global_step or e.get("_done"):
+                continue
+            if e["kind"] == "preempt":
+                e["_done"] = True
+                self._fire(e, "step", step=global_step,
+                           signal="SIGTERM")
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif e["kind"] in ("device_fault", "step_fault"):
+                e["_done"] = True
+                if e["kind"] == "device_fault":
+                    self._armed_culls.append({
+                        "survivors": int(e.get("survivors", 0)),
+                        "probes": int(e.get("probes", 1))})
+                self._fire(e, "step", step=global_step)
+                raise RuntimeError(
+                    f"injected {e['kind']} at step {global_step} "
+                    "(robustness.faults)")
+
+    # -- probe seam (elastic agent health checks) ----------------------
+    def cull(self, devices: List) -> List:
+        """While a device fault is armed, hide the dead devices from the
+        health probe for the configured number of consults."""
+        if not self._armed_culls:
+            return devices
+        armed = self._armed_culls[0]
+        armed["probes"] -= 1
+        if armed["probes"] <= 0:
+            self._armed_culls.pop(0)
+        n = armed["survivors"]
+        return list(devices)[:n] if n < len(devices) else list(devices)
+
+    # -- I/O seams ------------------------------------------------------
+    def op(self, category: str, path: Optional[str] = None,
+           offset: Optional[int] = None) -> None:
+        idx = self._count(category)
+        for e in self.schedule.entries:
+            if e["kind"] == "io_error" and e.get("op", category) == category \
+                    and self._matches_index(e, idx):
+                self._fire(e, category, path=path, offset=offset, index=idx)
+                raise OSError(e["errno"],
+                              f"injected io_error ({category}) "
+                              "(robustness.faults)")
+            if e["kind"] == "torn_save" and category == "ckpt_commit" \
+                    and self._matches_index(e, idx):
+                self._fire(e, category, path=path, index=idx)
+                raise OSError(_errno.EIO,
+                              "injected torn save: crash before commit "
+                              "marker (robustness.faults)")
+
+    def mutate_tag(self, tag_dir: str) -> None:
+        """corrupt_payload seam: truncate the largest manifest-listed file
+        of the `at`-th committed save (fires after the manifest, before the
+        commit marker — a committed-but-bitrotten tag)."""
+        idx = self._count("ckpt_mutate")
+        for e in self.schedule.entries:
+            if e["kind"] != "corrupt_payload" or not self._matches_index(e, idx):
+                continue
+            victims = []
+            for root, _d, files in os.walk(tag_dir):
+                for fn in files:
+                    if fn in ("manifest.json", "COMMITTED"):
+                        continue
+                    p = os.path.join(root, fn)
+                    victims.append((os.path.getsize(p), p))
+            if not victims:
+                continue
+            _, victim = max(victims)
+            keep = max(0, os.path.getsize(victim) // 2)
+            with open(victim, "r+b") as f:
+                f.truncate(keep)
+            self._fire(e, "ckpt_mutate", path=victim, index=idx,
+                       truncated_to=keep)
+
+    # -- clock seam (rendezvous) ---------------------------------------
+    def make_clock(self, base=None):
+        """Wrap a clock with scheduled skew: after `after` reads, add
+        ``skew_s`` seconds — the file-rendezvous sees heartbeats age out
+        (host death / heartbeat loss) without any store mutation."""
+        import time as _time
+        base = base or _time.time
+        skews = [dict(e) for e in self.schedule.entries
+                 if e["kind"] == "clock_skew"]
+        state = {"reads": 0}
+
+        def clock() -> float:
+            t = base()
+            state["reads"] += 1
+            for e in skews:
+                if state["reads"] > e.get("after", 0):
+                    if not e.get("_seen"):
+                        e["_seen"] = True
+                        self._fire(e, "clock", reads=state["reads"])
+                    t += float(e.get("skew_s", 0.0))
+            return t
+        return clock
+
+
+# -- global install (the seams consult this) ----------------------------
+# The injector is PROCESS-global by design: an elastic rebuild constructs a
+# fresh engine mid-run and must keep the schedule's counters. Consequence:
+# a later engine with `robustness.faults.enabled: false` does NOT disarm an
+# already-armed injector — call faults.clear() to stop injecting.
+_ACTIVE: Optional[FaultInjector] = None
+_ACTIVE_CFG_KEY: Optional[str] = None  # set only for config-armed injectors
+
+
+def install(injector: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    global _ACTIVE, _ACTIVE_CFG_KEY
+    _ACTIVE = injector
+    _ACTIVE_CFG_KEY = None
+    return injector
+
+
+def install_from_config(faults_cfg) -> Optional[FaultInjector]:
+    """Engine-init hook: build + install from ``robustness.faults``. A
+    rebuild with the SAME schedule keeps the live injector (counters
+    survive the rescale); a DIFFERENT schedule replaces it; a manually
+    install()ed injector (test harness) is never replaced."""
+    global _ACTIVE_CFG_KEY
+    if not getattr(faults_cfg, "enabled", False):
+        return _ACTIVE
+    import json as _json
+    key = _json.dumps({"seed": faults_cfg.seed,
+                       "entries": faults_cfg.entries},
+                      sort_keys=True, default=str)
+    if _ACTIVE is None or (_ACTIVE_CFG_KEY is not None
+                           and _ACTIVE_CFG_KEY != key):
+        if _ACTIVE is not None:
+            logger.warning("robustness: replacing the active fault "
+                           "injector — the config schedule changed")
+        logger.warning("robustness: fault injection ENABLED "
+                       f"({len(faults_cfg.entries)} scheduled entries, "
+                       f"seed={faults_cfg.seed})")
+        install(FaultInjector(FaultSchedule.from_config(faults_cfg)))
+        _ACTIVE_CFG_KEY = key
+    return _ACTIVE
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def clear() -> None:
+    install(None)
+
+
+def io_seam(category: str, path: Optional[str] = None,
+            offset: Optional[int] = None) -> None:
+    """Production-code hook: a no-op unless an injector is installed."""
+    if _ACTIVE is not None:
+        _ACTIVE.op(category, path, offset)
+
+
+def mutate_seam(tag_dir: str) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.mutate_tag(tag_dir)

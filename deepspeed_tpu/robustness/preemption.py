@@ -1,0 +1,100 @@
+"""Graceful preemption: SIGTERM -> checkpoint-and-exit.
+
+Reference: ``launcher/launch.py:103`` kills the process tree on SIGTERM;
+our ``LaunchAgent`` already forwards the signal to the user process group
+and waits out a grace period before SIGKILL. This module is the *user
+process* half of that contract: a ``PreemptionHandler`` latches the signal
+into a flag (handlers must not checkpoint from signal context — Orbax and
+JAX are not reentrant), and the training driver (``DSElasticAgent.
+train_batch``, or any custom loop polling ``requested``) saves a final
+checkpoint at the next step boundary and raises ``Preempted``. The launch
+agent's grace window (``--kill_grace_s`` / ``DSTPU_KILL_GRACE_S``) is
+exactly the budget for that save.
+
+A preempted run resumes like any other elastic resume: rebuild the engine,
+``load_checkpoint(tag=None)`` — the preemption save is the newest valid
+tag in the integrity chain, so nothing is replayed.
+"""
+
+import signal
+from typing import Dict, Optional, Sequence
+
+from deepspeed_tpu.robustness import events
+from deepspeed_tpu.utils.logging import logger
+
+
+class Preempted(RuntimeError):
+    """Raised by the training driver after the preemption checkpoint is
+    durable — the caller should exit cleanly (rc 0: the work is saved)."""
+
+    def __init__(self, message: str, step: int = -1, ckpt_path: Optional[str] = None):
+        super().__init__(message)
+        self.step = step
+        self.ckpt_path = ckpt_path
+
+
+class PreemptionHandler:
+    """Latches SIGTERM (and any extra signals) into a poll-able flag.
+
+    Usage::
+
+        handler = PreemptionHandler().install()
+        agent = DSElasticAgent(..., preemption=handler)
+        try:
+            while ...:
+                agent.train_batch(batch_fn)
+        except Preempted:
+            sys.exit(0)   # checkpointed; the launch agent reaps us
+
+    ``install``/``restore`` save and put back the previous handlers, so the
+    launch agent's own forwarding (parent process) is never disturbed —
+    each process owns its handlers.
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self.received: Optional[int] = None
+        self._requested = False
+        self._prev: Dict[int, object] = {}
+        self._installed = False
+
+    def _on_signal(self, signum, _frame):
+        # signal context: latch the flag only — no I/O, no JAX
+        self._requested = True
+        self.received = signum
+
+    def install(self) -> "PreemptionHandler":
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+        self._installed = True
+        return self
+
+    def restore(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def reset(self) -> None:
+        """Clear the latch (after the preemption was handled; a resumed
+        in-process driver reuses the handler)."""
+        self._requested = False
+        self.received = None
+
+    def acknowledge(self, step: int, ckpt_path: Optional[str] = None) -> None:
+        """Record that the checkpoint-and-exit contract was honored."""
+        logger.warning(f"preemption: checkpointed at step {step}; exiting")
+        events.emit("preempted", step=step, signal=self.received,
+                    ckpt_path=ckpt_path)
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.restore()

@@ -1,0 +1,76 @@
+"""Bounded retry-with-backoff for host I/O.
+
+Before this module a single transient ``OSError`` from an NVMe/AIO
+read/write (a gcsfuse hiccup, an EIO under memory pressure, a full disk
+racing the retention pruner) was fatal AND anonymous — the traceback named
+neither the file nor the offset nor how often it had worked before. Every
+host-I/O call in ``runtime/swap_tensor.py``, ``runtime/infinity.py``,
+``ops/aio.py`` and ``runtime/checkpointing.py`` now goes through
+``retry_io``: transient faults are retried with exponential backoff, a
+recovery is a structured ``fault_recovered`` event on the telemetry stream,
+and the *terminal* error names the operation, file, offset and attempt
+count.
+"""
+
+import errno as _errno
+import time
+from typing import Callable, Optional, Tuple
+
+from deepspeed_tpu.robustness import events
+from deepspeed_tpu.utils.logging import logger
+
+# errnos worth retrying: transient media/transport errors. ENOSPC is NOT
+# retried by default — a full disk rarely un-fills within the backoff
+# budget, and the caller (checkpoint save, swap writeback) has a better
+# fallback (skip the save, keep the previous good tag).
+TRANSIENT_ERRNOS = frozenset({
+    _errno.EIO, _errno.EAGAIN, _errno.EINTR, _errno.EBUSY, _errno.ETIMEDOUT,
+})
+
+
+def _is_transient(err: BaseException) -> bool:
+    if not isinstance(err, OSError):
+        return False
+    # an OSError with no errno (e.g. raised by hand, or IOError("msg"))
+    # is treated as transient: the native AIO binding reports failures
+    # without errno and those are exactly the calls this helper guards
+    return err.errno is None or err.errno in TRANSIENT_ERRNOS
+
+
+def retry_io(fn: Callable, *, what: str, path: str,
+             offset: Optional[int] = None, attempts: int = 4,
+             backoff_s: float = 0.05, sleep: Callable[[float], None] = None,
+             retriable: Tuple = (OSError,)):
+    """Run ``fn()`` with up to ``attempts`` tries.
+
+    Retries only *transient* ``OSError``s (see ``TRANSIENT_ERRNOS``);
+    anything else — ENOSPC, EACCES, a ``ValueError`` — propagates
+    immediately. On success after >= 1 failure a ``fault_recovered`` event
+    is emitted. The terminal error is an ``OSError`` naming ``what``,
+    ``path``, ``offset`` and the attempt count, chained from the last
+    underlying failure.
+    """
+    sleep = sleep or time.sleep
+    where = path if offset is None else f"{path}@{offset}"
+    last = None
+    for attempt in range(1, max(1, attempts) + 1):
+        try:
+            result = fn()
+        except retriable as e:
+            if not _is_transient(e):
+                raise
+            last = e
+            if attempt >= attempts:
+                break
+            logger.warning(f"{what}: transient {e!r} on {where} "
+                           f"(attempt {attempt}/{attempts}); retrying")
+            sleep(backoff_s * (2 ** (attempt - 1)))
+            continue
+        if attempt > 1:
+            events.emit("fault_recovered", kind="io", what=what, path=path,
+                        offset=offset, attempts=attempt)
+        return result
+    raise OSError(
+        getattr(last, "errno", None) or _errno.EIO,
+        f"{what} failed after {attempts} attempts on {where}: {last}"
+    ) from last

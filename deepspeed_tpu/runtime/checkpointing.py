@@ -16,14 +16,27 @@ preserved: <dir>/<tag>/..., a `latest` file, and a client_state payload.
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
 import numpy as np
 
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness import integrity
+from deepspeed_tpu.robustness.retry import retry_io
 from deepspeed_tpu.utils.logging import logger
 
 LATEST_FILE = "latest"
+
+
+def _write_small(path: str, data: str, what: str) -> None:
+    """Atomic small-file write with bounded retry + the `ckpt_io` fault
+    seam — one shared implementation (integrity.atomic_write) covers the
+    pointer/meta/latest writers here AND the manifest/marker writers in
+    robustness/integrity.py, so a transient EIO is survivable on every
+    metadata file of a save, not just some of them."""
+    integrity.atomic_write(path, data, what=what)
 
 
 def _pointer_file(path: str) -> str:
@@ -42,13 +55,8 @@ def _read_pointer(path: str) -> Optional[str]:
 
 def _write_pointer(path: str, version_name: str) -> None:
     """Atomically publish version_name as the live version of `path`."""
-    ptr = _pointer_file(path)
-    tmp = f"{ptr}.tmp-{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(version_name)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, ptr)
+    _write_small(_pointer_file(path), version_name,
+                 "checkpoint pointer publish")
 
 
 def _resolve_pointer(path: str) -> str:
@@ -147,16 +155,63 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return self._ckptr.restore(path)
 
 
+def finalize_tag(save_dir: str, tag: str, *, save_latest: bool = True,
+                 write_integrity: bool = True, checksums: bool = True,
+                 keep_last_k: int = 0) -> None:
+    """The integrity tail of every save: manifest -> COMMITTED -> latest ->
+    retention, in that order. Shared by the Orbax path (inside finalize)
+    and the infinity path (which writes its own payload files).
+
+    The commit marker is written LAST among the tag's own files — its
+    absence is the torn-save signal ``validate_tag`` keys on. `latest` is
+    only a hint after this chain: a reader validates the tag it names and
+    walks back when it lies."""
+    ckpt_path = os.path.join(save_dir, str(tag))
+    if write_integrity:
+        integrity.write_manifest(ckpt_path, checksums=checksums)
+        # corrupt_payload fault seam: bitrot AFTER the manifest hash
+        rb_faults.mutate_seam(ckpt_path)
+        # torn_save fault seam: "crash" between payload and commit marker.
+        # Deliberately OUTSIDE any retry — a torn save is a process death,
+        # not a transient error.
+        rb_faults.io_seam("ckpt_commit", ckpt_path)
+        integrity.write_commit_marker(ckpt_path)
+    if save_latest:
+        _write_small(os.path.join(save_dir, LATEST_FILE), str(tag),
+                     "checkpoint latest publish")
+    if keep_last_k:
+        # never prune the tag `latest` names — with save_latest=False the
+        # pointer may still name an OLDER tag than the one just saved
+        protect = {str(tag)}
+        try:
+            with open(os.path.join(save_dir, LATEST_FILE)) as f:
+                protect.add(f.read().strip())
+        except OSError:
+            pass
+        integrity.prune_tags(save_dir, keep_last_k, protect=protect)
+    logger.info(f"saved checkpoint {ckpt_path}")
+
+
 def save_checkpoint(save_dir: str, tag: str, state, *,
                     client_state: Optional[Dict[str, Any]] = None,
                     config_dict: Optional[Dict[str, Any]] = None,
                     engine: Optional[CheckpointEngine] = None,
-                    save_latest: bool = True) -> str:
+                    save_latest: bool = True, write_integrity: bool = True,
+                    checksums: bool = True, keep_last_k: int = 0) -> str:
     """DeepSpeed directory contract: save_dir/tag/{state,meta.json}; plus
-    save_dir/latest containing the tag."""
+    save_dir/latest containing the tag, a content manifest, and an atomic
+    COMMITTED marker written last (robustness/integrity.py)."""
     engine = engine or OrbaxCheckpointEngine()
     ckpt_path = os.path.join(save_dir, str(tag))
     os.makedirs(save_dir, exist_ok=True)
+    rb_faults.io_seam("ckpt_save", ckpt_path)  # whole-save abort seam
+    if os.path.isdir(ckpt_path):
+        # overwriting a tag in place: drop its commit marker first so a
+        # crash mid-overwrite reads as torn, never as the OLD save's
+        # marker vouching for MIXED content. When THIS save won't write a
+        # manifest, drop the stale one too — otherwise the finished save
+        # would validate as uncommitted forever.
+        integrity.invalidate(ckpt_path, drop_manifest=not write_integrity)
 
     def finalize():
         # runs only after the state dir is durable (possibly async)
@@ -167,35 +222,101 @@ def save_checkpoint(save_dir: str, tag: str, state, *,
             "world_size": jax.device_count(),
             "framework_version": "deepspeed_tpu-0.1",
         }
-        with open(os.path.join(ckpt_path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-        logger.info(f"saved checkpoint {ckpt_path}")
+        _write_small(os.path.join(ckpt_path, "meta.json"),
+                     json.dumps(meta, indent=2, default=str),
+                     "checkpoint meta write")
+        finalize_tag(save_dir, tag, save_latest=save_latest,
+                     write_integrity=write_integrity, checksums=checksums,
+                     keep_last_k=keep_last_k)
 
     engine.save(state, os.path.join(ckpt_path, "state"), on_complete=finalize)
     return ckpt_path
+
+
+def resolve_load_tag(load_dir: str, tag: Optional[str] = None, *,
+                     exclude: Iterable[str] = (),
+                     deep: bool = True) -> Tuple[str, bool]:
+    """Resolve which tag to load. Returns (tag, fell_back).
+
+    An explicit tag is honored verbatim (the caller asked for exactly that
+    save). tag=None resolves `latest`, validates it against the integrity
+    chain, and on a torn/corrupt/uncommitted/missing target walks back to
+    the newest tag that still validates — emitting a ``ckpt_fallback``
+    event — instead of raising. Raises FileNotFoundError only when nothing
+    under load_dir is loadable."""
+    if tag is not None:
+        return str(tag), False
+    latest = os.path.join(load_dir, LATEST_FILE)
+    requested = None
+    if os.path.exists(latest):
+        with open(latest) as f:
+            requested = f.read().strip()
+    if requested is not None and requested not in set(exclude):
+        ok, reason = integrity.validate_tag(
+            os.path.join(load_dir, requested), deep=deep)
+        if ok:
+            return requested, False
+    elif requested is None:
+        reason = f"no '{LATEST_FILE}' file"
+    else:
+        reason = "load failed"
+    fallback = integrity.newest_valid_tag(
+        load_dir, exclude=set(exclude) | ({requested} if requested else set()),
+        deep=deep)
+    if fallback is None:
+        raise FileNotFoundError(
+            f"no valid checkpoint under {load_dir} "
+            f"(latest={requested!r}: {reason})")
+    logger.warning(f"checkpoint fallback: latest={requested!r} is not "
+                   f"loadable ({reason}); falling back to newest valid "
+                   f"tag '{fallback}'")
+    rb_events.emit("ckpt_fallback", dir=load_dir, requested=requested,
+                   resolved=fallback, reason=reason)
+    return fallback, True
 
 
 def load_checkpoint(load_dir: str, tag: Optional[str] = None, *,
                     template=None, shardings=None,
                     engine: Optional[CheckpointEngine] = None):
     """Returns (state, client_state). tag=None reads the `latest` file
-    (reference: load_checkpoint:2512 latest resolution)."""
+    (reference: load_checkpoint:2512 latest resolution), validates it
+    against the integrity chain, and walks back to the newest valid tag
+    when `latest` points at a torn/corrupt/uncommitted save."""
     engine = engine or OrbaxCheckpointEngine()
-    if tag is None:
-        latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest):
-            raise FileNotFoundError(f"no '{LATEST_FILE}' file under {load_dir}")
-        with open(latest) as f:
-            tag = f.read().strip()
-    ckpt_path = os.path.join(load_dir, str(tag))
-    state = engine.load(os.path.join(ckpt_path, "state"), template, shardings)
-    meta_path = os.path.join(ckpt_path, "meta.json")
-    client_state = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            client_state = json.load(f).get("client_state", {})
-    logger.info(f"loaded checkpoint {ckpt_path}")
-    return state, client_state
+
+    def load_one(t: str):
+        ckpt_path = os.path.join(load_dir, str(t))
+        state = engine.load(os.path.join(ckpt_path, "state"), template,
+                            shardings)
+        meta_path = os.path.join(ckpt_path, "meta.json")
+        client_state = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                client_state = json.load(f).get("client_state", {})
+        logger.info(f"loaded checkpoint {ckpt_path}")
+        return state, client_state
+
+    if tag is not None:
+        return load_one(tag)
+    # tag=None: resolve + validate; if a validated tag STILL fails to load
+    # (validation was shallow, or the payload format itself is bad) keep
+    # walking back rather than bricking the resume path
+    tried = set()
+    last_err = None
+    while True:
+        try:
+            resolved, _fell_back = resolve_load_tag(load_dir, None,
+                                                    exclude=tried)
+        except FileNotFoundError:
+            if last_err is not None:
+                raise last_err
+            raise
+        try:
+            return load_one(resolved)
+        except Exception as e:  # noqa: BLE001 - any load failure walks back
+            tried.add(resolved)
+            last_err = e
+            logger.warning(f"checkpoint tag '{resolved}' validated but "
+                           f"failed to load ({e!r}); walking back")
+            rb_events.emit("ckpt_fallback", dir=load_dir, requested=resolved,
+                           resolved=None, reason=f"load-error: {e}")

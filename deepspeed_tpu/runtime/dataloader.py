@@ -32,6 +32,8 @@ class DataLoader:
                              "when a sampler is given")
         self.sampler = sampler  # e.g. data_pipeline.DistributedSampler
         self.epoch = 0
+        self._pos = 0          # batches yielded this epoch (ckpt position)
+        self._resume_pos = 0   # batches to skip on the next __iter__
 
     def __len__(self):
         total = (len(self.sampler) if self.sampler is not None
@@ -43,6 +45,23 @@ class DataLoader:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        self._pos = 0
+
+    # -- checkpointable position (robustness: elastic resume must neither
+    # replay nor skip data). The order within an epoch is a pure function
+    # of (seed, epoch), so (epoch, pos, seed) fully names the position.
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "pos": self._pos, "seed": self.seed}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.seed = int(sd.get("seed", self.seed))
+        self.set_epoch(int(sd.get("epoch", 0)))
+        # fast-forward happens lazily at the next __iter__: the shuffle
+        # order is regenerated from (seed, epoch) and `pos` batches are
+        # skipped, so the next yielded batch is exactly the first one the
+        # saved run had not consumed
+        self._resume_pos = int(sd.get("pos", 0))
+        self._pos = self._resume_pos
 
     def __iter__(self) -> Iterator:
         if self.sampler is not None:
@@ -56,10 +75,16 @@ class DataLoader:
             if self.shuffle:
                 rng = np.random.default_rng(self.seed + self.epoch)
                 rng.shuffle(order)
-        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
-                           self.batch_size):
+        skip, self._resume_pos = self._resume_pos, 0
+        self._pos = skip
+        starts = range(0, n - (self.batch_size - 1 if self.drop_last else 0),
+                       self.batch_size)
+        for bi, start in enumerate(starts):
+            if bi < skip:
+                continue
             idx = order[start:start + self.batch_size]
             rows = [self.dataset[int(i)] for i in idx]
+            self._pos = bi + 1
             yield self.collate_fn(rows)
 
 
@@ -151,6 +176,17 @@ class RepeatingLoader:
                 self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
             self._it = iter(self.loader)
             return next(self._it)
+
+    # position checkpointing proxies (engine.attach_dataloader works with
+    # either the bare DataLoader or this wrapper)
+    def state_dict(self) -> dict:
+        return self.loader.state_dict()
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.loader.load_state_dict(sd)
+        # drop the live iterator: it was positioned for the OLD state, and
+        # DataLoader's lazy fast-forward applies at the next iter()
+        self._it = iter(self.loader)
 
 
 def _default_collate(rows):

@@ -123,6 +123,9 @@ def initialize(args=None, model=None, config=None, config_params=None,
         # train_batch() consumes GLOBAL batches (train_batch_size rows)
         dataloader = DataLoader(training_data,
                                 batch_size=engine.config.train_batch_size)
+        # checkpoints carry the loader's position (epoch/batch/seed) so an
+        # elastic resume neither replays nor skips data
+        engine.attach_dataloader(dataloader)
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 
@@ -564,6 +567,15 @@ class Engine:
         self._last_grad_norm = None
         self._last_log_window = 0
         self.micro_steps = 0
+        # --- robustness (deepspeed_tpu/robustness): deterministic fault
+        # injection armed from config; the injector is PROCESS-global so an
+        # elastic rebuild mid-run keeps the schedule's counters
+        self._dataloader = None  # attach_dataloader: data position in ckpts
+        self.fault_injector = None
+        if config.robustness.faults.enabled:
+            from deepspeed_tpu.robustness import faults as rb_faults
+            self.fault_injector = rb_faults.install_from_config(
+                config.robustness.faults)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size,
@@ -1969,6 +1981,12 @@ class Engine:
             # CommsLogger totals reach the monitor as comm/* events instead
             # of log-only text (trace-time counts/bytes + host_ms)
             events += comms_logger.events(self.global_steps)
+        # robustness events (ckpt_fallback / fault_recovered / preempted /
+        # fault_injected) ride the same window-boundary record stream
+        from deepspeed_tpu.robustness import events as rb_events
+        for rec in rb_events.drain():
+            rec.setdefault("step", self.global_steps)
+            records.append(rec)
         if self.monitor is not None and self.monitor.enabled:
             self.monitor.write_events(events)  # one batched write
             if records:
@@ -2238,6 +2256,34 @@ class Engine:
     # ------------------------------------------------------------------
     # checkpointing (reference: save_checkpoint:2817 / load_checkpoint:2512)
     # ------------------------------------------------------------------
+    def attach_dataloader(self, loader) -> None:
+        """Register the training loader so checkpoints carry its position
+        (epoch, batch-in-epoch, seed) and an elastic resume neither replays
+        nor skips data. Any object with state_dict/load_state_dict works
+        (DataLoader and RepeatingLoader both do)."""
+        self._dataloader = loader
+
+    def _rng_key_data(self):
+        """Host uint32 view of the engine rng chain (typed or legacy key)."""
+        key = self._rng
+        try:
+            key = jax.random.key_data(key)
+        except Exception:  # noqa: BLE001 - already a legacy uint32 key
+            pass
+        return np.asarray(jax.device_get(key))
+
+    def _restore_rng(self, key_data) -> None:
+        arr = np.asarray(key_data, dtype=np.uint32)
+        try:
+            if jnp.issubdtype(self._rng.dtype, jax.dtypes.prng_key):
+                impl = jax.random.key_impl(self._rng)
+                self._rng = jax.random.wrap_key_data(jnp.asarray(arr),
+                                                     impl=impl)
+                return
+        except Exception:  # noqa: BLE001 - legacy raw-key path below
+            pass
+        self._rng = jnp.asarray(arr)
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
                         save_latest: bool = True) -> str:
@@ -2247,7 +2293,14 @@ class Engine:
             "global_steps": self.global_steps,
             "skipped_steps": self.skipped_steps,
             "micro_steps": self.micro_steps,
+            # the rng split chain: restoring it makes replayed steps after a
+            # fault recovery bit-identical to the uninterrupted run
+            "rng_key": self._rng_key_data().tolist(),
         })
+        if self._dataloader is not None and \
+                hasattr(self._dataloader, "state_dict"):
+            client_state.setdefault("data_position",
+                                    self._dataloader.state_dict())
         if self._infinity:
             return self._save_infinity_checkpoint(save_dir, tag, client_state,
                                                   save_latest)
@@ -2256,18 +2309,22 @@ class Engine:
             if self._ckpt_engine is None:
                 self._ckpt_engine = ckpt_mod.OrbaxCheckpointEngine(async_save=True)
             engine = self._ckpt_engine  # .save() finalizes any in-flight save
-        path = ckpt_mod.save_checkpoint(
-            save_dir, tag, self.state, client_state=client_state,
-            config_dict=self.config.to_dict(), save_latest=save_latest,
-            engine=engine)
+        ck = self.config.checkpoint
         if self._nvme_opt:
             # fp32 optimizer chunks live on NVMe, not in self.state — persist
             # them alongside the Orbax state (reference: optimizer swap files
-            # are re-read into the checkpoint, optimizer_utils.py)
+            # are re-read into the checkpoint, optimizer_utils.py). Written
+            # BEFORE the save finalizes so the integrity manifest covers
+            # them: a truncated optswap.npz must fail validation too.
+            path = os.path.join(save_dir, str(tag))
             os.makedirs(path, exist_ok=True)
             np.savez(os.path.join(path, "optswap.npz"),
                      **self._swapper.export_state())
-        return path
+        return ckpt_mod.save_checkpoint(
+            save_dir, tag, self.state, client_state=client_state,
+            config_dict=self.config.to_dict(), save_latest=save_latest,
+            engine=engine, write_integrity=ck.integrity,
+            checksums=ck.integrity_checksums, keep_last_k=ck.keep_last_k)
 
     def wait_checkpoint(self):
         """Block until an in-flight async checkpoint is durable (and its
@@ -2279,6 +2336,46 @@ class Engine:
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
         self.wait_checkpoint()
+        if tag is not None:
+            # an explicit tag is honored verbatim — the caller asked for
+            # exactly that save, so a failure there must surface
+            return self._load_resolved(load_dir, str(tag),
+                                       load_optimizer_states,
+                                       load_lr_scheduler_states)
+        # tag=None: resolve + integrity-validate, then load; if a VALIDATED
+        # tag still fails to load (shallow validation with checksums off, a
+        # payload-format error), keep walking back — the elastic rebuild
+        # must land on SOME loadable save while one exists
+        tried = set()
+        last_err = None
+        while True:
+            try:
+                resolved, _fell_back = ckpt_mod.resolve_load_tag(
+                    load_dir, exclude=tried)
+            except FileNotFoundError:
+                if last_err is not None:
+                    raise last_err
+                raise
+            try:
+                return self._load_resolved(load_dir, resolved,
+                                           load_optimizer_states,
+                                           load_lr_scheduler_states)
+            except Exception as e:  # noqa: BLE001 - walk back on any failure
+                tried.add(resolved)
+                last_err = e
+                logger.warning(f"checkpoint tag '{resolved}' validated but "
+                               f"failed to load ({e!r}); walking back")
+                from deepspeed_tpu.robustness import events as rb_events
+                rb_events.emit("ckpt_fallback", dir=load_dir,
+                               requested=resolved, resolved=None,
+                               reason=f"load-error: {e}")
+
+    def _load_resolved(self, load_dir: str, tag: str,
+                       load_optimizer_states: bool,
+                       load_lr_scheduler_states: bool):
+        """Load one specific, already-resolved tag. Every sub-path (Orbax
+        state, optional-leaf retries, optswap.npz, infinity) reads the SAME
+        tag; the walk-back policy lives in load_checkpoint above."""
         if self._infinity:
             return self._load_infinity_checkpoint(load_dir, tag)
         try:
@@ -2329,17 +2426,18 @@ class Engine:
         if self._offload_opt:
             state["opt"] = self._opt_to_host(state["opt"])
         if self._nvme_opt and load_optimizer_states:
-            resolved = tag
-            if resolved is None:
-                with open(os.path.join(load_dir, ckpt_mod.LATEST_FILE)) as f:
-                    resolved = f.read().strip()
-            swap_file = os.path.join(load_dir, str(resolved), "optswap.npz")
+            swap_file = os.path.join(load_dir, str(tag), "optswap.npz")
             with np.load(swap_file) as z:
                 self._swapper.import_state({k: z[k] for k in z.files})
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
+        if "rng_key" in client_state:
+            self._restore_rng(client_state["rng_key"])
+        if self._dataloader is not None and "data_position" in client_state \
+                and hasattr(self._dataloader, "load_state_dict"):
+            self._dataloader.load_state_dict(client_state["data_position"])
         # restored cumulative telemetry counters: restart the window diff
         # baseline so the first post-restore window isn't a cross-run delta
         self._tel_prev = None
@@ -2360,6 +2458,8 @@ class Engine:
         manifest (the same bf16-as-uint16 scheme as save_16bit_model)."""
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
+        from deepspeed_tpu.robustness import integrity as rb_integrity
+        rb_integrity.invalidate(path)  # in-place overwrite reads as torn
         small = self._infinity_exec.save_checkpoint(path)
         client_state["applied_steps"] = small.pop("applied_steps")
         if "loss_scale" in small:
@@ -2376,17 +2476,18 @@ class Engine:
         np.savez(os.path.join(path, "infinity_small.npz"), **arrays)
         with open(os.path.join(path, "infinity_meta.json"), "w") as f:
             json.dump({"dtypes": dtypes, "client_state": client_state}, f)
-        if save_latest:
-            with open(os.path.join(save_dir, ckpt_mod.LATEST_FILE), "w") as f:
-                f.write(str(tag))
+        ck = self.config.checkpoint
+        ckpt_mod.finalize_tag(save_dir, tag, save_latest=save_latest,
+                              write_integrity=ck.integrity,
+                              checksums=ck.integrity_checksums,
+                              keep_last_k=ck.keep_last_k)
         logger.info(f"saved infinity checkpoint {path}")
         return path
 
     def _load_infinity_checkpoint(self, load_dir, tag):
         import ml_dtypes
         if tag is None:
-            with open(os.path.join(load_dir, ckpt_mod.LATEST_FILE)) as f:
-                tag = f.read().strip()
+            tag, _fell_back = ckpt_mod.resolve_load_tag(load_dir)
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "infinity_meta.json")) as f:
             meta = json.load(f)
@@ -2408,6 +2509,11 @@ class Engine:
         self.global_steps = int(client_state.get("global_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
+        if "rng_key" in client_state:
+            self._restore_rng(client_state["rng_key"])
+        if self._dataloader is not None and "data_position" in client_state \
+                and hasattr(self._dataloader, "load_state_dict"):
+            self._dataloader.load_state_dict(client_state["data_position"])
         logger.info(f"loaded infinity checkpoint {path}")
         return load_dir, client_state
 

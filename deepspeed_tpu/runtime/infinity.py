@@ -109,9 +109,18 @@ class LayerStore:
         elif self.backend == "host":
             self._host[self._key(kind, i)] = np.ascontiguousarray(arr).copy()
         elif self._aio_w is not None:
+            # AIOHandle.pwrite carries its own bounded retry + named error
             self._aio_w.pwrite(self._path(kind, i), arr)
         else:
-            np.ascontiguousarray(arr).tofile(self._path(kind, i))
+            from deepspeed_tpu.robustness import faults as rb_faults
+            from deepspeed_tpu.robustness.retry import retry_io
+            path = self._path(kind, i)
+            data = np.ascontiguousarray(arr)
+
+            def do_write():
+                rb_faults.io_seam("nvme_write", path)
+                data.tofile(path)
+            retry_io(do_write, what="layer-chunk write", path=path)
 
     def _read(self, kind: str, i: int, shape, dtype,
               out: Optional[np.ndarray] = None):
@@ -123,7 +132,13 @@ class LayerStore:
             return None
         if self._aio_r is not None:
             return self._aio_r.pread(p, shape, dtype, out=out)
-        return np.fromfile(p, dtype).reshape(shape)
+        from deepspeed_tpu.robustness import faults as rb_faults
+        from deepspeed_tpu.robustness.retry import retry_io
+
+        def do_read():
+            rb_faults.io_seam("nvme_read", p)
+            return np.fromfile(p, dtype).reshape(shape)
+        return retry_io(do_read, what="layer-chunk read", path=p)
 
     # params: uint16 (bf16 bits), shape (C,)
     def write_param(self, i: int, bits: np.ndarray):

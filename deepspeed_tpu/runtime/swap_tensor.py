@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.retry import retry_io
 from deepspeed_tpu.utils.logging import logger
 
 # master / exp_avg / exp_avg_sq planes in each chunk buffer
@@ -265,18 +267,28 @@ class NVMeOptimizerSwapper:
                 np.asarray(jax.device_get(host_buf))
                 if not isinstance(host_buf, np.ndarray) else host_buf).copy()
         elif self._aio_w is not None:
+            # AIOHandle.pwrite carries its own bounded retry + named error
             self._aio_w.pwrite(self._path(i), host_buf)
         else:
-            host_buf.tofile(self._path(i))
+            path = self._path(i)
+
+            def do_write():
+                rb_faults.io_seam("nvme_write", path)
+                host_buf.tofile(path)
+            retry_io(do_write, what="optimizer-chunk write", path=path)
 
     def _read_file(self, i: int, out: np.ndarray = None):
         if self.storage in ("pinned", "host"):
             return self._buffers[i]
         if self._aio is not None:
             return self._aio.pread(self._path(i), out.shape, out.dtype, out=out)
-        data = np.fromfile(self._path(i), np.float32).reshape(out.shape)
-        out[...] = data
-        return out
+        path = self._path(i)
+
+        def do_read():
+            rb_faults.io_seam("nvme_read", path)
+            out[...] = np.fromfile(path, np.float32).reshape(out.shape)
+            return out
+        return retry_io(do_read, what="optimizer-chunk read", path=path)
 
     # ------------------------------------------------------------------
     def initialize(self, params):

@@ -17,8 +17,16 @@ serving with zero added steady-state syncs). Four pieces:
                  flops priced by the observed step rate: modeled comms
                  bytes/sec and per-window MFU as monitor events
 
+The robustness subsystem (``deepspeed_tpu/robustness``) publishes its
+recovery decisions on the same record stream: ``ckpt_fallback``,
+``fault_recovered``, ``ckpt_save_failed``, ``preempted`` and
+``fault_injected`` records are drained from ``robustness.events`` by
+``engine._log_step`` at the SAME window boundary (and into the same JSONL
+sink) as the telemetry records — fault handling is observable with zero
+added steady-state syncs.
+
 Enable with config ``{"telemetry": {"enabled": true}}``; see the README
-"Observability" section for the full reference.
+"Observability" and "Fault tolerance" sections for the full reference.
 """
 
 from deepspeed_tpu.telemetry.accumulators import (HIST_BUCKETS, HIST_LOG2_MIN,

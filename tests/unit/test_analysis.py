@@ -555,11 +555,14 @@ class TestMemoryLintEngine:
         assert mem["remat"]["remat_ops"] == 0
         assert mem["remat"]["bwd_ops"] > 0
 
+    @pytest.mark.slow
     def test_memory_lint_changes_no_numerics(self, devices8):
         """Bit-for-bit: auditing with the memory gate armed is a pure
         read of the compiled artifact — training with audit() calls and
         analysis.max_hbm_bytes set produces byte-identical params to
-        training without."""
+        training without. Slow tier: numerical-parity suites run with
+        production codegen (two engine builds + 6 steps, ~9s; re-tiered
+        with the PR-6 quick additions to hold the 180s tier budget)."""
         def run(with_lint):
             overrides = ({"analysis": {"max_hbm_bytes": 1 << 40}}
                          if with_lint else {})

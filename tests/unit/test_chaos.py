@@ -1,0 +1,281 @@
+"""Chaos soak: N steps under a seeded fault schedule must end bit-identical
+to the fault-free run, modulo replayed steps.
+
+The schedule exercises every injection seam in one run: a transient device
+fault (failed step -> probe cull -> rebuild from checkpoint), a corrupted
+checkpoint payload (the rebuild's `latest` fails checksum validation and
+walks back a tag), transient EIO on the checkpoint metadata path (absorbed
+by retry_io), and a real-SIGTERM preemption (checkpoint-and-exit, then a
+fresh agent resumes). Because checkpoints carry the engine rng chain and
+batches are a pure function of the global step, every replayed step
+recomputes exactly what the uninterrupted run computed — so the final
+params AND optimizer state match bit-for-bit.
+
+Slow tier: several engine (re)builds. Runs under tests/run_slow.sh with its
+own per-module budget (CHAOS_BUDGET).
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.faults import FaultInjector, FaultSchedule
+from deepspeed_tpu.robustness.preemption import Preempted, PreemptionHandler
+
+pytestmark = pytest.mark.slow
+
+N_STEPS = 50
+SEQ, VOCAB = 32, 64
+CKPT_INTERVAL = 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_robustness_state():
+    rb_faults.clear()
+    rb_events.clear()
+    yield
+    rb_faults.clear()
+    rb_events.clear()
+
+
+def _factory():
+    return make_model(TransformerConfig(
+        vocab_size=VOCAB, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=SEQ, dtype=jnp.float32, attention_impl="xla"))
+
+
+def _config(jsonl_path=None):
+    cfg = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4],
+                       "min_gpus": 1, "max_gpus": 8},
+        "steps_per_print": CKPT_INTERVAL,
+    }
+    if jsonl_path:
+        cfg["telemetry"] = {"enabled": True, "jsonl_path": jsonl_path}
+    return cfg
+
+
+def _fetch(tree):
+    return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tree)
+
+
+def _run(agent_ctor, batches, n_steps):
+    """Drive an agent to n_steps, restarting on Preempted (the 'new
+    process after the launcher reaped us' path). failure_events are
+    accumulated ACROSS restarts (each restart is a fresh agent)."""
+    agent = agent_ctor()
+    preemptions = failures = 0
+    while agent.engine.global_steps < n_steps:
+        # batch is a pure function of the step being attempted, so replays
+        # after a rebuild consume exactly the original data
+        try:
+            agent.train_batch(
+                lambda bs: batches[agent.engine.global_steps])
+        except Preempted:
+            preemptions += 1
+            assert preemptions < 5, "preemption loop"
+            failures += agent.failure_events
+            agent = agent_ctor()
+    return agent, preemptions, failures + agent.failure_events
+
+
+class TestChaosSoak:
+    def test_soak_bit_identical_to_fault_free(self, tmp_path, devices8):
+        from deepspeed_tpu.elasticity import DSElasticAgent
+
+        cfg = _config()
+        rng = np.random.default_rng(99)
+        # compute the elastic global batch once (same at every world size)
+        probe_agent = DSElasticAgent(_factory, cfg, str(tmp_path / "probe"),
+                                     checkpoint_interval=10**6)
+        gb = probe_agent.batch_size
+        probe_agent = None
+        batches = [{"input_ids": rng.integers(0, VOCAB, (gb, SEQ),
+                                              dtype=np.int32)}
+                   for _ in range(N_STEPS + 4)]
+
+        # ---- fault-free baseline -------------------------------------
+        base_dir = str(tmp_path / "base")
+        base, _, _ = _run(lambda: DSElasticAgent(
+            _factory, _config(), base_dir,
+            checkpoint_interval=CKPT_INTERVAL), batches, N_STEPS)
+        assert base.engine.global_steps == N_STEPS
+        base_params = _fetch(base.engine.state["params"])
+        base_opt = _fetch(base.engine.state["opt"])
+        base = None
+        rb_events.clear()
+
+        # ---- chaos run ------------------------------------------------
+        # saves land at steps 5,10,15,... (post-install mutate indices
+        # 0,1,2,...). The schedule:
+        #   * ckpt_io EIO x2 at ops 0-1   -> retried, fault_recovered
+        #   * corrupt_payload at save idx 1 (step 10's tag rots AFTER
+        #     commit)
+        #   * device_fault at step 12     -> failed step, cull to 4 for one
+        #     probe (transient blip), rebuild; `latest`=step10 fails its
+        #     checksum -> ckpt_fallback to step 5, replay 6..12
+        #   * preempt at step 30          -> real SIGTERM, checkpoint-and-
+        #     exit, fresh agent resumes at 30
+        inj = rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "io_error", "op": "ckpt_io", "at": 0, "times": 2,
+             "errno": "EIO"},
+            {"kind": "corrupt_payload", "at": 1},
+            {"kind": "device_fault", "step": 12, "survivors": 4,
+             "probes": 1},
+            {"kind": "preempt", "step": 30},
+        ], seed=7)))
+        chaos_dir = str(tmp_path / "chaos")
+        jsonl = str(tmp_path / "tel" / "events.jsonl")
+        handler = PreemptionHandler().install()
+
+        def fresh_agent():
+            # the restarted process starts with an un-latched handler
+            handler.reset()
+            return DSElasticAgent(
+                _factory, _config(jsonl), chaos_dir,
+                checkpoint_interval=CKPT_INTERVAL, preemption=handler)
+
+        try:
+            chaos, preemptions, failures = _run(fresh_agent, batches,
+                                                N_STEPS)
+        finally:
+            handler.restore()
+        assert chaos.engine.global_steps == N_STEPS
+
+        # every scheduled fault actually fired
+        fired_kinds = {f["kind"] for f in inj.fired}
+        assert fired_kinds >= {"io_error", "corrupt_payload", "device_fault",
+                               "preempt"}, fired_kinds
+        assert preemptions == 1
+        assert failures == 1                      # the device fault
+        assert chaos.world == 8                   # transient blip: recovered
+
+        # recovery evidence on the event stream
+        recovered = rb_events.history("fault_recovered")
+        assert any(e.get("kind") == "io" for e in recovered)      # retry_io
+        assert any(e.get("kind") == "device" for e in recovered)  # rebuild
+        fallbacks = rb_events.history("ckpt_fallback")
+        assert fallbacks and fallbacks[0]["resolved"] == "global_step5"
+        assert rb_events.history("preempted")
+
+        # ... and drained into the telemetry JSONL sink
+        tel_types = set()
+        for p in glob.glob(os.path.join(os.path.dirname(jsonl), "*")):
+            with open(p) as f:
+                for line in f:
+                    try:
+                        tel_types.add(json.loads(line).get("type"))
+                    except ValueError:
+                        pass
+        assert {"ckpt_fallback", "fault_recovered"} <= tel_types, tel_types
+
+        # the final state is BIT-IDENTICAL to the fault-free run: replayed
+        # steps recomputed the same math (checkpointed rng chain + step-
+        # indexed batches), recoveries changed nothing
+        chaos_params = _fetch(chaos.engine.state["params"])
+        chaos_opt = _fetch(chaos.engine.state["opt"])
+        for name, a, b in (("params", base_params, chaos_params),
+                           ("opt", base_opt, chaos_opt)):
+            flat_a = dict(jax.tree_util.tree_flatten_with_path(a)[0])
+            flat_b = dict(jax.tree_util.tree_flatten_with_path(b)[0])
+            assert flat_a.keys() == flat_b.keys()
+            bad = [jax.tree_util.keystr(k) for k, va in flat_a.items()
+                   if not np.array_equal(va, flat_b[k])]
+            assert not bad, f"{name} leaves differ after chaos soak: {bad}"
+
+
+class TestEngineLoadWalkback:
+    def test_validated_but_unloadable_tag_walks_back(self, tmp_path,
+                                                     devices8):
+        """With checksums off, a size-preserving bit flip passes shallow
+        validation but fails the Orbax restore — the ENGINE path must keep
+        walking back to the previous good tag instead of bricking the
+        elastic rebuild."""
+        import deepspeed_tpu
+
+        def build():
+            engine, *_ = deepspeed_tpu.initialize(model=_factory(), config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": False}, "steps_per_print": 10**6,
+                "checkpoint": {"integrity_checksums": False}})
+            return engine
+
+        rng = np.random.default_rng(5)
+        b = {"input_ids": rng.integers(0, VOCAB, (8, SEQ), dtype=np.int32)}
+        engine = build()
+        engine.train_batch(b)
+        engine.save_checkpoint(str(tmp_path), tag="good")
+        engine.train_batch(b)
+        engine.save_checkpoint(str(tmp_path))  # latest = global_step2
+        # size-preserving corruption of the newest tag's largest file
+        tag2 = os.path.join(str(tmp_path), "global_step2")
+        with open(os.path.join(tag2, "manifest.json")) as f:
+            files = json.load(f)["files"]
+        victim = os.path.join(
+            tag2, max(files.items(), key=lambda kv: kv[1]["size"])[0])
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.write(os.urandom(size))          # same size, garbage bytes
+        from deepspeed_tpu.robustness import integrity
+        assert integrity.validate_tag(tag2, deep=False)[0]  # passes shallow
+
+        e2 = build()
+        e2.load_checkpoint(str(tmp_path))      # must walk back, not raise
+        assert e2.global_steps == 1
+        assert any(str(e.get("reason", "")).startswith("load-error")
+                   for e in rb_events.history("ckpt_fallback"))
+
+
+class TestEngineDataPositionResume:
+    def test_client_state_carries_loader_position(self, tmp_path, devices8):
+        """Engine-level satellite pin: save_checkpoint persists the attached
+        loader's (epoch, pos, seed); load_checkpoint restores it, so the
+        resumed run consumes exactly the batches the saved run had not."""
+        import deepspeed_tpu
+        from deepspeed_tpu.runtime.dataloader import DataLoader, RepeatingLoader
+
+        data = [{"input_ids": np.full((SEQ,), i % VOCAB, np.int32)}
+                for i in range(64)]
+
+        def build():
+            model = _factory()
+            engine, *_ = deepspeed_tpu.initialize(model=model, config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": False}, "steps_per_print": 10**6})
+            loader = RepeatingLoader(DataLoader(
+                data, batch_size=8, shuffle=True, seed=3))
+            engine.attach_dataloader(loader)
+            return engine, loader
+
+        engine, loader = build()
+        seen = []
+        for _ in range(11):   # 8 batches/epoch: crosses into epoch 1
+            b = next(loader)
+            seen.append(b["input_ids"][:, 0].tolist())
+            engine.train_batch(b)
+        engine.save_checkpoint(str(tmp_path))
+        ref = [next(loader)["input_ids"][:, 0].tolist() for _ in range(6)]
+
+        engine2, loader2 = build()
+        engine2.load_checkpoint(str(tmp_path))
+        assert engine2.global_steps == 11
+        assert loader2.state_dict() == {"epoch": 1, "pos": 3, "seed": 3}
+        resumed = [next(loader2)["input_ids"][:, 0].tolist()
+                   for _ in range(6)]
+        assert resumed == ref    # no replay, no skip
+        # and the restored rng chain matches the saved engine's
+        assert np.array_equal(engine._rng_key_data(),
+                              engine2._rng_key_data())

@@ -223,6 +223,45 @@ class TestFailureRecovery:
         # the rebuilt engine resumed from the step-1 checkpoint
         assert agent.engine.global_steps == step_before + 1
 
+    def test_rebuild_survives_corrupt_latest(self, tmp_path, devices8):
+        """Satellite pin: the agent's rebuild path must survive a corrupt
+        `latest` — the integrity chain walks the load back to the previous
+        good tag instead of bricking the recovery with a deserialization
+        error."""
+        import json
+        import os
+        from deepspeed_tpu.robustness import events as rb_events
+        from deepspeed_tpu.robustness import integrity
+        rb_events.clear()
+        healthy = {"n": 8}
+        agent = self._agent(tmp_path,
+                            health_fn=lambda: devices8[:healthy["n"]],
+                            probe_interval=2, checkpoint_interval=1)
+
+        def batch(bs):
+            rng = np.random.default_rng(3)
+            return {"input_ids": rng.integers(0, 64, (bs, 32),
+                                              dtype=np.int32)}
+
+        agent.train_batch(batch)      # step 1 + checkpoint (good tag)
+        agent.train_batch(batch)      # step 2 + checkpoint (will corrupt)
+        tag2 = os.path.join(str(tmp_path), "global_step2")
+        with open(os.path.join(tag2, integrity.MANIFEST_FILE)) as f:
+            files = json.load(f)["files"]
+        victim = max(files.items(), key=lambda kv: kv[1]["size"])[0]
+        with open(os.path.join(tag2, victim), "r+b") as f:
+            f.truncate(os.path.getsize(os.path.join(tag2, victim)) // 2)
+
+        healthy["n"] = 4              # probe-due step culls the world
+        m = agent.train_batch(batch)  # rebuild: latest=step2 is corrupt
+        assert agent.world == 4 and agent.scale_events == 1
+        # resumed from step 1 (the newest VALID tag), then stepped once
+        assert agent.engine.global_steps == 2
+        assert np.isfinite(float(m["loss"]))
+        falls = [e for e in rb_events.history("ckpt_fallback")
+                 if e["resolved"] == "global_step1"]
+        assert falls and falls[-1]["requested"] == "global_step2"
+
     def test_software_error_with_healthy_devices_reraises(self, tmp_path,
                                                           devices8):
         agent = self._agent(tmp_path, health_fn=lambda: devices8,

@@ -112,12 +112,15 @@ def test_grad_flows_to_all_params(tiny_model, rng):
             assert np.abs(np.asarray(g)).sum() > 0, f"zero grad at {path}"
 
 
+@pytest.mark.slow
 def test_attn_windows_band_mask_and_grads(rng):
     """Per-layer local-attention windows (GPT-Neo/Mistral pattern): the
     band bites once seq > window while in-window positions stay exact;
     grads flow, differ from the global-attention grads, and the scan and
     unrolled window threading agree. (Numerical parity against HF's real
-    local attention lives in test_hf_import's GPT-Neo tests.)"""
+    local attention lives in test_hf_import's GPT-Neo tests.) Slow tier:
+    numerical-parity suite (fwd+bwd on four model variants, ~8s; re-tiered
+    with the PR-6 quick additions to hold the 180s tier budget)."""
     kw = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
               dtype=jnp.float32, attention_impl="xla", max_seq_len=64,
               position_type="learned")

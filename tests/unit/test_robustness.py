@@ -1,0 +1,472 @@
+"""Fault-tolerance subsystem (deepspeed_tpu/robustness): retry-with-backoff,
+deterministic fault injection, the checkpoint integrity chain + walk-back,
+retention, data-position resume, preemption latching, and the rendezvous
+torn-manifest regression.
+
+Quick tier by design: everything here is file- and host-level (no engine
+builds, no mesh compiles). The engine-integrated chaos soak lives in
+tests/unit/test_chaos.py (slow tier).
+"""
+
+import errno
+import json
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness import integrity
+from deepspeed_tpu.robustness.faults import FaultInjector, FaultSchedule
+from deepspeed_tpu.robustness.preemption import PreemptionHandler
+from deepspeed_tpu.robustness.retry import retry_io
+from deepspeed_tpu.runtime.checkpointing import (LATEST_FILE, load_checkpoint,
+                                                 resolve_load_tag,
+                                                 save_checkpoint)
+
+
+@pytest.fixture(autouse=True)
+def _clean_robustness_state():
+    rb_faults.clear()
+    rb_events.clear()
+    yield
+    rb_faults.clear()
+    rb_events.clear()
+
+
+def tree(val):
+    return {"w": jnp.full((4, 4), float(val)), "step": jnp.asarray(val)}
+
+
+def corrupt_largest_payload(tag_dir):
+    """Truncate the biggest manifest-listed file (bitrot simulation)."""
+    with open(os.path.join(tag_dir, integrity.MANIFEST_FILE)) as f:
+        files = json.load(f)["files"]
+    victim = max(files.items(), key=lambda kv: kv[1]["size"])[0]
+    p = os.path.join(tag_dir, victim)
+    with open(p, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(p) // 2))
+    return victim
+
+
+# ---------------------------------------------------------------------------
+# retry helper (satellite: every NVMe/AIO host-I/O call is wrapped)
+# ---------------------------------------------------------------------------
+class TestRetryIO:
+    def test_recovers_from_transient_and_emits_event(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise OSError(errno.EIO, "flaky media")
+            return "data"
+
+        slept = []
+        out = retry_io(flaky, what="test read", path="/dev/fake",
+                       offset=4096, sleep=slept.append)
+        assert out == "data" and calls["n"] == 3
+        assert len(slept) == 2 and slept[1] > slept[0]  # backoff grows
+        rec = rb_events.history("fault_recovered")[-1]
+        assert rec["path"] == "/dev/fake" and rec["attempts"] == 3
+
+    def test_terminal_error_names_file_offset_attempts(self):
+        def dead():
+            raise OSError(errno.EIO, "gone")
+
+        with pytest.raises(OSError) as ei:
+            retry_io(dead, what="chunk read", path="/nvme/opt_chunk_3.bin",
+                     offset=12345, attempts=3, sleep=lambda s: None)
+        msg = str(ei.value)
+        assert "chunk read" in msg and "/nvme/opt_chunk_3.bin" in msg
+        assert "@12345" in msg and "3 attempts" in msg
+
+    def test_non_transient_not_retried(self):
+        calls = {"n": 0}
+
+        def full_disk():
+            calls["n"] += 1
+            raise OSError(errno.ENOSPC, "disk full")
+
+        with pytest.raises(OSError):
+            retry_io(full_disk, what="w", path="/x", sleep=lambda s: None)
+        assert calls["n"] == 1  # ENOSPC doesn't un-fill within a backoff
+
+
+# ---------------------------------------------------------------------------
+# fault schedule / injector
+# ---------------------------------------------------------------------------
+class TestFaultInjection:
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            FaultSchedule([{"kind": "meteor_strike"}])
+
+    def test_triggerless_entry_rejected(self):
+        # an entry that could never fire is a schedule that silently
+        # tests nothing — reject it at validation time
+        with pytest.raises(ValueError, match="needs 'at'"):
+            FaultSchedule([{"kind": "io_error", "op": "ckpt_io"}])
+        with pytest.raises(ValueError, match="needs 'step'"):
+            FaultSchedule([{"kind": "preempt"}])
+
+    def test_install_from_config_keeps_same_replaces_changed(self):
+        from deepspeed_tpu.config.config import FaultsConfig
+        cfg1 = FaultsConfig(enabled=True, seed=1, entries=[
+            {"kind": "io_error", "op": "ckpt_io", "at": 0}])
+        a = rb_faults.install_from_config(cfg1)
+        assert rb_faults.install_from_config(cfg1) is a   # rebuild: kept
+        cfg2 = FaultsConfig(enabled=True, seed=2, entries=[])
+        b = rb_faults.install_from_config(cfg2)           # changed: swapped
+        assert b is not a and rb_faults.active() is b
+        # a manually installed injector is never replaced by config
+        manual = rb_faults.install(FaultInjector(FaultSchedule([])))
+        assert rb_faults.install_from_config(cfg1) is manual
+
+    def test_io_error_window_is_deterministic(self):
+        inj = FaultInjector(FaultSchedule(
+            [{"kind": "io_error", "op": "nvme_read", "at": 1, "times": 2}]))
+        inj.op("nvme_read", "/a")                       # index 0: clean
+        for _ in range(2):                              # 1, 2: scheduled
+            with pytest.raises(OSError) as ei:
+                inj.op("nvme_read", "/a")
+            assert ei.value.errno == errno.EIO
+        inj.op("nvme_read", "/a")                       # 3: clean again
+        inj.op("nvme_write", "/a")                      # other category clean
+        assert len(inj.fired) == 2
+
+    def test_injected_transient_recovered_by_retry(self):
+        inj = rb_faults.install(FaultInjector(FaultSchedule(
+            [{"kind": "io_error", "op": "nvme_read", "at": 0, "times": 2}])))
+
+        def read():
+            rb_faults.io_seam("nvme_read", "/nvme/c0.bin")
+            return 42
+
+        assert retry_io(read, what="chunk read", path="/nvme/c0.bin",
+                        sleep=lambda s: None) == 42
+        assert rb_events.history("fault_recovered")
+
+    def test_device_fault_step_and_cull(self):
+        inj = FaultInjector(FaultSchedule(
+            [{"kind": "device_fault", "step": 3, "survivors": 4,
+              "probes": 1}]))
+        inj.step(1), inj.step(2)
+        with pytest.raises(RuntimeError, match="injected device_fault"):
+            inj.step(3)
+        devs = list(range(8))
+        assert inj.cull(devs) == [0, 1, 2, 3]   # armed: first probe shrinks
+        assert inj.cull(devs) == devs           # transient blip cleared
+        inj.step(3)  # once fired, the same step passes (deterministic)
+
+    def test_clock_skew_wraps_injectable_clock(self):
+        inj = FaultInjector(FaultSchedule(
+            [{"kind": "clock_skew", "after": 2, "skew_s": 100.0}]))
+        t = [50.0]
+        clock = inj.make_clock(lambda: t[0])
+        assert clock() == 50.0 and clock() == 50.0
+        assert clock() == 150.0  # third read onward is skewed
+
+
+# ---------------------------------------------------------------------------
+# integrity chain (tentpole piece 2)
+# ---------------------------------------------------------------------------
+class TestIntegrityChain:
+    def test_save_writes_manifest_and_marker(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "t", tree(1))
+        tag = os.path.join(d, "t")
+        assert integrity.is_committed(tag)
+        with open(os.path.join(tag, integrity.MANIFEST_FILE)) as f:
+            manifest = json.load(f)
+        assert manifest["files"]  # payload hashed
+        ok, reason = integrity.validate_tag(tag)
+        assert ok and reason == "ok"
+
+    def test_validate_catches_truncation_and_bitrot(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "t", tree(1))
+        tag = os.path.join(d, "t")
+        victim = corrupt_largest_payload(tag)
+        ok, reason = integrity.validate_tag(tag)
+        assert not ok and victim in reason
+
+    def test_legacy_tag_without_integrity_is_loadable(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "t", tree(5))
+        tag = os.path.join(d, "t")
+        # strip the integrity files: the pre-PR-6 on-disk format
+        os.remove(os.path.join(tag, integrity.COMMIT_FILE))
+        os.remove(os.path.join(tag, integrity.MANIFEST_FILE))
+        ok, reason = integrity.validate_tag(tag)
+        assert ok and reason == "legacy"
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 5.0
+
+    def test_retention_keeps_last_k_good_tags(self, tmp_path):
+        d = str(tmp_path)
+        for i in range(5):
+            save_checkpoint(d, f"step{i}", tree(i), keep_last_k=2)
+        tags = sorted(n for n in os.listdir(d)
+                      if os.path.isdir(os.path.join(d, n)))
+        assert tags == ["step3", "step4"]
+        # newest still loads; latest points at it
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 4.0
+
+    def test_retention_never_prunes_the_tag_latest_names(self, tmp_path):
+        """save_latest=False can leave `latest` naming an OLDER tag than
+        the one just saved — retention must protect it anyway."""
+        d = str(tmp_path)
+        save_checkpoint(d, "a", tree(1))          # latest -> a
+        save_checkpoint(d, "b", tree(2), save_latest=False, keep_last_k=1)
+        save_checkpoint(d, "c", tree(3), save_latest=False, keep_last_k=1)
+        remaining = sorted(n for n in os.listdir(d)
+                           if os.path.isdir(os.path.join(d, n)))
+        assert "a" in remaining                   # latest's tag survives
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 1.0
+
+    def test_overwrite_with_integrity_off_stays_loadable(self, tmp_path):
+        """Re-saving a tag with integrity disabled must drop the STALE
+        manifest too — otherwise the finished save reads as uncommitted
+        forever and resolution silently rolls back to an older tag."""
+        d = str(tmp_path)
+        save_checkpoint(d, "old", tree(1))
+        save_checkpoint(d, "t", tree(2))                 # integrity on
+        save_checkpoint(d, "t", tree(3), write_integrity=False)
+        ok, reason = integrity.validate_tag(os.path.join(d, "t"))
+        assert ok and reason == "legacy"
+        state, _ = load_checkpoint(d, template=tree(0))  # latest == t
+        assert float(np.asarray(state["step"])) == 3.0
+
+    def test_retention_never_counts_invalid_tags(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "a", tree(1))
+        save_checkpoint(d, "b", tree(2))
+        integrity.invalidate(os.path.join(d, "b"))  # torn
+        save_checkpoint(d, "c", tree(3), keep_last_k=2)
+        remaining = sorted(n for n in os.listdir(d)
+                           if os.path.isdir(os.path.join(d, n)))
+        # a + c are the last 2 GOOD tags; torn b is evidence, not capacity
+        assert remaining == ["a", "b", "c"]
+
+
+# ---------------------------------------------------------------------------
+# load_checkpoint walk-back (acceptance: a corrupt/uncommitted latest never
+# raises with tag=None)
+# ---------------------------------------------------------------------------
+class TestCheckpointFallback:
+    def test_uncommitted_latest_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "good", tree(1))
+        save_checkpoint(d, "torn", tree(2))
+        os.remove(os.path.join(d, "torn", integrity.COMMIT_FILE))
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 1.0
+        ev = rb_events.history("ckpt_fallback")[-1]
+        assert ev["requested"] == "torn" and ev["resolved"] == "good"
+        assert "uncommitted" in ev["reason"]
+
+    def test_truncated_payload_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "good", tree(1))
+        save_checkpoint(d, "rotten", tree(2))
+        corrupt_largest_payload(os.path.join(d, "rotten"))
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 1.0
+        assert rb_events.history("ckpt_fallback")
+
+    def test_latest_pointing_at_missing_tag_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "good", tree(7))
+        with open(os.path.join(d, LATEST_FILE), "w") as f:
+            f.write("never_existed")
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 7.0
+
+    def test_commit_marker_deleted_mid_save_via_injector(self, tmp_path):
+        """torn_save fault: the save 'crashes' between payload and commit
+        marker. The save call raises (the process would have died); the
+        NEXT load must land on the previous good tag."""
+        d = str(tmp_path)
+        save_checkpoint(d, "s1", tree(1))
+        rb_faults.install(FaultInjector(FaultSchedule(
+            [{"kind": "torn_save", "at": 0}])))
+        with pytest.raises(OSError, match="torn save"):
+            save_checkpoint(d, "s2", tree(2))
+        assert not integrity.is_committed(os.path.join(d, "s2"))
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 1.0
+
+    def test_corrupt_payload_injector_commits_then_fails_checksum(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "s1", tree(1))
+        # indices count from injector install: s2's save is mutate-op 0
+        rb_faults.install(FaultInjector(FaultSchedule(
+            [{"kind": "corrupt_payload", "at": 0}])))
+        save_checkpoint(d, "s2", tree(2))   # save "succeeds" (bitrot later)
+        assert integrity.is_committed(os.path.join(d, "s2"))
+        ok, reason = integrity.validate_tag(os.path.join(d, "s2"))
+        assert not ok and "mismatch" in reason
+        state, _ = load_checkpoint(d, template=tree(0))
+        assert float(np.asarray(state["step"])) == 1.0
+
+    def test_nothing_valid_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_checkpoint(str(tmp_path), template=tree(0))
+
+    def test_explicit_tag_is_honored_verbatim(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, "a", tree(1))
+        save_checkpoint(d, "b", tree(2))
+        resolved, fell_back = resolve_load_tag(d, "a")
+        assert resolved == "a" and not fell_back
+
+
+# ---------------------------------------------------------------------------
+# preemption (tentpole piece 3, host half)
+# ---------------------------------------------------------------------------
+class TestPreemption:
+    def test_sigterm_latches_flag(self):
+        with PreemptionHandler() as h:
+            assert not h.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested and h.received == signal.SIGTERM
+            h.reset()
+            assert not h.requested
+        # restored: the default handler is back (don't send SIGTERM now!)
+        assert signal.getsignal(signal.SIGTERM) is not h._on_signal
+
+    def test_injector_preempt_delivers_real_sigterm(self):
+        inj = FaultInjector(FaultSchedule([{"kind": "preempt", "step": 2}]))
+        with PreemptionHandler() as h:
+            inj.step(1)
+            assert not h.requested
+            inj.step(2)      # delivers SIGTERM to this process
+            assert h.requested
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: torn NEWEST manifest regression (satellite)
+# ---------------------------------------------------------------------------
+class TestRendezvousTornManifest:
+    def test_torn_newest_manifest_falls_back_not_none(self, tmp_path):
+        """A torn newest gen file must NOT erase history: current_generation
+        falls back to the next-newest readable manifest, so the leader's
+        next publish is gen N+1, never a gen-0 rewrite."""
+        from deepspeed_tpu.elasticity import FileRendezvous
+        t = [100.0]
+        a = FileRendezvous(str(tmp_path), "host-a", dead_after_s=10.0,
+                           clock=lambda: t[0])
+        a.heartbeat()
+        a.propose_generation()           # gen 0
+        a.propose_generation()           # gen 1
+        # gen 1's file is torn in place (crashed writer, partial flush)
+        (tmp_path / "gen_00000001.json").write_text('{"genera')
+        cur = a.current_generation()
+        assert cur is not None and cur["generation"] == 0
+        # and the next publish continues history instead of rewriting it
+        m = a.propose_generation()
+        assert m["generation"] == 1
+        assert a.current_generation()["generation"] == 1
+
+    def test_clock_skew_fault_ages_out_heartbeats(self, tmp_path):
+        """Injected clock skew = heartbeat loss: the skewed observer sees
+        its peer's heartbeat age past dead_after_s and re-forms."""
+        from deepspeed_tpu.elasticity import FileRendezvous
+        inj = FaultInjector(FaultSchedule(
+            [{"kind": "clock_skew", "after": 3, "skew_s": 60.0}]))
+        t = [100.0]
+        a = FileRendezvous(str(tmp_path), "host-a", dead_after_s=10.0,
+                           clock=inj.make_clock(lambda: t[0]))
+        b = FileRendezvous(str(tmp_path), "host-b", dead_after_s=10.0,
+                           clock=lambda: t[0])
+        a.heartbeat(); b.heartbeat()               # a's clock: read 1
+        assert a.live_hosts() == ["host-a", "host-b"]   # read 2: unskewed
+        a.heartbeat()                              # read 3: last unskewed ts
+        a.heartbeat()                              # read 4: SKEWED ts=160
+        # a's view is now 60s ahead: b's ts-100 heartbeat looks dead while
+        # a's own (written with the skewed ts) still looks live
+        assert a.live_hosts() == ["host-a"]
+        assert a.is_leader()
+
+
+# ---------------------------------------------------------------------------
+# data-pipeline position (satellite): resume neither replays nor skips
+# ---------------------------------------------------------------------------
+class TestDataPositionResume:
+    def _loader(self, **kw):
+        from deepspeed_tpu.runtime.dataloader import DataLoader
+        data = [{"x": np.full((2,), i, np.int32)} for i in range(32)]
+        return DataLoader(data, batch_size=4, shuffle=True, seed=7, **kw)
+
+    @staticmethod
+    def _ids(batch):
+        return batch["x"][:, 0].tolist()
+
+    def test_state_dict_resume_is_exact(self):
+        ref = self._loader()
+        full = [self._ids(b) for b in ref]          # the uninterrupted epoch
+        run = self._loader()
+        it = iter(run)
+        consumed = [self._ids(next(it)) for _ in range(3)]
+        sd = run.state_dict()
+        assert sd == {"epoch": 0, "pos": 3, "seed": 7}
+        resumed = self._loader()                    # a fresh process
+        resumed.load_state_dict(sd)
+        rest = [self._ids(b) for b in resumed]
+        assert consumed + rest == full              # no replay, no skip
+
+    def test_resume_across_epoch_boundary(self):
+        from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+        ref = RepeatingLoader(self._loader())
+        full = [self._ids(next(ref)) for _ in range(20)]   # spans 2+ epochs
+        run = RepeatingLoader(self._loader())
+        consumed = [self._ids(next(run)) for _ in range(11)]  # epoch 1, pos 3
+        sd = run.state_dict()
+        assert sd["epoch"] == 1 and sd["pos"] == 3
+        resumed = RepeatingLoader(self._loader())
+        resumed.load_state_dict(sd)
+        rest = [self._ids(next(resumed)) for _ in range(9)]
+        assert consumed + rest == full
+
+    def test_set_epoch_resets_position(self):
+        run = self._loader()
+        it = iter(run)
+        next(it)
+        run.set_epoch(1)
+        assert run.state_dict()["pos"] == 0
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+class TestRobustnessConfig:
+    def test_fault_entries_validated_at_config_load(self):
+        from deepspeed_tpu.config.config import Config
+        from deepspeed_tpu.config.config_utils import ConfigError
+        with pytest.raises((ConfigError, ValueError), match="unknown kind"):
+            Config.load({"robustness": {"faults": {
+                "enabled": True, "entries": [{"kind": "nope"}]}}})
+        cfg = Config.load({"robustness": {"faults": {
+            "enabled": True, "seed": 3,
+            "entries": [{"kind": "io_error", "op": "nvme_read", "at": 0}]}}})
+        assert cfg.robustness.faults.seed == 3
+
+    def test_checkpoint_integrity_keys(self):
+        from deepspeed_tpu.config.config import Config
+        cfg = Config.load({"checkpoint": {"keep_last_k": 3,
+                                          "integrity_checksums": False}})
+        assert cfg.checkpoint.keep_last_k == 3
+        assert cfg.checkpoint.integrity and not cfg.checkpoint.integrity_checksums
+
+    def test_events_drain_and_history(self):
+        rb_events.emit("ckpt_fallback", requested="a", resolved="b",
+                       reason="test")
+        drained = rb_events.drain()
+        assert drained[-1]["type"] == "ckpt_fallback"
+        assert rb_events.drain() == []                   # empty after drain
+        assert rb_events.history("ckpt_fallback")        # history persists
