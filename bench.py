@@ -17,6 +17,7 @@ import argparse
 import gc
 import json
 import math
+import os
 import sys
 import time
 
@@ -243,6 +244,12 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 result["seq8k_mfu"] = _long_seq_bench(size)
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: seq-8k bench failed: {e}", file=sys.stderr)
+            gc.collect()
+            try:
+                result.update(_stall_attribution_bench(size))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: stall attribution failed: {e}",
+                      file=sys.stderr)
             try:
                 result.update(_sparse_kernel_bench())
             except Exception as e:  # noqa: BLE001 — secondary metric
@@ -266,8 +273,96 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                                              result["step_ms"] / 1000.0))
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: offload bench failed: {e}", file=sys.stderr)
+        elif not on_tpu and not quick and not model_size:
+            # CPU smoke of the stall-attribution rung (true seq lengths,
+            # CPU-sized vocab): keeps the traced-capture path exercised on
+            # boxes without the TPU relay
+            try:
+                result.update(_stall_attribution_bench(size, small=True))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: stall attribution failed: {e}",
+                      file=sys.stderr)
         return result
     raise RuntimeError(f"every bench rung OOM'd; last error: {last_err}")
+
+
+def _stall_attribution_bench(size: str, bench_dir: str = None,
+                             small: bool = False) -> dict:
+    """Traced-step capture + device-time stall attribution at seq 2048 and
+    8k (ROADMAP item 1's evidence gate: name the top two stall sources in
+    the bench JSON before shipping any perf lever).
+
+    One step per rung runs under ``jax.profiler``; the trace artifact lands
+    in the bench dir (rotated — see profiling/capture.py caps) and the
+    perf doctor's attribution produces ``stall_top2_<suffix>`` = the two
+    largest non-compute-bound buckets with ms + fraction of the step span.
+    The modeled ``exposed_comm_ms`` from the telemetry overlap join rides
+    along so modeled-vs-measured divergence is visible in the same JSON.
+
+    small=True (CPU smoke): same sequence lengths, but a 2-layer/128-hidden
+    f32 model with a 2k vocab — the O(S^2) XLA attention and the logits
+    stay CPU-sized while the capture/attribution path is fully real."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama_config, make_model
+    from deepspeed_tpu.profiling.capture import capture_traced_step
+    from deepspeed_tpu.profiling.doctor import diagnose, stall_fields
+
+    bench_dir = bench_dir or os.environ.get("DSTPU_BENCH_DIR",
+                                            "bench_artifacts")
+    out = {}
+    rungs = [("seq2048", 2048, 4 if not small else 1, LOSS_CHUNK),
+             ("seq8k", 8192, 2 if not small else 1, 1024)]
+    for suffix, S, B, chunk in rungs:
+        # per-rung isolation: a seq-8k OOM must not throw away the seq-2048
+        # fields already gathered (same degradation contract as the other
+        # secondary benches)
+        try:
+            overrides = dict(vocab_size=2048, num_layers=2, hidden_size=128,
+                             num_heads=4, num_kv_heads=2,
+                             intermediate_size=384) if small else {}
+            cfg = llama_config(size, max_seq_len=S, remat=not small,
+                               remat_policy="dots_saveable" if not small
+                               else "none",
+                               loss_chunk=min(chunk, S), **overrides)
+            model = make_model(cfg, name=f"llama-{size}")
+            engine, *_ = deepspeed_tpu.initialize(model=model, config={
+                "train_batch_size": B,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": not small},
+                "zero_optimization": {"stage": 1},
+                # static_join: the modeled exposed_comm_ms the measured
+                # attribution cross-checks comes from the same overlap
+                # audit the MFU rung reports
+                "telemetry": {"enabled": True},
+                "steps_per_print": 1000000})
+            rng = np.random.default_rng(0)
+            b = {"input_ids": rng.integers(0, cfg.vocab_size, (B, S),
+                                           dtype=np.int32)}
+            res = capture_traced_step(engine, b, bench_dir, tag=suffix,
+                                      steps=1)
+            win = engine.drain_telemetry() or {}
+            modeled = win.get("exposed_comm_ms")
+            del engine
+            gc.collect()
+            if res is None:
+                print(f"bench: stall attribution {suffix}: no trace "
+                      "produced", file=sys.stderr)
+                continue
+            d = diagnose(res.trace, res.hlo_text, cost=res.cost,
+                         steps=res.steps, modeled_exposed_comm_ms=modeled)
+        except Exception as e:  # noqa: BLE001 — keep completed rungs
+            print(f"bench: stall attribution {suffix} failed: {e}",
+                  file=sys.stderr)
+            gc.collect()
+            continue
+        out.update(stall_fields(d, suffix))
+        out[f"trace_artifact_{suffix}"] = res.artifact_path
+        out[f"step_span_ms_{suffix}"] = d["step_span_ms"]
+        out[f"exposed_comm_ms_{suffix}"] = d["exposed_comm_ms"]
+        if d.get("exposed_comm_divergence") is not None:
+            out[f"exposed_comm_divergence_{suffix}"] = \
+                d["exposed_comm_divergence"]
+    return out
 
 
 def _telemetry_bench(size: str, S: int, B: int, base_step_s: float,

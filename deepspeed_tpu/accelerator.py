@@ -189,6 +189,25 @@ class Accelerator:
             return 1e10
         return 2.0e11
 
+    def hbm_bytes_per_sec(self) -> float:
+        """Best-effort per-chip HBM bandwidth (bytes/sec). Used with
+        ``peak_flops_per_device`` as the roofline balance point when the
+        perf doctor classifies a traced bucket compute- vs memory-bound.
+        Published chip numbers — a modeling constant, not a measurement."""
+        kind = self.device_kind().lower()
+        table = {
+            # chip kind substring -> HBM bytes/sec
+            "v5 lite": 8.2e11, "v5e": 8.2e11, "v5litepod": 8.2e11,
+            "v5p": 2.77e12, "v4": 1.2e12, "v3": 9.0e11, "v2": 7.0e11,
+            "v6": 1.6e12,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        if self._platform == "cpu":
+            return 5e10
+        return 8.2e11
+
     def pin_memory(self, array):
         """Host staging; JAX host buffers are already DMA-capable — identity."""
         return array

@@ -404,6 +404,17 @@ class NoisyLossModel:
         return loss + 1e-12 * jnp.sum(extra)
 
 
+def exposed_collective_trace(devices=None):
+    """Perf doctor gate: a TRACED step (not a compiled program) whose
+    all-reduce runs with nothing scheduled under it — 8 ms of measured
+    exposed wire in an 18 ms step. The doctor's attribution must price the
+    full collective as exposed and ``exposed-collective-measured`` must
+    fire. This is the measured counterpart of ``deferred-sync-regression``
+    (whose exposure is modeled from the scheduled HLO)."""
+    from deepspeed_tpu.profiling.doctor import run_corpus_entry
+    return run_corpus_entry()
+
+
 CORPUS = {
     "undonated-state": undonated_state,
     "extra-collective": extra_collective,
@@ -415,6 +426,7 @@ CORPUS = {
     "deferred-sync-regression": deferred_sync_regression,
     "remat-missing": remat_missing,
     "stage3-replicated-opt": stage3_replicated_opt,
+    "exposed-collective-trace": exposed_collective_trace,
 }
 
 

@@ -208,13 +208,19 @@ class MonitorSinkConfig(ConfigModel):
 
 @dataclasses.dataclass
 class FlopsProfilerConfig(ConfigModel):
-    """Reference: ``profiling/flops_profiler`` config keys."""
+    """Reference: ``profiling/flops_profiler`` config keys, plus the
+    TPU-native measured tier: ``measure_trace`` joins a real
+    ``jax.profiler`` traced step (profiling/capture.py) against the
+    analytic per-module FLOPs so the report's latency column is device
+    time, not host-side module timers."""
     enabled: bool = False
     profile_step: int = 1
     module_depth: int = -1
     top_modules: int = 1
     detailed: bool = True
     output_file: Optional[str] = None
+    measure_trace: bool = False
+    trace_dir: str = ""               # "" = no artifact written
 
 
 @dataclasses.dataclass

@@ -919,23 +919,29 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         if read_len is not None and read_len < ck.shape[2]:
             sc = (tuple(s[:, :, :read_len] for s in kv_scale)
                   if kv_scale is not None else None)
-            attn_out = _decode_attention(q, ck[:, :, :read_len],
-                                         cv[:, :, :read_len], index, cfg,
-                                         kv_row=(k_row, v_row),
-                                         kv_scale=sc, kv_suffix=kv_suffix,
-                                         window=attn_window)
+            with jax.named_scope("attn"):
+                attn_out = _decode_attention(q, ck[:, :, :read_len],
+                                             cv[:, :, :read_len], index, cfg,
+                                             kv_row=(k_row, v_row),
+                                             kv_scale=sc, kv_suffix=kv_suffix,
+                                             window=attn_window)
         else:
-            attn_out = _decode_attention(q, ck, cv, index, cfg,
-                                         kv_row=(k_row, v_row),
-                                         kv_scale=kv_scale,
-                                         kv_suffix=kv_suffix,
-                                         window=attn_window)
+            with jax.named_scope("attn"):
+                attn_out = _decode_attention(q, ck, cv, index, cfg,
+                                             kv_row=(k_row, v_row),
+                                             kv_scale=kv_scale,
+                                             kv_suffix=kv_suffix,
+                                             window=attn_window)
         new_kv = (k_row, v_row)
     else:
         if return_kv:
             new_kv = (k, v)
-        attn_out = attention(q, k, v, mask=mask, causal=cfg.causal, cfg=cfg,
-                             window=attn_window)
+        # named scope: the perf doctor's trace join buckets everything under
+        # attn/ as attention time (flash kernel, softmax chain) — the QKV/O
+        # projections outside it stay in the matmul bucket by design
+        with jax.named_scope("attn"):
+            attn_out = attention(q, k, v, mask=mask, causal=cfg.causal,
+                                 cfg=cfg, window=attn_window)
     attn_out = attn_out.reshape(B, S, nh * hd) @ p["wo"].astype(h.dtype)
     if "bo" in p:
         attn_out = attn_out + p["bo"].astype(h.dtype)
@@ -956,51 +962,57 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         from deepspeed_tpu.moe.sharded_moe import moe_ffn
         from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
         from deepspeed_tpu.parallel.context import current_plan
-        moe_params = {"wg": p["wg"], "w_in": p["moe_w_in"],
-                      "w_out": p["moe_w_out"]}
-        if "moe_w_gate" in p:
-            moe_params["w_gate"] = p["moe_w_gate"]
-        plan = current_plan()
-        tp_moe = plan is not None and getattr(plan, "tensor", 1) > 1
-        if tp_moe:
-            # split tokens across the TP group for the gate/dispatch region
-            # (reference: moe/mappings.py drop/gather around the MoE block)
-            h = drop_tokens(h, dim=1)
-        moe_out, aux = moe_ffn(moe_params, h, cfg, rng=dropout_rng,
-                               train=not deterministic)
-        if tp_moe:
-            moe_out = gather_tokens(moe_out, dim=1)
-        if "w_in" in p:  # PR-MoE residual (reference: layer.py use_residual)
+        with jax.named_scope("moe"):
+            moe_params = {"wg": p["wg"], "w_in": p["moe_w_in"],
+                          "w_out": p["moe_w_out"]}
+            if "moe_w_gate" in p:
+                moe_params["w_gate"] = p["moe_w_gate"]
+            plan = current_plan()
+            tp_moe = plan is not None and getattr(plan, "tensor", 1) > 1
+            if tp_moe:
+                # split tokens across the TP group for the gate/dispatch
+                # region (reference: moe/mappings.py drop/gather around MoE)
+                h = drop_tokens(h, dim=1)
+            moe_out, aux = moe_ffn(moe_params, h, cfg, rng=dropout_rng,
+                                   train=not deterministic)
+            if tp_moe:
+                moe_out = gather_tokens(moe_out, dim=1)
+            if "w_in" in p:  # PR-MoE residual (reference: use_residual)
+                up = h @ p["w_in"].astype(h.dtype)
+                if "b_in" in p:
+                    up = up + p["b_in"].astype(h.dtype)
+                gate = (h @ p["w_gate"].astype(h.dtype)
+                        if "w_gate" in p else None)
+                dense_out = (_activation(up, gate, cfg)
+                             @ p["w_out"].astype(h.dtype))
+                if "b_out" in p:
+                    dense_out = dense_out + p["b_out"].astype(h.dtype)
+                coef = jax.nn.softmax(
+                    (h @ p["moe_coef"].astype(h.dtype)).astype(jnp.float32),
+                    axis=-1)
+                out = dense_out * coef[..., 0:1].astype(h.dtype) + \
+                    moe_out * coef[..., 1:2].astype(h.dtype)
+            else:
+                out = moe_out
+    elif "w_in_gate" in p:
+        # fused up+gate projection (see fuse_layer_stack)
+        with jax.named_scope("mlp"):
+            ug = h @ p["w_in_gate"].astype(h.dtype)
+            half = ug.shape[-1] // 2
+            act = _activation(ug[..., :half], ug[..., half:], cfg)
+            out = act @ p["w_out"].astype(h.dtype)
+            if "b_out" in p:
+                out = out + p["b_out"].astype(h.dtype)
+    else:
+        with jax.named_scope("mlp"):
             up = h @ p["w_in"].astype(h.dtype)
             if "b_in" in p:
                 up = up + p["b_in"].astype(h.dtype)
             gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
-            dense_out = _activation(up, gate, cfg) @ p["w_out"].astype(h.dtype)
+            act = _activation(up, gate, cfg)
+            out = act @ p["w_out"].astype(h.dtype)
             if "b_out" in p:
-                dense_out = dense_out + p["b_out"].astype(h.dtype)
-            coef = jax.nn.softmax(
-                (h @ p["moe_coef"].astype(h.dtype)).astype(jnp.float32), axis=-1)
-            out = dense_out * coef[..., 0:1].astype(h.dtype) + \
-                moe_out * coef[..., 1:2].astype(h.dtype)
-        else:
-            out = moe_out
-    elif "w_in_gate" in p:
-        # fused up+gate projection (see fuse_layer_stack)
-        ug = h @ p["w_in_gate"].astype(h.dtype)
-        half = ug.shape[-1] // 2
-        act = _activation(ug[..., :half], ug[..., half:], cfg)
-        out = act @ p["w_out"].astype(h.dtype)
-        if "b_out" in p:
-            out = out + p["b_out"].astype(h.dtype)
-    else:
-        up = h @ p["w_in"].astype(h.dtype)
-        if "b_in" in p:
-            up = up + p["b_in"].astype(h.dtype)
-        gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
-        act = _activation(up, gate, cfg)
-        out = act @ p["w_out"].astype(h.dtype)
-        if "b_out" in p:
-            out = out + p["b_out"].astype(h.dtype)
+                out = out + p["b_out"].astype(h.dtype)
     if cfg.parallel_block:
         x = (x + _dropout(attn_out, cfg, dropout_rng, deterministic, 0)
              + _dropout(out, cfg, dropout_rng, deterministic, 1))
@@ -1067,23 +1079,24 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     segment ids for encoder models (type_vocab_size > 0); None -> zeros.
     inputs_embeds: pre-computed [B, S, H] embeddings instead of a token
     lookup (vision towers / soft prompts); positions still apply."""
-    if inputs_embeds is not None:
-        B, S = inputs_embeds.shape[:2]
-        x = inputs_embeds.astype(cfg.dtype)
-    else:
-        B, S = input_ids.shape
-        x = params["tok_embed"][input_ids].astype(cfg.dtype)
-    if cfg.position_type == "learned":
-        pos = positions if positions is not None else jnp.arange(S)[None]
-        x = x + params["pos_embed"][pos].astype(cfg.dtype)
-    if "tok_type_embed" in params:
-        tt = (token_type_ids if token_type_ids is not None
-              else jnp.zeros((B, S), jnp.int32))
-        x = x + params["tok_type_embed"][tt].astype(cfg.dtype)
-    if cfg.embed_norm:
-        x = _norm(x, params["embed_norm_scale"],
-                  params.get("embed_norm_bias"), cfg)
-    x = _constrain_batch_axes(x)
+    with jax.named_scope("embed"):
+        if inputs_embeds is not None:
+            B, S = inputs_embeds.shape[:2]
+            x = inputs_embeds.astype(cfg.dtype)
+        else:
+            B, S = input_ids.shape
+            x = params["tok_embed"][input_ids].astype(cfg.dtype)
+        if cfg.position_type == "learned":
+            pos = positions if positions is not None else jnp.arange(S)[None]
+            x = x + params["pos_embed"][pos].astype(cfg.dtype)
+        if "tok_type_embed" in params:
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros((B, S), jnp.int32))
+            x = x + params["tok_type_embed"][tt].astype(cfg.dtype)
+        if cfg.embed_norm:
+            x = _norm(x, params["embed_norm_scale"],
+                      params.get("embed_norm_bias"), cfg)
+        x = _constrain_batch_axes(x)
 
     layers = layer_override if layer_override is not None else params["layers"]
 
@@ -1148,14 +1161,19 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
             return lax.cond(coin, lambda c: body(c, lxs),
                             lambda c: (c, None), carry)
 
-        (x, _, aux_total), kv_stack = lax.scan(
-            pld_body, (x, dropout_rng, aux_total),
-            ((layers, wins) if wins is not None else layers,
-             jnp.arange(L)))
+        with jax.named_scope("layers"):
+            (x, _, aux_total), kv_stack = lax.scan(
+                pld_body, (x, dropout_rng, aux_total),
+                ((layers, wins) if wins is not None else layers,
+                 jnp.arange(L)))
     elif cfg.scan_layers and not use_ltd:
-        (x, _, aux_total), kv_stack = lax.scan(
-            body, (x, dropout_rng, aux_total),
-            (layers, wins) if wins is not None else layers)
+        # "layers" scope: under scan every layer shares the one traced body,
+        # so the trace join attributes the stack in aggregate (per-layer
+        # splits need scan_layers=False — the unrolled path names each one)
+        with jax.named_scope("layers"):
+            (x, _, aux_total), kv_stack = lax.scan(
+                body, (x, dropout_rng, aux_total),
+                (layers, wins) if wins is not None else layers)
     else:
         n_layers = jax.tree.leaves(layers)[0].shape[0]
         carry = (x, dropout_rng, aux_total)
@@ -1197,9 +1215,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
                 # XLA path for a band mask they don't have
                 win_i = ((cfg.attn_windows[i] or None)
                          if cfg.attn_windows else None)
-                carry, kv = body(
-                    carry, (layer_p, win_i) if wins is not None
-                    else layer_p)
+                with jax.named_scope(f"layer{i}"):
+                    carry, kv = body(
+                        carry, (layer_p, win_i) if wins is not None
+                        else layer_p)
             kvs.append(kv)
         x, aux_total = carry[0], carry[2]
         if return_kv:
@@ -1210,12 +1229,13 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
                   params.get("final_norm_bias"), cfg)
     if return_hidden:
         return x, aux_total
-    head = params.get("lm_head")
-    if head is None:
-        head = params["tok_embed"].T
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
-    if "lm_head_bias" in params:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    with jax.named_scope("lm_head"):
+        head = params.get("lm_head")
+        if head is None:
+            head = params["tok_embed"].T
+        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"].astype(jnp.float32)
     if return_kv:
         return logits, kv_stack
     if return_aux:
@@ -1624,13 +1644,15 @@ def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
         head = params.get("lm_head")
         if head is None:
             head = params["tok_embed"].T
-        loss = chunked_cross_entropy(x, head, labels, cfg.loss_chunk)
+        with jax.named_scope("loss"):
+            loss = chunked_cross_entropy(x, head, labels, cfg.loss_chunk)
     else:
         logits, aux = forward(params, ids, cfg, attention_mask=mask,
                               dropout_rng=dropout_rng,
                               deterministic=deterministic, return_aux=True,
                               pld_theta=pld_theta)
-        loss = cross_entropy_loss(logits, labels)
+        with jax.named_scope("loss"):
+            loss = cross_entropy_loss(logits, labels)
     if cfg.num_experts > 1:
         loss = loss + cfg.moe_aux_loss_weight * aux
     return loss

@@ -1,2 +1,9 @@
 from deepspeed_tpu.profiling.flops_profiler import (
     FlopsProfiler, get_model_profile, profile_jaxpr)
+from deepspeed_tpu.profiling.capture import (CaptureResult,
+                                             capture_traced_step,
+                                             rotate_artifacts, trace_window)
+from deepspeed_tpu.profiling.trace_analysis import (Attribution, attribute,
+                                                    parse_hlo_scopes,
+                                                    stall_top2)
+from deepspeed_tpu.profiling.doctor import diagnose, gate, stall_fields

@@ -1,0 +1,283 @@
+"""Perf doctor: machine-readable diagnosis + CI gate over a traced step.
+
+Turns a captured trace artifact (profiling/capture.py) into the same
+finding/baseline machinery graft-lint uses, so a perf regression gates a
+pipeline exactly like a collective-census drift does::
+
+    python -m deepspeed_tpu.profiling.doctor --trace bench_artifacts/trace_seq2048.json.gz
+    python -m deepspeed_tpu.profiling.doctor --trace T --write-baseline doctor_baseline.json
+    python -m deepspeed_tpu.profiling.doctor --trace T --baseline doctor_baseline.json
+    python -m deepspeed_tpu.profiling.doctor --corpus exposed-collective-trace
+
+Rules:
+
+  * ``stall-regression``      — a bucket's fraction of step time grew past
+                                the baseline by more than the tolerance
+  * ``exposed-collective-measured`` — measured exposed-comm time exceeds
+                                the allowed fraction of the step (the
+                                default gate; fires with no baseline)
+  * ``modeled-measured-divergence`` — measured exposed-comm ms diverges
+                                from the static OverlapAudit's modeled
+                                ``exposed_comm_ms`` by > 25% (warning: one
+                                of the two models is lying)
+
+Exit status: non-zero when any error finding survives — the CI gate.
+"""
+
+import argparse
+import gzip
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.analysis.report import Finding, Report
+from deepspeed_tpu.profiling import trace_analysis
+from deepspeed_tpu.profiling.trace_analysis import (classify_bounds,
+                                                    join_census,
+                                                    stall_ranking, stall_top2)
+
+# measured exposed collective time above this fraction of the step is an
+# error even without a baseline — wire latency the scheduler is not hiding
+MAX_EXPOSED_COMM_FRACTION = 0.15
+# modeled (OverlapAudit) vs measured exposed-comm divergence warning bar
+DIVERGENCE_TOLERANCE = 0.25
+# baseline gating: a bucket must grow BOTH 20% relative and 2 points of
+# step fraction before stall-regression fires (absolute floor keeps noise
+# on tiny buckets from gating)
+REGRESSION_REL = 0.20
+REGRESSION_ABS = 0.02
+
+
+def diagnose(trace: Any, hlo_text: str = "", *,
+             cost: Optional[Dict[str, Any]] = None,
+             steps: int = 1,
+             modeled_exposed_comm_ms: Optional[float] = None,
+             accel=None) -> Dict[str, Any]:
+    """Full attribution + roofline + census join + top-2 stalls for one
+    traced step. Pure host work — no jax import on the happy path."""
+    if accel is None:
+        from deepspeed_tpu.accelerator import get_accelerator
+        accel = get_accelerator()
+    scope_map = (trace_analysis.parse_hlo_scopes(hlo_text)
+                 if hlo_text else None)
+    attr = trace_analysis.attribute(trace, scope_map, steps=steps)
+    bounds = classify_bounds(
+        attr, cost,
+        peak_flops=accel.peak_flops_per_device("bf16"),
+        hbm_bytes_per_sec=accel.hbm_bytes_per_sec())
+    out = {
+        "step_span_ms": round(attr.step_span_ms, 4),
+        "device_busy_ms": round(attr.device_busy_ms, 4),
+        "fwd_ms": round(attr.fwd_ms, 4),
+        "bwd_ms": round(attr.bwd_ms, 4),
+        "buckets": attr.buckets,
+        "bounds": bounds,
+        "by_scope_ms": {k: round(v, 4) for k, v in sorted(
+            attr.by_scope_ms.items(), key=lambda kv: -kv[1])},
+        "exposed_comm_ms": round(attr.exposed_comm_ms, 4),
+        "stalls": stall_ranking(attr, bounds),
+        "stall_top2": stall_top2(attr, bounds),
+        "joined_ops": attr.joined_ops,
+        "total_ops": attr.total_ops,
+    }
+    if cost and cost.get("census"):
+        out["collective_join"] = join_census(attr, cost["census"])
+    if modeled_exposed_comm_ms is not None:
+        out["modeled_exposed_comm_ms"] = round(modeled_exposed_comm_ms, 4)
+        hi = max(attr.exposed_comm_ms, modeled_exposed_comm_ms)
+        div = (abs(attr.exposed_comm_ms - modeled_exposed_comm_ms) / hi
+               if hi > 0 else 0.0)
+        out["exposed_comm_divergence"] = round(div, 4)
+    return out
+
+
+def gate(diag: Dict[str, Any], *,
+         baseline: Optional[Dict[str, Any]] = None,
+         max_exposed_fraction: float = MAX_EXPOSED_COMM_FRACTION,
+         program: str = "traced_step") -> Report:
+    """Apply the doctor's gating rules to a diagnosis. Returns a Report in
+    the graft-lint mold: ``report.ok`` is the exit status, findings carry
+    rule/ident for baseline suppression."""
+    report = Report(meta={"tool": "perf-doctor", "program": program,
+                          "step_span_ms": diag.get("step_span_ms")})
+    span = diag.get("step_span_ms") or 0.0
+    exposed = diag.get("exposed_comm_ms") or 0.0
+    if span > 0 and exposed / span > max_exposed_fraction:
+        report.extend([Finding(
+            rule="exposed-collective-measured",
+            message=(f"measured exposed collective time {exposed:.3f} ms is "
+                     f"{exposed / span:.1%} of the {span:.3f} ms step "
+                     f"(budget {max_exposed_fraction:.0%}) — the scheduler "
+                     "is not hiding this wire time under compute"),
+            program=program, ident="exposed",
+            data={"exposed_comm_ms": exposed, "step_span_ms": span})])
+    div = diag.get("exposed_comm_divergence")
+    if div is not None and div > DIVERGENCE_TOLERANCE:
+        report.extend([Finding(
+            rule="modeled-measured-divergence", severity="warning",
+            message=(f"measured exposed-comm {exposed:.3f} ms vs modeled "
+                     f"{diag.get('modeled_exposed_comm_ms'):.3f} ms diverge "
+                     f"{div:.0%} (> {DIVERGENCE_TOLERANCE:.0%}) — the "
+                     "overlap model or the interconnect pricing is off"),
+            program=program, ident="divergence",
+            data={"divergence": div})])
+    if baseline:
+        base_buckets = baseline.get("buckets", {})
+        for name, stat in diag.get("buckets", {}).items():
+            base = base_buckets.get(name)
+            if base is None:
+                continue
+            cur_f, base_f = stat["fraction"], base.get("fraction", 0.0)
+            if (cur_f - base_f > REGRESSION_ABS
+                    and cur_f > base_f * (1 + REGRESSION_REL)):
+                report.extend([Finding(
+                    rule="stall-regression",
+                    message=(f"bucket '{name}' grew to {cur_f:.1%} of the "
+                             f"step (baseline {base_f:.1%}) — attribution "
+                             "regression"),
+                    program=program, ident=name,
+                    data={"fraction": cur_f, "baseline": base_f})])
+    return report
+
+
+def baseline_dict(diag: Dict[str, Any]) -> Dict[str, Any]:
+    return {"buckets": diag.get("buckets", {}),
+            "stall_top2": diag.get("stall_top2", []),
+            "exposed_comm_ms": diag.get("exposed_comm_ms", 0.0),
+            "step_span_ms": diag.get("step_span_ms", 0.0)}
+
+
+def stall_fields(diag: Dict[str, Any], suffix: str) -> Dict[str, Any]:
+    """The bench-JSON fields: stall_top2_<suffix> = [{bucket, ms,
+    fraction}, ...] (fraction is of step_span_ms)."""
+    return {f"stall_top2_{suffix}": [
+        {"bucket": s["bucket"], "ms": s["ms"], "fraction": s["fraction"]}
+        for s in diag.get("stall_top2", [])]}
+
+
+# --------------------------------------------------------------------------
+# seeded corpus
+# --------------------------------------------------------------------------
+
+def synthetic_exposed_collective_trace() -> Dict[str, Any]:
+    """A trace with an artificially exposed collective: 10 ms of matmul,
+    then an 8 ms all-reduce with NOTHING scheduled under it. Attribution
+    must price the full 8 ms as exposed and the doctor gate must fire."""
+    evs = [
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 10_000.0,
+         "name": "dot.1", "args": {"hlo_op": "dot.1"}},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 2_000.0, "dur": 1_000.0,
+         "name": "fusion.2", "args": {"hlo_op": "fusion.2"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 10_050.0, "dur": 8_000.0,
+         "name": "all-reduce.3", "args": {"hlo_op": "all-reduce.3"}},
+    ]
+    return {"displayTimeUnit": "ms", "traceEvents": evs}
+
+
+def run_corpus_entry() -> Report:
+    """The ``doctor`` corpus entry (analysis.corpus wires it into the lint
+    --corpus runner): the seeded exposed collective MUST fire the gate."""
+    diag = diagnose(synthetic_exposed_collective_trace())
+    return gate(diag, program="exposed_collective_trace")
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _load_json(path: str) -> Dict[str, Any]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def _load_text(path: str) -> str:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return f.read()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.profiling.doctor",
+        description="Stall attribution + CI gate over a jax.profiler traced "
+                    "step (see profiling/capture.py for producing one).")
+    p.add_argument("--trace", help="trace artifact (.json or .json.gz, "
+                                   "Chrome-trace format)")
+    p.add_argument("--hlo", help="compiled step program text (the "
+                                 "trace_<tag>.hlo.txt.gz written next to "
+                                 "the artifact) for the scope/census join")
+    p.add_argument("--steps", type=int, default=None,
+                   help="engine steps inside the capture window (default: "
+                        "the artifact's recorded metadata.steps, else 1)")
+    p.add_argument("--modeled-exposed-ms", type=float, default=None,
+                   help="modeled exposed_comm_ms from the telemetry overlap "
+                        "join, for the divergence cross-check")
+    p.add_argument("--max-exposed-frac", type=float,
+                   default=MAX_EXPOSED_COMM_FRACTION)
+    p.add_argument("--json", dest="json_out", metavar="PATH",
+                   help="write the diagnosis JSON to PATH ('-' for stdout)")
+    p.add_argument("--baseline", help="baseline JSON: gate bucket fractions "
+                                      "against it")
+    p.add_argument("--write-baseline", metavar="PATH",
+                   help="accept the current attribution and exit 0")
+    p.add_argument("--corpus", help="run a seeded known-bad entry instead "
+                                    "of a trace (doctor gate self-test)")
+    args = p.parse_args(argv)
+
+    if args.corpus:
+        if args.corpus not in ("exposed-collective-trace", "doctor"):
+            p.error("unknown doctor corpus entry "
+                    f"'{args.corpus}' — use exposed-collective-trace")
+        report = run_corpus_entry()
+        print(report.summary(), file=sys.stderr)
+        return 0 if report.ok else 1
+    if not args.trace:
+        p.error("--trace (or --corpus) is required")
+
+    trace = _load_json(args.trace)
+    hlo_path = args.hlo
+    if hlo_path is None:
+        guess = args.trace.replace(".json.gz", ".hlo.txt.gz") \
+                          .replace(".json", ".hlo.txt.gz")
+        hlo_path = guess if os.path.exists(guess) else None
+    hlo_text = _load_text(hlo_path) if hlo_path else ""
+    steps = args.steps
+    if steps is None:   # an explicit --steps wins over the recorded value
+        meta = trace.get("metadata") if isinstance(trace, dict) else None
+        steps = int(meta["steps"]) if meta and meta.get("steps") else 1
+    diag = diagnose(trace, hlo_text, steps=steps,
+                    modeled_exposed_comm_ms=args.modeled_exposed_ms)
+    baseline = _load_json(args.baseline) if args.baseline else None
+    report = gate(diag, baseline=baseline,
+                  max_exposed_fraction=args.max_exposed_frac,
+                  program=os.path.basename(args.trace))
+
+    print(report.summary(), file=sys.stderr)
+    top = ", ".join(f"{s['bucket']}={s['ms']:.2f}ms({s['fraction']:.0%})"
+                    for s in diag["stall_top2"]) or "none"
+    print(f"doctor: step {diag['step_span_ms']:.3f} ms, device busy "
+          f"{diag['device_busy_ms']:.3f} ms, top stalls: {top}",
+          file=sys.stderr)
+    if args.json_out:
+        payload = dict(diag)
+        payload["findings"] = [f.to_dict() for f in report.findings]
+        payload["ok"] = report.ok
+        text = json.dumps(payload, indent=2, default=str)
+        if args.json_out == "-":
+            print(text)
+        else:
+            with open(args.json_out, "w") as f:
+                f.write(text + "\n")
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as f:
+            json.dump(baseline_dict(diag), f, indent=2)
+        print(f"doctor: baseline written to {args.write_baseline}",
+              file=sys.stderr)
+        return 0
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

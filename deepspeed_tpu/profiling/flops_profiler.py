@@ -116,7 +116,19 @@ def profile_jaxpr(jaxpr, *, scale: int = 1,
     total = 0
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
-        if prim == "scan":
+        if prim == "pallas_call":
+            # the kernel body jaxpr describes ONE grid program; the launch
+            # executes it prod(grid) times (sparse/flash attention express
+            # their block loop through the grid, so counting the body once
+            # reported ~zero attention FLOPs — the r6 coverage gap)
+            gm = eqn.params.get("grid_mapping")
+            grid = _prod(getattr(gm, "grid", ()) or (1,))
+            inner = eqn.params["jaxpr"]
+            inner = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            t, _, _ = profile_jaxpr(inner, scale=scale * grid, by=by,
+                                    by_scope=by_scope)
+            total += t * grid
+        elif prim == "scan":
             inner = eqn.params["jaxpr"].jaxpr
             length = int(eqn.params["length"])
             t, _, _ = profile_jaxpr(inner, scale=scale * length, by=by,
@@ -163,7 +175,9 @@ def _eqn_scope(eqn) -> str:
     st = eqn.source_info.name_stack
     s = str(st) if st is not None else ""
     if s:
-        return s.split("/")[0] if "/" in s else s
+        # keep two scope levels ("layers/attn") — the same aggregation key
+        # trace_analysis.scope_root uses, so the measured join lines up
+        return "/".join(s.split("/")[:2])
     tb = eqn.source_info.traceback
     if tb is not None:
         frames = tb.frames if hasattr(tb, "frames") else []
@@ -207,6 +221,61 @@ def get_model_profile(fn: Callable, *args, backend_analysis: bool = True,
         except Exception as e:  # pragma: no cover - backend-specific
             logger.debug(f"backend cost analysis unavailable: {e!r}")
     return out
+
+
+def measured_module_profile(engine, batch, *, steps: int = 1,
+                            out_dir: str = "") -> Optional[Dict[str, Any]]:
+    """Measured per-module latency + achieved FLOPS from a real traced step.
+
+    The analytic tables above say what the program SHOULD cost; this runs
+    the engine's own jitted step under ``jax.profiler`` (profiling/capture)
+    and joins the trace's per-named-scope device time with the analytic
+    per-scope FLOPs — the reference flops profiler's latency column, fed by
+    a hardware trace instead of host-side module timers. Returns None when
+    the platform yields no trace (callers degrade)."""
+    from deepspeed_tpu.profiling.capture import capture_traced_step
+    res = capture_traced_step(engine, batch, out_dir, tag="flops",
+                              steps=steps)
+    if res is None:
+        return None
+    attr = res.attribution()
+    # analytic per-scope fwd flops of the same model (loss_fn jaxpr walk)
+    flops_by_scope: Dict[str, int] = {}
+    try:
+        state, rng = engine.state, jax.random.PRNGKey(0)
+        closed = jax.make_jaxpr(
+            lambda p, bt, r: engine.model.loss_fn(p, bt, r, False))(
+            state["params"], batch, rng)
+        _, _, flops_by_scope = profile_jaxpr(closed.jaxpr)
+    except Exception as e:  # noqa: BLE001 - join degrades to latency-only
+        logger.debug(f"measured profile: analytic join unavailable: {e!r}")
+    modules = []
+    for scope, ms in sorted(attr.by_scope_ms.items(), key=lambda kv: -kv[1]):
+        # measured keys carry engine phases + bwd markers the analytic
+        # (forward-only) table doesn't: grads/layers[bwd] -> layers
+        is_bwd = scope.endswith("[bwd]")
+        bare = scope.removesuffix("[bwd]")
+        for prefix in ("grads/", "optimizer/"):
+            bare = bare.removeprefix(prefix)
+        row: Dict[str, Any] = {"module": scope,
+                               "measured_ms": round(ms, 3)}
+        fl = flops_by_scope.get(bare) or flops_by_scope.get(
+            bare.split("/")[0])
+        if fl and ms > 0 and not is_bwd:
+            # fwd rows only: the analytic walk covers the forward pass, so
+            # dividing it by backward device time would understate bwd
+            # throughput ~2-3x and mislead exactly the table meant to
+            # guide perf work
+            row["analytic_fwd_flops"] = int(fl)
+            row["achieved_tflops"] = round(fl / (ms / 1e3) / 1e12, 4)
+        modules.append(row)
+    return {"modules": modules,
+            "buckets": attr.buckets,
+            "step_span_ms": round(attr.step_span_ms, 4),
+            "device_busy_ms": round(attr.device_busy_ms, 4),
+            "fwd_ms": round(attr.fwd_ms, 4),
+            "bwd_ms": round(attr.bwd_ms, 4),
+            "trace_artifact": res.artifact_path}
 
 
 def _fmt_flops(f: float) -> str:
@@ -267,6 +336,13 @@ class FlopsProfiler:
         peak = accel.peak_flops_per_device("bf16") * max(1, jax.device_count())
         prof["achieved_tflops"] = prof["train_flops_estimate"] / dt / 1e12
         prof["mfu"] = prof["train_flops_estimate"] / dt / peak
+        if getattr(self.cfg, "measure_trace", False):
+            try:
+                prof["measured"] = measured_module_profile(
+                    engine, batch, out_dir=self.cfg.trace_dir)
+            except Exception as e:  # noqa: BLE001 - measured tier degrades
+                logger.warning(f"flops profiler: measured trace tier "
+                               f"failed: {e!r}")
         self.profile = prof
         report = self.format_report(prof)
         if self.cfg.output_file:
@@ -300,5 +376,15 @@ class FlopsProfiler:
             lines.append("per-primitive fwd flops:")
             for k, v in list(prof["flops_by_primitive"].items())[:8]:
                 lines.append(f"  {k:<40} {_fmt_flops(v)}")
+        measured = prof.get("measured")
+        if measured:
+            lines.append(f"measured (traced step, "
+                         f"{measured['step_span_ms']:.2f} ms span, device "
+                         f"busy {measured['device_busy_ms']:.2f} ms):")
+            for row in measured["modules"][:10]:
+                extra = (f"  {row['achieved_tflops']:.3f} TFLOPS"
+                         if "achieved_tflops" in row else "")
+                lines.append(f"  {row['module']:<36} "
+                             f"{row['measured_ms']:>9.3f} ms{extra}")
         lines.append("-" * 84)
         return "\n".join(lines)

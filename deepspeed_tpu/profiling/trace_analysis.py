@@ -1,0 +1,425 @@
+"""Device-time stall attribution from a ``jax.profiler`` traced step.
+
+The profiler's TensorBoard dump contains a Chrome-trace JSON
+(``*.trace.json.gz``) whose complete events carry ``args.hlo_op`` — the
+instruction name of the executed HLO op. The compiled step program's text
+carries ``metadata={op_name="jit(step)/.../layer/attn/dot_general"}`` per
+instruction, and ``jax.named_scope`` annotations (models/transformer.py,
+runtime/engine.py) land verbatim in that path. Joining the two recovers,
+for every microsecond of device time, *which op kind* ran and *which model
+scope* it belongs to — the measurement half the analytic flops profiler and
+the static overlap audit cannot provide.
+
+Buckets (the taxonomy every consumer — doctor CLI, bench JSON, dryrun line
+— reports):
+
+  * ``matmul``       — dot/convolution ops (and fusions rooted on one)
+                       outside an attention scope
+  * ``attention``    — any op under an ``attn`` named scope (flash/sparse
+                       custom calls, softmax chains, QKV/O projections)
+  * ``elementwise``  — everything else that computes (fusions, reduces,
+                       converts, scatter/gather)
+  * ``collective``   — all-reduce/all-gather/reduce-scatter/all-to-all/
+                       collective-permute (sync or start/done pairs)
+  * ``host-stall``   — infeed/outfeed/host transfers: device time spent
+                       waiting on (or moving data to/from) the host
+  * ``dispatch-gap`` — wall time inside the step span when NO device op was
+                       executing: the device idled waiting for dispatch
+
+Attribution is interval arithmetic over the event timeline, so the numbers
+are wall-true: ``device_busy_ms`` is the union of op intervals (parallel
+executor threads don't double-count), ``dispatch_gap_ms`` is span minus
+busy, and ``exposed_comm_ms`` is collective time NOT covered by concurrent
+compute — the measured counterpart of the static OverlapAudit's modeled
+``telemetry/exposed_comm_ms``.
+"""
+
+import dataclasses
+import gzip
+import json
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+BUCKETS = ("matmul", "attention", "elementwise", "collective",
+           "host-stall", "dispatch-gap")
+
+# kinds whose events join against the graft-lint collective census
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute", "collective-broadcast")
+
+_HOST_OPS = ("infeed", "outfeed", "copy-start", "copy-done", "send", "recv",
+             "host")
+
+# trace-event names that are profiler/executor bookkeeping, not device work
+_NOISE = ("ThreadpoolListener", "ThunkExecutor", "TfrtCpu", "ParseArguments",
+          "PjitFunction", "start_trace", "stop_trace", "BufferFromHost",
+          "ExecuteHelper", "Await", "thunk.")
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Load a Chrome-trace JSON (optionally gzipped) into a dict."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+# --------------------------------------------------------------------------
+# HLO metadata join
+# --------------------------------------------------------------------------
+
+_HLO_META_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=.*?op_name=\"([^\"]+)\"", re.M)
+
+
+def parse_hlo_scopes(hlo_text: str) -> Dict[str, str]:
+    """{instruction name -> op_name metadata path} over a compiled module.
+
+    Instruction names in the trace events match the module text modulo
+    executor-added suffixes (``.clone``, ``.remat``) which the join strips.
+    """
+    return {m.group(1): m.group(2) for m in _HLO_META_RE.finditer(hlo_text)}
+
+
+_WRAPPER_RE = re.compile(r"^(?:transpose|jvp|vmap|remat|checkpoint)\((.*)\)$")
+
+
+def _unwrap(seg: str) -> str:
+    """transpose(jvp(layers)) -> layers: autodiff wrappers embed the user
+    scope they transformed — keep it, the wrapper itself is the fwd/bwd
+    marker, not the location."""
+    while True:
+        m = _WRAPPER_RE.match(seg)
+        if not m:
+            return seg
+        seg = m.group(1)
+
+
+def normalize_scope(op_name: str) -> Tuple[Tuple[str, ...], bool]:
+    """op_name metadata -> (scope path without jit()/transpose wrappers,
+    is_backward). Backward ops carry ``transpose(jvp(...))`` in the path."""
+    is_bwd = "transpose(" in op_name
+    parts = []
+    for seg in op_name.split("/"):
+        seg = _unwrap(seg)
+        if not seg or seg.startswith("jit(") \
+                or seg.startswith("rematted_computation") \
+                or seg == "checkpoint":
+            continue
+        parts.append(seg)
+    return tuple(parts), is_bwd
+
+
+def scope_root(op_name: str, depth: int = 3) -> str:
+    """First `depth` user-scope segments — the per-module aggregation key
+    ("grads/layers/attn", "optimizer", ...). Depth 3 keeps the model's
+    attn/mlp split visible under the engine's grads phase scope. The
+    trailing primitive name is dropped when deeper context exists."""
+    parts, is_bwd = normalize_scope(op_name)
+    if len(parts) > 1:
+        parts = parts[:-1]  # drop the primitive leaf (dot_general, ...)
+    key = "/".join(parts[:depth]) or "<unattributed>"
+    return key + ("[bwd]" if is_bwd else "")
+
+
+# --------------------------------------------------------------------------
+# bucket classification
+# --------------------------------------------------------------------------
+
+def collective_kind(hlo_op: str) -> Optional[str]:
+    base = hlo_op.split(".")[0].removesuffix("-start").removesuffix("-done")
+    for kind in COLLECTIVE_KINDS:
+        if base == kind or base == kind.replace("-", "_"):
+            return kind
+    return None
+
+
+def bucket_of(hlo_op: str, scope: str = "") -> str:
+    """Classify one device op into the attribution taxonomy."""
+    base = hlo_op.split(".")[0].lower()
+    if collective_kind(hlo_op):
+        return "collective"
+    if any(base.startswith(h) for h in _HOST_OPS):
+        return "host-stall"
+    if "attn" in scope or "attention" in scope or \
+            "flash" in base or "attention" in base:
+        return "attention"
+    if base.startswith(("dot", "convolution", "conv", "cublas", "gemm",
+                        "einsum")):
+        return "matmul"
+    if base.startswith("fusion") and ("dot" in scope or "einsum" in scope):
+        return "matmul"
+    return "elementwise"
+
+
+# --------------------------------------------------------------------------
+# interval arithmetic
+# --------------------------------------------------------------------------
+
+def merge_intervals(ivs: Iterable[Tuple[float, float]]
+                    ) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(ivs):
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def interval_total(ivs: List[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in ivs)
+
+
+def subtract_intervals(a: List[Tuple[float, float]],
+                       b: List[Tuple[float, float]]
+                       ) -> List[Tuple[float, float]]:
+    """Portions of (merged) `a` not covered by (merged) `b`."""
+    out: List[Tuple[float, float]] = []
+    for s, e in a:
+        cur = s
+        for bs, be in b:
+            if be <= cur:
+                continue
+            if bs >= e:
+                break
+            if bs > cur:
+                out.append((cur, min(bs, e)))
+            cur = max(cur, be)
+            if cur >= e:
+                break
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+# --------------------------------------------------------------------------
+# attribution
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Attribution:
+    """Machine-readable diagnosis of one traced step (all times ms,
+    normalized per step when the capture spanned several)."""
+    step_span_ms: float = 0.0          # first-to-last device event wall span
+    device_busy_ms: float = 0.0        # union of device op intervals
+    buckets: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)          # bucket -> {ms, count, fraction}
+    by_scope_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    fwd_ms: float = 0.0
+    bwd_ms: float = 0.0
+    exposed_comm_ms: float = 0.0       # collective time NOT under compute
+    collectives: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)          # per-kind join vs the static census
+    steps: int = 1
+    joined_ops: int = 0                # events matched to HLO metadata
+    total_ops: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def device_events(trace: Any) -> List[Dict[str, Any]]:
+    """Select the executed-HLO complete events out of a raw trace.
+
+    Device rows are identified by ``args.hlo_op`` (CPU + TPU emit it) or, on
+    TPU dumps, by a ``/device:`` process whose thread runs XLA ops. Host
+    Python/runtime rows and profiler bookkeeping are dropped.
+    """
+    if isinstance(trace, dict):
+        events = trace.get("traceEvents", [])
+    else:
+        events = list(trace)
+    dev_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = (e.get("args") or {}).get("name", "")
+            if "/device:" in pname and "CPU" not in pname:
+                dev_pids.add(e.get("pid"))
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("dur", 0) <= 0:
+            continue
+        name = e.get("name", "")
+        if any(n in name for n in _NOISE):
+            continue
+        args = e.get("args") or {}
+        if "hlo_op" in args or (dev_pids and e.get("pid") in dev_pids):
+            out.append(e)
+    return out
+
+
+def attribute(trace: Any, scope_map: Optional[Dict[str, str]] = None, *,
+              steps: int = 1) -> Attribution:
+    """Bucket a traced step's device time. ``scope_map`` (parse_hlo_scopes
+    of the same compiled program) upgrades fusion/op classification with
+    named-scope context; without it the op-kind heuristics still hold."""
+    scope_map = scope_map or {}
+    evs = device_events(trace)
+    attr = Attribution(steps=max(1, int(steps)))
+    attr.total_ops = len(evs)
+    if not evs:
+        return attr
+    k = attr.steps
+    bucket_ms: Dict[str, float] = {}
+    bucket_n: Dict[str, int] = {}
+    comm_by_kind: Dict[str, Dict[str, float]] = {}
+    all_ivs: List[Tuple[float, float]] = []
+    compute_ivs: List[Tuple[float, float]] = []
+    comm_ivs: List[Tuple[float, float]] = []
+    for e in evs:
+        hlo_op = (e.get("args") or {}).get("hlo_op") or e.get("name", "")
+        base = hlo_op.removesuffix(".clone").removesuffix(".remat")
+        scope = scope_map.get(base) or scope_map.get(hlo_op) or ""
+        if scope:
+            attr.joined_ops += 1
+        b = bucket_of(hlo_op, scope)
+        dur_ms = e["dur"] / 1e3
+        ts, te = e["ts"], e["ts"] + e["dur"]
+        bucket_ms[b] = bucket_ms.get(b, 0.0) + dur_ms
+        bucket_n[b] = bucket_n.get(b, 0) + 1
+        all_ivs.append((ts, te))
+        if b == "collective":
+            comm_ivs.append((ts, te))
+            kind = collective_kind(hlo_op) or "collective"
+            kk = comm_by_kind.setdefault(kind, {"ms": 0.0, "count": 0})
+            kk["ms"] += dur_ms
+            kk["count"] += 1
+        elif b != "host-stall":
+            compute_ivs.append((ts, te))
+        if scope:
+            key = scope_root(scope)
+            attr.by_scope_ms[key] = attr.by_scope_ms.get(key, 0.0) + dur_ms
+            if normalize_scope(scope)[1]:
+                attr.bwd_ms += dur_ms
+            else:
+                attr.fwd_ms += dur_ms
+    merged = merge_intervals(all_ivs)
+    span_ms = (merged[-1][1] - merged[0][0]) / 1e3
+    busy_ms = interval_total(merged) / 1e3
+    attr.step_span_ms = span_ms / k
+    attr.device_busy_ms = busy_ms / k
+    gap_ms = max(0.0, span_ms - busy_ms)
+    bucket_ms["dispatch-gap"] = gap_ms
+    bucket_n["dispatch-gap"] = max(0, len(merged) - 1)
+    exposed = subtract_intervals(merge_intervals(comm_ivs),
+                                 merge_intervals(compute_ivs))
+    attr.exposed_comm_ms = interval_total(exposed) / 1e3 / k
+    attr.fwd_ms /= k
+    attr.bwd_ms /= k
+    denom = max(span_ms, 1e-9)
+    for b in sorted(bucket_ms, key=lambda b_: -bucket_ms[b_]):
+        attr.buckets[b] = {
+            "ms": round(bucket_ms[b] / k, 4),
+            "count": bucket_n.get(b, 0),
+            "fraction": round(bucket_ms[b] / denom, 4),
+        }
+    attr.collectives = [
+        {"kind": kind, "ms": round(v["ms"] / k, 4), "count": int(v["count"])}
+        for kind, v in sorted(comm_by_kind.items(), key=lambda kv: -kv[1]["ms"])]
+    return attr
+
+
+def join_census(attr: Attribution,
+                census: Dict[str, Dict[str, int]]) -> List[Dict[str, Any]]:
+    """Join measured per-kind collective time against the graft-lint static
+    census (kind -> {count, bytes}) of the same compiled step. The measured
+    count covering a start/done pair as 2 events is normalized by the
+    census' own count; missing kinds are reported with measured 0."""
+    joined = []
+    measured = {c["kind"]: c for c in attr.collectives}
+    for kind in sorted(set(census) | set(measured)):
+        stat = census.get(kind, {})
+        m = measured.get(kind, {"ms": 0.0, "count": 0})
+        joined.append({
+            "kind": kind,
+            "measured_ms": round(float(m["ms"]), 4),
+            "measured_count": int(m["count"]),
+            "census_count": int(stat.get("count", 0)),
+            "census_bytes": int(stat.get("bytes", 0)),
+        })
+    return joined
+
+
+# --------------------------------------------------------------------------
+# roofline classification + stall ranking
+# --------------------------------------------------------------------------
+
+def classify_bounds(attr: Attribution, cost: Optional[Dict[str, Any]] = None,
+                    *, peak_flops: float = 0.0,
+                    hbm_bytes_per_sec: float = 0.0) -> Dict[str, str]:
+    """Per-bucket compute-bound / memory-bound / exposed-comm / host / idle
+    verdicts. The compute buckets use the whole-program roofline (XLA
+    cost_analysis flops + bytes vs chip peak and HBM bandwidth): achieved
+    intensity below the machine balance point means the bucket's time is
+    bandwidth, not MXU. Collectives are exposed-comm when their measured
+    exposed time is a material fraction of their total, idle otherwise
+    (fully hidden wire is not a stall)."""
+    out: Dict[str, str] = {}
+    intensity = None
+    balance = None
+    if cost and cost.get("flops_per_step") and cost.get(
+            "bytes_accessed_per_step"):
+        intensity = cost["flops_per_step"] / max(
+            1, cost["bytes_accessed_per_step"])
+    if peak_flops > 0 and hbm_bytes_per_sec > 0:
+        balance = peak_flops / hbm_bytes_per_sec
+    for b in attr.buckets:
+        if b in ("matmul", "attention"):
+            if intensity is not None and balance is not None:
+                out[b] = ("compute-bound" if intensity >= balance
+                          else "memory-bound")
+            else:
+                out[b] = "compute-bound"
+        elif b == "elementwise":
+            out[b] = "memory-bound"
+        elif b == "collective":
+            total = attr.buckets[b]["ms"]
+            out[b] = ("exposed-comm"
+                      if total > 0 and attr.exposed_comm_ms > 0.25 * total
+                      else "overlapped-comm")
+        elif b == "host-stall":
+            out[b] = "host-bound"
+        else:
+            out[b] = "idle"
+    return out
+
+
+# buckets that are pure execution-efficiency (the MXU doing its job) and so
+# never *stall* attribution candidates; every other bucket's time is the
+# step not computing at peak
+_NON_STALL = {"compute-bound", "overlapped-comm"}
+
+
+def stall_ranking(attr: Attribution, bounds: Optional[Dict[str, str]] = None
+                  ) -> List[Dict[str, Any]]:
+    """Buckets ranked by stall time: everything whose roofline verdict is
+    not compute-bound (memory-bound compute still counts — it is the thing
+    a fused kernel would fix), with the collective bucket priced at its
+    MEASURED exposed time only."""
+    bounds = bounds or classify_bounds(attr)
+    rows = []
+    for b, stat in attr.buckets.items():
+        verdict = bounds.get(b, "")
+        if verdict in _NON_STALL:
+            continue
+        ms = stat["ms"]
+        if b == "collective":
+            ms = attr.exposed_comm_ms
+            if ms <= 0:
+                continue
+        if ms <= 0:
+            continue
+        rows.append({
+            "bucket": b,
+            "ms": round(ms, 4),
+            "fraction": round(ms / max(attr.step_span_ms, 1e-9), 4),
+            "bound": verdict,
+        })
+    rows.sort(key=lambda r: -r["ms"])
+    return rows
+
+
+def stall_top2(attr: Attribution, bounds: Optional[Dict[str, str]] = None
+               ) -> List[Dict[str, Any]]:
+    return stall_ranking(attr, bounds)[:2]

@@ -1196,9 +1196,14 @@ class Engine:
             return mean_loss, grads
 
         def train_step(state, batch, rng):
-            """One full optimizer step over `gas` microbatches."""
-            mean_loss, grads = batch_grads(state, batch, rng)
-            return apply_grads(state, grads, mean_loss)
+            """One full optimizer step over `gas` microbatches. The named
+            scopes land in the compiled program's op_name metadata — the
+            perf doctor's trace join reads them to split device time into
+            grad-compute vs optimizer phases."""
+            with jax.named_scope("grads"):
+                mean_loss, grads = batch_grads(state, batch, rng)
+            with jax.named_scope("optimizer"):
+                return apply_grads(state, grads, mean_loss)
 
         # raw (unjitted) step for the fused K-step program; recompiles
         # (Random-LTD/act-quant rebuilds) invalidate any cached fusions

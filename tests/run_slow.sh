@@ -56,6 +56,10 @@ for m in "${modules[@]}"; do
     budget="$BUDGET"
     case "$m" in
         *test_chaos*) budget="${CHAOS_BUDGET:-900}" ;;
+        # real jax.profiler captures: 3 engine builds + a profiled fp16
+        # parity run; the profiler start/stop and trace export are wall
+        # time the other suites don't pay
+        *test_trace_analysis*) budget="${TRACE_BUDGET:-420}" ;;
     esac
     t0=$(date +%s)
     out=$(timeout -k 10 "$budget" \
