@@ -103,7 +103,8 @@ def _count_params(cfg) -> int:
     return L * (attn + mlp + norms) + embed + h
 
 
-def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable"):
+def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable",
+              fused_backward=False, fuse_steps=1):
     import jax
     import deepspeed_tpu
     from deepspeed_tpu.models import llama_config, make_model
@@ -120,7 +121,10 @@ def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable"):
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},
         # async step pipeline: bounded dispatch window + input prefetch
-        "pipeline": {"in_flight": 4, "prefetch": True},
+        "pipeline": {"in_flight": 4, "prefetch": True,
+                     **({"fuse_steps": fuse_steps} if fuse_steps > 1 else {})},
+        # fused attention backward (delta epilogue inside the Pallas grids)
+        "transformer": {"fused_backward": bool(fused_backward)},
         "steps_per_print": 1000000,
     })
 
@@ -139,6 +143,11 @@ def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable"):
         return int(np.asarray(jax.device_get(engine.state["step"])))
 
     engine.train_batch(make_batch())
+    if fuse_steps > 1:
+        # the fused K-step program is a SECOND jit the timed loop will
+        # dispatch — compile it outside the window too
+        engine.train_batches((make_batch() for _ in range(fuse_steps)),
+                             fuse_steps)
     sync()
 
     # async path (the headline step_ms): train_batches keeps
@@ -168,7 +177,7 @@ def _try_rung(size, S, B, nsteps, chunk=None, remat="dots_saveable"):
 
 def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
               batch: int = None, steps: int = None, chunk: int = None,
-              remat: str = "dots_saveable"):
+              remat: str = "auto"):
     import jax
     from deepspeed_tpu.accelerator import get_accelerator
     from deepspeed_tpu.models import llama_config
@@ -176,6 +185,14 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
     accel = get_accelerator()
     on_tpu = accel.platform not in ("cpu",)
     hbm = accel.hbm_bytes()
+
+    # the levers this round ships (ISSUE 8): fused attention backward is on
+    # for every headline rung; the remat policy (and fused multi-step K)
+    # comes from the measured in-bench sweep when --remat auto (default).
+    fused_backward = True
+    fuse_steps = 1
+    sweep_fields = {}
+    est_remat = remat if remat != "auto" else "dots_saveable"
 
     if model_size:  # explicit override: single rung, no ladder
         ladder = [(model_size, seq or 2048, batch or 8)]
@@ -186,18 +203,63 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
         for size, S, B in LADDER:
             cfg = llama_config(size, max_seq_len=S)
             est = estimate_resident_bytes(cfg, _count_params(cfg), B, S,
-                                          chunk=chunk, remat=remat)
+                                          chunk=chunk, remat=est_remat)
             if est <= 0.90 * hbm:
                 ladder.append((size, S, B))
         if not ladder:
             ladder = [LADDER[-1]]
     nsteps = steps or (10 if (quick or not on_tpu) else 20)
 
+    if remat == "auto":
+        remat = "dots_saveable"
+        if not model_size and not quick:
+            # measured remat-policy x fuse_steps sweep on the rung the
+            # ladder picked (statically pruned by RematAudit + MemoryLint
+            # before any candidate runs); the winner becomes the headline
+            # policy and is recorded in the JSON
+            try:
+                size0, S0, B0 = ladder[0]
+                if not on_tpu:   # CPU smoke: tiny shapes, same code path
+                    size0, S0, B0 = "tiny", 512, 4
+                sweep_fields, win_policy, win_fuse = _remat_sweep_bench(
+                    size0, S0, B0, hbm, small=not on_tpu)
+                if on_tpu:
+                    # the sweep timed the REAL headline rung — ship its
+                    # winner. The CPU smoke sweeps a tiny proxy model whose
+                    # winner does not transfer across shapes (observed:
+                    # proxy save_nothing/fuse2 degrading the real rung), so
+                    # there it only records the table.
+                    remat, fuse_steps = win_policy, win_fuse
+                # whether the headline number was produced UNDER the winner
+                # (flipped off by the OOM-retry below) — applied_levers is
+                # always authoritative for what actually ran
+                sweep_fields["remat_sweep_winner_applied"] = on_tpu
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: remat sweep failed: {e}", file=sys.stderr)
+
     last_err = None
     for size, S, B in ladder:
         try:
-            cfg, engine, n_params, dt, extras = _try_rung(
-                size, S, B, nsteps, chunk=chunk, remat=remat)
+            try:
+                cfg, engine, n_params, dt, extras = _try_rung(
+                    size, S, B, nsteps, chunk=chunk, remat=remat,
+                    fused_backward=fused_backward, fuse_steps=fuse_steps)
+            except Exception as e:  # noqa: BLE001 — sweep-winner OOM
+                # an OOM the sweep's 92% modeled-HBM prune missed must cost
+                # the optional lever, not a model-size rung: retry the SAME
+                # shape on the safe policy before stepping down the ladder
+                if not _is_oom(e) or (remat == "dots_saveable"
+                                      and fuse_steps == 1):
+                    raise
+                print(f"bench: llama-{size} seq={S} bs={B} OOM'd with "
+                      f"remat={remat}/fuse{fuse_steps}; retrying with "
+                      "dots_saveable/fuse1", file=sys.stderr)
+                gc.collect()
+                remat, fuse_steps = "dots_saveable", 1
+                sweep_fields["remat_sweep_winner_applied"] = False
+                cfg, engine, n_params, dt, extras = _try_rung(
+                    size, S, B, nsteps, chunk=chunk, remat=remat,
+                    fused_backward=fused_backward, fuse_steps=fuse_steps)
         except Exception as e:  # noqa: BLE001 — OOM ladder fallback
             if _is_oom(e):
                 print(f"bench: llama-{size} seq={S} bs={B} OOM'd; stepping down",
@@ -216,6 +278,12 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
             "vs_baseline": round(mfu / 0.45, 4),
             "tokens_per_sec_per_chip": round(tok_per_sec / max(1, jax.device_count()), 1),
             "step_ms": round(dt / nsteps * 1000, 2),
+            # the perf levers actually applied to this headline number —
+            # the acceptance contract names them next to the MFU they moved
+            "applied_levers": (["fused_backward", f"remat:{remat}"]
+                               + ([f"fuse_steps:{fuse_steps}"]
+                                  if fuse_steps > 1 else [])),
+            **sweep_fields,
             **extras,
         }
         if on_tpu and not (quick or model_size):
@@ -241,7 +309,8 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: kernel parity smoke failed: {e}", file=sys.stderr)
             try:
-                result["seq8k_mfu"] = _long_seq_bench(size)
+                result["seq8k_mfu"] = _long_seq_bench(
+                    size, remat=remat, fused_backward=fused_backward)
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: seq-8k bench failed: {e}", file=sys.stderr)
             gc.collect()
@@ -362,6 +431,40 @@ def _stall_attribution_bench(size: str, bench_dir: str = None,
         if d.get("exposed_comm_divergence") is not None:
             out[f"exposed_comm_divergence_{suffix}"] = \
                 d["exposed_comm_divergence"]
+        # refresh the doctor baseline from THIS (post-optimization) trace:
+        # the next `doctor --trace T --baseline <path>` gates stall-
+        # regression against the fractions the shipped levers produce, not
+        # a stale pre-lever attribution. Ratchet, don't clobber: when a
+        # previous baseline exists and the new attribution REGRESSES
+        # against it, the old baseline is kept (refreshing from the very
+        # trace a later doctor run gates would let every regression
+        # silently re-baseline itself) — accept a known regression
+        # explicitly with `doctor --trace T --write-baseline <path>`.
+        try:
+            from deepspeed_tpu.profiling.doctor import baseline_dict, gate
+            bpath = os.path.join(bench_dir, f"doctor_baseline_{suffix}.json")
+            refreshed = True
+            if os.path.exists(bpath):
+                # only a stall-REGRESSION vs the old baseline blocks the
+                # refresh — gate().ok would also veto on the absolute
+                # exposed-collective budget, freezing the baseline even
+                # when the attribution improved
+                with open(bpath) as f:
+                    report = gate(d, baseline=json.load(f), program=suffix)
+                refreshed = not any(f.rule == "stall-regression"
+                                    for f in report.findings)
+            if refreshed:
+                with open(bpath, "w") as f:
+                    json.dump(baseline_dict(d), f, indent=2)
+            else:
+                print(f"bench: doctor baseline {suffix} NOT refreshed — "
+                      "attribution regressed vs the existing baseline",
+                      file=sys.stderr)
+            out[f"doctor_baseline_{suffix}"] = bpath
+            out[f"doctor_baseline_refreshed_{suffix}"] = refreshed
+        except Exception as e:  # noqa: BLE001 — baseline is advisory
+            print(f"bench: doctor baseline {suffix} failed: {e}",
+                  file=sys.stderr)
     return out
 
 
@@ -451,15 +554,129 @@ def _telemetry_bench(size: str, S: int, B: int, base_step_s: float,
 
 
 def _long_seq_bench(size: str, S: int = 8192, B: int = 2,
-                    nsteps: int = 8) -> float:
+                    nsteps: int = 8, remat: str = "dots_saveable",
+                    fused_backward: bool = True) -> float:
     """Long-context rung: same model trained at seq 8k (the blocked-KV flash
     kernel's VMEM residency is O(block), so sequence length is HBM-bound —
-    the round-2 kernel capped out below this)."""
-    cfg, engine, n_params, dt, _ = _try_rung(size, S, B, nsteps, chunk=1024)
+    the round-2 kernel capped out below this). Runs with the same levers as
+    the headline (fused backward + the sweep's remat policy); a
+    policy-induced OOM at 8k falls back to dots_saveable so the rung still
+    reports."""
+    try:
+        cfg, engine, n_params, dt, _ = _try_rung(
+            size, S, B, nsteps, chunk=1024, remat=remat,
+            fused_backward=fused_backward)
+    except Exception as e:  # noqa: BLE001 — OOM fallback to the safe policy
+        if not _is_oom(e) or remat == "dots_saveable":
+            raise
+        gc.collect()
+        cfg, engine, n_params, dt, _ = _try_rung(
+            size, S, B, nsteps, chunk=1024, remat="dots_saveable",
+            fused_backward=fused_backward)
     mfu = _mfu(cfg, n_params, B, S, nsteps, dt)
     del engine
     gc.collect()
     return round(mfu, 4)
+
+
+def _remat_sweep_bench(size: str, S: int, B: int, hbm: int,
+                       small: bool = False, tsteps: int = 4):
+    """Measured remat-policy sweep on the bench rung, statically pruned.
+
+    Candidates are remat policies (none / dots_saveable / dots_and_attn /
+    save_nothing), then the winning policy x pipeline.fuse_steps. Before a
+    candidate ever runs, the engine's own static analyzers price it:
+    MemoryLint's modeled peak HBM (``memory-peak`` at 92% of the chip) and
+    RematAudit (``involuntary-remat`` / ``remat-policy-inert``) prune
+    predicted-OOM or inert configs for the cost of one AOT compile — the
+    jit cache then reuses that compile when the surviving candidate is
+    timed. Returns (json_fields, winner_policy, winner_fuse_steps)."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.analysis import AnalysisSettings
+    from deepspeed_tpu.models import llama_config, make_model
+
+    budget = int(0.92 * hbm) if hbm else None
+    table = {}
+
+    def candidate(policy, fuse):
+        key = f"{policy}/fuse{fuse}"
+        overrides = dict(vocab_size=2048, num_layers=2, hidden_size=128,
+                         num_heads=4, num_kv_heads=2,
+                         intermediate_size=384) if small else {}
+        cfg = llama_config(size, max_seq_len=S, remat=policy != "none",
+                           remat_policy=policy,
+                           loss_chunk=min(LOSS_CHUNK, S), **overrides)
+        model = make_model(cfg, name=f"llama-{size}")
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": B,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": not small},
+            "zero_optimization": {"stage": 1},
+            "pipeline": {"in_flight": 4, "prefetch": True,
+                         **({"fuse_steps": fuse} if fuse > 1 else {})},
+            "transformer": {"fused_backward": True},
+            "steps_per_print": 1000000})
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (B, S),
+                                           dtype=np.int32)}
+        entry = {}
+        try:
+            # static pruning BEFORE the candidate executes a single step
+            report = engine.audit(batch=batch, settings=AnalysisSettings(
+                max_hbm_bytes=budget))
+            mem = report.memory.get("train_step", {})
+            if mem.get("peak_hbm_bytes"):
+                entry["modeled_peak_hbm"] = int(mem["peak_hbm_bytes"])
+            pruned = sorted({f.rule for f in report.findings
+                             if f.rule in ("memory-peak", "involuntary-remat",
+                                           "remat-policy-inert")})
+            if pruned:
+                entry["pruned"] = ",".join(pruned)
+                table[key] = entry
+                return None
+        except Exception as e:  # noqa: BLE001 — audit is advisory here
+            print(f"bench: remat sweep audit {key} failed: {e}",
+                  file=sys.stderr)
+        try:
+            # warmup compiles BOTH programs the timed loop will dispatch:
+            # the single step and (fuse>1) the fused K-step program
+            engine.train_batch(batch)
+            if fuse > 1:
+                engine.train_batches((dict(batch) for _ in range(fuse)), fuse)
+            int(np.asarray(jax.device_get(engine.state["step"])))
+            t0 = time.perf_counter()
+            engine.train_batches((dict(batch) for _ in range(tsteps)), tsteps)
+            int(np.asarray(jax.device_get(engine.state["step"])))
+            entry["step_ms"] = round(
+                (time.perf_counter() - t0) / tsteps * 1000, 2)
+        except Exception as e:  # noqa: BLE001 — an OOM the lint missed
+            entry["pruned"] = f"runtime:{type(e).__name__}"
+            if not _is_oom(e):
+                print(f"bench: remat sweep {key} failed: {e}",
+                      file=sys.stderr)
+        finally:
+            table[key] = entry
+        return entry.get("step_ms")
+
+    def close(engine=None):
+        gc.collect()
+
+    winner, winner_ms = "dots_saveable", None
+    for policy in ("none", "dots_saveable", "dots_and_attn", "save_nothing"):
+        ms = candidate(policy, 1)
+        close()
+        if ms is not None and (winner_ms is None or ms < winner_ms):
+            winner, winner_ms = policy, ms
+    winner_fuse = 1
+    for fuse in ((2,) if small else (4,)):
+        ms = candidate(winner, fuse)
+        close()
+        if ms is not None and winner_ms is not None and ms < winner_ms:
+            winner_ms, winner_fuse = ms, fuse
+    fields = {"remat_sweep": table,
+              "remat_sweep_winner": f"{winner}/fuse{winner_fuse}"}
+    return fields, winner, winner_fuse
 
 
 def _rel_err(a, b):
@@ -510,6 +727,21 @@ def _kernel_parity_matrix() -> dict:
         errs = [_rel_err(of, orf)] + [_rel_err(a, b) for a, b in zip(gf, gr)]
         worst = max(worst, max(errs))
         ok = ok and max(errs) < REL_TOL
+        cases += 1
+
+        # fused backward (delta epilogue inside the Pallas grids, ISSUE 8):
+        # ON HARDWARE vs the unfused kernel path. The fused grids compute
+        # delta = rowsum(dO*O) in f32 on-chip exactly like the XLA delta
+        # pass, so the tolerance is an order tighter than the
+        # vs-XLA-reference bar — a Mosaic lowering bug in the fused
+        # epilogue shows up here before it shows up against the reference.
+        def fused(qa, ka, va, causal=True):
+            return flash_attention(qa, ka, va, causal=causal,
+                                   fused_backward=True)
+        gff = jax.jit(jax.grad(loss(fused), argnums=(0, 1, 2)))(q, k, v)
+        errs_f = [_rel_err(a, b) for a, b in zip(gff, gf)]
+        worst = max(worst, max(errs_f))
+        ok = ok and max(errs_f) < 2e-3
         cases += 1
 
     # decode kernel: legacy (row in buffer) and fresh-row modes, checked
@@ -834,7 +1066,10 @@ if __name__ == "__main__":
     p.add_argument("--batch", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--chunk", type=int, default=None)
-    p.add_argument("--remat", default="dots_saveable")
+    p.add_argument("--remat", default="auto",
+                   help="remat policy for the headline rung; 'auto' runs "
+                        "the measured in-bench policy x fuse_steps sweep "
+                        "(statically pruned) and ships the winner")
     a = p.parse_args()
     if a.comm:
         from deepspeed_tpu.benchmarks.communication import run_comm_bench

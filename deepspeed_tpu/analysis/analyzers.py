@@ -463,6 +463,10 @@ class RematAudit:
                 memory: Optional[Dict[str, Any]] = None) -> List[Finding]:
         findings = []
         for w in art.meta.get("spmd_warnings", ()):
+            if w.get("trivial"):
+                # broadcast/iota-from-scalar: recomputation is free, the
+                # partitioner's fallback costs nothing — not a finding
+                continue
             findings.append(Finding(
                 rule=self.rule_involuntary, program=art.name,
                 ident=str(w.get("op", w.get("raw", ""))[:80]),

@@ -404,6 +404,55 @@ class NoisyLossModel:
         return loss + 1e-12 * jnp.sum(extra)
 
 
+def serialized_backward(devices=None):
+    """Serialized backward: a tensor=2 row-parallel projection whose chunked
+    collective-matmul overlap (`transformer.tp_overlap_chunks`) was silently
+    disabled — the program compiled the single fat boundary all-reduce
+    instead of the 4 chunk-interleaved psums the config asked for. The
+    census pin expects the chunked shape (4 all-reduces) and sees 1 —
+    census drift — and the one serial reduction is fully exposed, so the
+    overlap gate (max_exposed_collectives=0) fires too. The measured twin
+    of this defect is the doctor corpus entry of the same name
+    (``doctor --corpus serialized-backward``): there the exposed wire time
+    trips ``exposed-collective-measured`` on a traced step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = devices or jax.devices()[:2]
+    if len(devs) < 2:
+        raise SystemExit("corpus: needs >= 2 devices "
+                         "(--xla_force_host_platform_device_count)")
+    mesh = Mesh(list(devs)[:2], ("tensor",))
+    x_abs = jax.ShapeDtypeStruct((8, 256, 128), jnp.float32,
+                                 sharding=NamedSharding(
+                                     mesh, P(None, None, "tensor")))
+    w_abs = jax.ShapeDtypeStruct((128, 64), jnp.float32,
+                                 sharding=NamedSharding(mesh,
+                                                        P("tensor", None)))
+
+    def serial(x, w):
+        # the defect: the plain matmul — one local dot + ONE synchronous
+        # all-reduce of the whole [8, 256, 64] output at the end (the
+        # chunked path emits 4 independent psums the scheduler interleaves)
+        return x @ w
+
+    repl = NamedSharding(mesh, P())
+    jitted = jax.jit(serial, out_shardings=repl)
+    art = lower_program(jitted, x_abs, w_abs, name="row_parallel_proj",
+                        mesh=mesh, donatable=None, donation_expected=False,
+                        meta={"skip_required": True})
+    from deepspeed_tpu.config import Config
+    cfg = Config.load({"train_batch_size": 4,
+                       "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                       "bf16": {"enabled": False},
+                       "transformer": {"tp_overlap_chunks": 4}})
+    return analyze_programs(
+        [art], cfg, _FakePlan(),
+        settings=AnalysisSettings(
+            expect_collectives={"all-reduce": 4},
+            max_exposed_collectives=0, min_exposed_bytes=1))
+
+
 def exposed_collective_trace(devices=None):
     """Perf doctor gate: a TRACED step (not a compiled program) whose
     all-reduce runs with nothing scheduled under it — 8 ms of measured
@@ -427,6 +476,7 @@ CORPUS = {
     "remat-missing": remat_missing,
     "stage3-replicated-opt": stage3_replicated_opt,
     "exposed-collective-trace": exposed_collective_trace,
+    "serialized-backward": serialized_backward,
 }
 
 

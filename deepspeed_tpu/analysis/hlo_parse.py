@@ -738,11 +738,20 @@ _SPMD_OP_RE = re.compile(
     r"HLO operation:\s*(%?[\w.\-]+)\s*=\s*(\w+\[[\d,]*\])")
 _SPMD_SRC_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
 _SPMD_OPNAME_RE = re.compile(r'op_name="([^"]+)"')
+# broadcast/iota fed only by scalars ("f32[]", "s32[]") or nothing:
+# re-materializing one costs zero HBM traffic and zero meaningful flops
+_SPMD_TRIVIAL_RE = re.compile(
+    r"=\s*\w+\[[\d,]*\][^ ]*\s+(?:broadcast|iota|constant)"
+    r"(?:\(\s*(?:\w+\[\]\S*\s*%?[\w.\-]+\s*,?\s*)*\))?[,\s]")
 
 
 def parse_spmd_remat_warning(line: str) -> Dict[str, object]:
     """Structure one spmd_partitioner.cc 'Involuntary full
-    rematerialization' log line into a machine-readable diagnosis."""
+    rematerialization' log line into a machine-readable diagnosis.
+
+    Sets ``trivial: True`` when the rematted op is a broadcast/iota/constant
+    whose operands are all scalars — recomputing those is free (no HBM reads,
+    no flops), so the fallback is benign and gates should not fire on it."""
     out: Dict[str, object] = {"raw": line.strip()[:500]}
     m = _SPMD_WARN_RE.search(line)
     if m:
@@ -753,6 +762,8 @@ def parse_spmd_remat_warning(line: str) -> Dict[str, object]:
         sm = _SHAPE_RE.search(m.group(2))
         if sm:
             out["nbytes"] = shape_bytes(sm.group(1), sm.group(2))
+    if _SPMD_TRIVIAL_RE.search(line):
+        out["trivial"] = True
     m = _SPMD_SRC_RE.search(line)
     if m:
         out["source_file"], out["source_line"] = m.group(1), int(m.group(2))

@@ -214,12 +214,15 @@ def assert_no_spmd_replication(compile_fn, *args, **kwargs):
     """Run `compile_fn(*args, **kwargs)` (something that triggers XLA SPMD
     compilation) and raise RuntimeError if the partitioner reported an
     involuntary full rematerialization. Returns compile_fn's result."""
+    from deepspeed_tpu.analysis.hlo_parse import parse_spmd_remat_warning
     matches: list = []
     with capture_spmd_warnings(matches):
         result = compile_fn(*args, **kwargs)
-    if matches:
+    real = [m for m in matches
+            if not parse_spmd_remat_warning(m).get("trivial")]
+    if real:
         raise RuntimeError(
             "XLA SPMD involuntary full rematerialization during compile "
-            f"({len(matches)} site(s)) — a tensor is being replicated in the "
-            "hot loop:\n" + "\n".join(matches[:8]))
+            f"({len(real)} site(s)) — a tensor is being replicated in the "
+            "hot loop:\n" + "\n".join(real[:8]))
     return result

@@ -90,6 +90,31 @@ class CommsLogger:
             lines.append(f"{key:<32} host_ms={self.host_ms[key]:.1f}")
         return "\n".join(lines)
 
+    def census_lines(self, census) -> list:
+        """Format a graft-lint collective census ({kind: {count, bytes}})
+        as summary rows. These are the collectives GSPMD *inserted* into
+        the compiled step (all-gathers for ZeRO-3 params, reduce-scatters
+        for grad sharding, ...) — invisible to `record`, which only sees
+        explicit jax-level calls at trace time."""
+        lines = []
+        for kind in sorted(census):
+            c = census[kind]
+            lines.append(f"gspmd/{kind:<26} {c.get('count', 0):>6} "
+                         f"{c.get('bytes', 0) / 1e6:>12.2f}")
+        return lines
+
+    def census_events(self, census, step: int):
+        """Monitor-ready triples of the GSPMD census (per compiled step):
+        ``comm/gspmd/<kind>/{count,bytes}``."""
+        out = []
+        for kind in sorted(census):
+            c = census[kind]
+            out.append((f"comm/gspmd/{kind}/count",
+                        float(c.get("count", 0)), step))
+            out.append((f"comm/gspmd/{kind}/bytes",
+                        float(c.get("bytes", 0)), step))
+        return out
+
     def events(self, step: int):
         """Monitor-ready ``(name, value, step)`` triples of the running
         totals: per-op ``comm/<op>[axis]/{count,bytes}`` plus
@@ -115,13 +140,36 @@ class CommsLogger:
 comms_logger = CommsLogger()
 
 
-def log_summary(monitor=None, step: Optional[int] = None) -> str:
+def log_summary(monitor=None, step: Optional[int] = None,
+                engine=None) -> str:
     """Reference: ``deepspeed.comm.log_summary`` (comm/comm.py:413). With a
     ``monitor`` (e.g. ``engine.monitor``), the totals also fan out as
     monitor events instead of log-only text — pass ``step`` (e.g.
     ``engine.global_steps``): wandb silently drops events whose step is
-    lower than what it already logged."""
+    lower than what it already logged.
+
+    With ``engine=``, the summary also reports the graft-lint collective
+    census of the engine's compiled train step — the GSPMD-inserted
+    all-gather/reduce-scatter kinds+bytes the trace-time `record` hook can
+    never see (the reference's per-collective accounting wraps every torch
+    call at comm/comm.py:108; on TPU the partitioner inserts the real
+    collectives at compile time, so the census is read from the scheduled
+    HLO via the telemetry static join). Costs nothing in steady state: the
+    static join is computed once, lazily, off the hot path."""
     msg = comms_logger.summary()
+    census = None
+    if engine is not None:
+        try:
+            static = engine._tel_static_cost(wait=True)
+            census = (static or {}).get("census") or None
+        except Exception as e:  # noqa: BLE001 — summary must never raise
+            logger.debug(f"comm.log_summary: census unavailable: {e!r}")
+        if census:
+            msg += ("\ngspmd census (compiled train step)     count"
+                    "      total MB\n")
+            msg += "\n".join(comms_logger.census_lines(census))
+        if step is None:
+            step = getattr(engine, "global_steps", None)
     logger.info("\n" + msg)
     if monitor is not None and getattr(monitor, "enabled", False):
         if step is None:
@@ -131,6 +179,8 @@ def log_summary(monitor=None, step: Optional[int] = None) -> str:
                            "step=engine.global_steps")
             step = 0
         monitor.write_events(comms_logger.events(step))
+        if census:
+            monitor.write_events(comms_logger.census_events(census, step))
     return msg
 
 

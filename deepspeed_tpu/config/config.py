@@ -498,6 +498,28 @@ class TelemetryConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class TransformerTuningConfig(ConfigModel):
+    """Model-level perf levers for transformer ModelSpecs. The engine
+    applies them with a ``dataclasses.replace`` + ``make_model`` rebuild
+    (the act-quant idiom): the param structure is untouched, only the
+    compute path changes. Non-transformer models ignore the section with a
+    warning."""
+    # fused attention backward block (ops/flash_attention fused_backward):
+    # the delta epilogue runs inside the backward grids; removes the XLA
+    # delta pass + its [B,N,S,1] HBM round-trip per layer per step
+    fused_backward: bool = False
+    # chunked TP collective-matmul overlap: row-parallel out-projections
+    # decompose the tensor-axis reduction into this many independent psums
+    # the latency-hiding scheduler can interleave with the next chunk's
+    # matmul. 0/1 = off; no-op without a tensor mesh axis.
+    tp_overlap_chunks: int = 0
+
+    def validate(self):
+        if self.tp_overlap_chunks < 0:
+            raise ConfigError("transformer.tp_overlap_chunks must be >= 0")
+
+
+@dataclasses.dataclass
 class MeshConfig(ConfigModel):
     """TPU-native: explicit mesh override. By default the planner derives the
     mesh from world size and the parallelism degrees."""
@@ -562,6 +584,8 @@ class Config(ConfigModel):
     autotuning: AutotuningConfig = config_field(AutotuningConfig)
     analysis: AnalysisConfig = config_field(AnalysisConfig)
     robustness: RobustnessConfig = config_field(RobustnessConfig)
+    transformer: TransformerTuningConfig = config_field(
+        TransformerTuningConfig)
 
     # ---------------------------------------------------------------------
     @classmethod

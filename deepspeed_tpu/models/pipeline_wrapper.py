@@ -97,10 +97,7 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
     def head_loss_fn(other_params, y, labels):
         y = T._norm(y, other_params["final_norm_scale"],
                     other_params.get("final_norm_bias"), cfg)
-        head = other_params.get("lm_head")
-        if head is None:
-            head = other_params["tok_embed"].T
-        logits = (y @ head.astype(y.dtype)).astype(jnp.float32)
+        logits = T.lm_head_logits(y, other_params)
         return T.cross_entropy_loss(logits, labels)
 
     aux_w = cfg.moe_aux_loss_weight if cfg.num_experts > 1 else 0.0
@@ -128,10 +125,7 @@ def make_pipelined_model(cfg: T.TransformerConfig, mesh: Mesh,
         y = y_mb.reshape(B, S, -1)
         y = T._norm(y, params["final_norm_scale"],
                     params.get("final_norm_bias"), cfg)
-        head = params.get("lm_head")
-        if head is None:
-            head = params["tok_embed"].T
-        return (y @ head.astype(y.dtype)).astype(jnp.float32)
+        return T.lm_head_logits(y, params)
 
     def loss_fn(params, batch, rng=None, deterministic=True):
         ids = batch["input_ids"]

@@ -107,8 +107,23 @@ class TransformerConfig:
     use_residual: bool = False                  # PR-MoE
     moe_aux_loss_weight: float = 0.01
     remat: bool = False
-    remat_policy: str = "none"                  # none|dots_saveable|save_nothing
+    # none | dots_saveable | save_nothing | dots_and_attn (dots + the flash
+    # kernel's named outputs: the backward reuses O/log-sum-exp instead of
+    # replaying the full online-softmax forward — jax.checkpoint treats the
+    # custom-vjp pallas outputs as recompute-always under dot-only policies)
+    remat_policy: str = "none"
     scan_layers: bool = True
+    # fused attention backward block (ops/flash_attention fused_backward):
+    # the delta epilogue runs inside the backward grids — no separate XLA
+    # delta pass between the forward and the dQ/dKV kernels. Set via the
+    # engine's `transformer.fused_backward` config section.
+    fused_backward: bool = False
+    # chunked tensor-parallel collective-matmul overlap: the row-parallel
+    # out-projections (wo, w_out) decompose their tensor-axis reduction
+    # into this many independent psums so the latency-hiding scheduler can
+    # run chunk i's wire time under chunk i+1's matmul. 0/1 = off. Set via
+    # `transformer.tp_overlap_chunks`.
+    tp_overlap_chunks: int = 0
     # Random-LTD (reference: runtime/data_pipeline/data_routing/basic_layer.py
     # RandomLayerTokenDrop): middle layers process a random kept-token subset
     # during training. random_ltd_keep is a SHAPE (static); the engine's
@@ -421,6 +436,16 @@ def _constrain_batch_axes(x):
     return jax.lax.with_sharding_constraint(x, P(batch, seq_ax))
 
 
+def _row_parallel(x, w, cfg: TransformerConfig):
+    """Row-parallel out-projection: the chunked collective-matmul overlap
+    path when `transformer.tp_overlap_chunks` is set and a tensor axis is
+    active, the plain matmul otherwise (identical numerics either way)."""
+    if cfg.tp_overlap_chunks and cfg.tp_overlap_chunks > 1:
+        from deepspeed_tpu.parallel.partitioning import row_parallel_matmul
+        return row_parallel_matmul(x, w, chunks=cfg.tp_overlap_chunks)
+    return x @ w
+
+
 def _norm(x, scale, bias, cfg: TransformerConfig):
     x32 = x.astype(jnp.float32)
     if cfg.norm_type == "rmsnorm":
@@ -518,7 +543,7 @@ def attention(q, k, v, mask=None, *, causal: bool = True, cfg: TransformerConfig
         if seq_parallel_degree() <= 1:
             from deepspeed_tpu.ops.flash_attention import flash_attention as fa
             return fa(q, k, v, causal=causal, sm_scale=sm,
-                      kv_mask=mask)
+                      kv_mask=mask, fused_backward=cfg.fused_backward)
     if Nkv != Nq:  # GQA: repeat kv heads
         rep = Nq // Nkv
         k = jnp.repeat(k, rep, axis=2)
@@ -942,7 +967,8 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         with jax.named_scope("attn"):
             attn_out = attention(q, k, v, mask=mask, causal=cfg.causal,
                                  cfg=cfg, window=attn_window)
-    attn_out = attn_out.reshape(B, S, nh * hd) @ p["wo"].astype(h.dtype)
+    attn_out = _row_parallel(attn_out.reshape(B, S, nh * hd),
+                             p["wo"].astype(h.dtype), cfg)
     if "bo" in p:
         attn_out = attn_out + p["bo"].astype(h.dtype)
     if cfg.parallel_block:
@@ -1000,7 +1026,7 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
             ug = h @ p["w_in_gate"].astype(h.dtype)
             half = ug.shape[-1] // 2
             act = _activation(ug[..., :half], ug[..., half:], cfg)
-            out = act @ p["w_out"].astype(h.dtype)
+            out = _row_parallel(act, p["w_out"].astype(h.dtype), cfg)
             if "b_out" in p:
                 out = out + p["b_out"].astype(h.dtype)
     else:
@@ -1010,7 +1036,7 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                 up = up + p["b_in"].astype(h.dtype)
             gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
             act = _activation(up, gate, cfg)
-            out = act @ p["w_out"].astype(h.dtype)
+            out = _row_parallel(act, p["w_out"].astype(h.dtype), cfg)
             if "b_out" in p:
                 out = out + p["b_out"].astype(h.dtype)
     if cfg.parallel_block:
@@ -1050,6 +1076,16 @@ def _remat_policy(cfg: TransformerConfig):
         "offload_dots": jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host")
         if hasattr(jax.checkpoint_policies, "offload_dot_with_no_batch_dims") else None,
+        # dots + the flash kernel's checkpoint_name'd outputs (O, lse):
+        # under dot-only policies jax.checkpoint recomputes custom-vjp
+        # pallas outputs, so the backward replays the whole online-softmax
+        # forward per layer — this policy pins them across the fwd/bwd
+        # boundary at ~one extra activation of HBM per layer (measured by
+        # the bench remat sweep; the winner is recorded in the bench JSON)
+        "dots_and_attn": jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse")),
     }
     return policies.get(cfg.remat_policy)
 
@@ -1230,16 +1266,95 @@ def forward(params: Params, input_ids, cfg: TransformerConfig, *,
     if return_hidden:
         return x, aux_total
     with jax.named_scope("lm_head"):
-        head = params.get("lm_head")
-        if head is None:
-            head = params["tok_embed"].T
-        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
-        if "lm_head_bias" in params:
-            logits = logits + params["lm_head_bias"].astype(jnp.float32)
+        logits = lm_head_logits(x, params)
     if return_kv:
         return logits, kv_stack
     if return_aux:
         return logits, aux_total
+    return logits
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _fwd_only_constraint(x, spec):
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _fwd_only_constraint_fwd(x, spec):
+    return _fwd_only_constraint(x, spec), None
+
+
+def _fwd_only_constraint_bwd(spec, _, g):
+    # the cotangent stays unconstrained: transposing the constraint onto
+    # d(logits) forces the partitioner into a copy it can only realize by
+    # involuntary full rematerialization on some fsdp x tensor meshes
+    # (observed at fsdp=2 x tensor=2), and the backward contraction
+    # partitions fine on its own
+    return (g,)
+
+
+_fwd_only_constraint.defvjp(_fwd_only_constraint_fwd,
+                            _fwd_only_constraint_bwd)
+
+
+def _constrain_tied_logits(logits):
+    """Pin tied-head logits' vocab dim to the embedding table's own axes.
+
+    On fsdp x tensor meshes the stage-3 rules shard the table's vocab dim
+    over BOTH axes. Left to itself the partitioner tries to re-shard the
+    table for the head contraction (vocab-(fsdp, tensor) -> embed-tensor)
+    inside the microbatch loop — a mixed-axes tile reordering it can only
+    do by involuntary full rematerialization (the r5 MULTICHIP DIAGNOSIS).
+    Constraining the output's vocab dim to the same (fsdp, tensor) order
+    keeps the table stationary: each shard contracts its vocab slice
+    against the (small, all-gathered) hidden states, and the CE's
+    logsumexp/one-hot reductions already partition over a sharded vocab.
+    Only the failing combination is pinned — single-axis meshes keep the
+    strategy the partitioner picks on its own."""
+    from deepspeed_tpu.parallel.context import physical_mesh_env
+    env_mesh, shape, bound = physical_mesh_env()
+    if env_mesh is None or env_mesh.size == 1:
+        return logits
+    vocab_axes = tuple(a for a in ("fsdp", "tensor")
+                       if shape.get(a, 1) > 1 and a not in bound)
+    if len(vocab_axes) < 2:   # single-axis meshes partition this fine
+        return logits
+    denom = 1
+    for a in vocab_axes:
+        denom *= shape[a]
+    if logits.shape[-1] % denom:
+        return logits
+    spec = (None,) * (logits.ndim - 1) + (vocab_axes,)
+    return _fwd_only_constraint(logits, P(*spec))
+
+
+def tied_head_logits(x, table):
+    """fp32 logits from the UNtransposed [V, H] embedding table, contracted
+    on its embed dim + the fwd-only vocab constraint. THE tied-head
+    contraction — every site (full forward, decode, pipeline head, chunked
+    CE, infinity top block) must go through here: materializing
+    ``table.T`` instead makes GSPMD re-shard the (vocab, embed)-sharded
+    table on fsdp x tensor meshes, an involuntary full rematerialization
+    every step (the r5 MULTICHIP DIAGNOSIS)."""
+    logits = lax.dot_general(
+        x, table.astype(x.dtype),
+        (((x.ndim - 1,), (1,)), ((), ()))).astype(jnp.float32)
+    return _constrain_tied_logits(logits)
+
+
+def lm_head_logits(x, params):
+    """Final projection to fp32 vocab logits, shared by every head site.
+
+    Tied models contract the embedding table directly (tied_head_logits);
+    the untransposed contraction partitions natively — each shard contracts
+    its slice and SPMD inserts the one reduction the math needs.
+    """
+    head = params.get("lm_head")
+    if head is not None:
+        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = tied_head_logits(x, params["tok_embed"])
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits
 
 
@@ -1461,12 +1576,7 @@ def decode_step(params: Params, token, cfg: TransformerConfig,
     if cfg.final_norm:
         x = _norm(x, params["final_norm_scale"],
                   params.get("final_norm_bias"), cfg)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["tok_embed"].T
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
-    if "lm_head_bias" in params:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    logits = lm_head_logits(x, params)
     new_cache = {"k": new_k, "v": new_v, "index": index + 1}
     if int8_kv:
         new_cache.update(new_scales)
@@ -1559,12 +1669,7 @@ def decode_step_suffix(params: Params, token, cfg: TransformerConfig,
     if cfg.final_norm:
         x = _norm(x, params["final_norm_scale"],
                   params.get("final_norm_bias"), cfg)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["tok_embed"].T
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
-    if "lm_head_bias" in params:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    logits = lm_head_logits(x, params)
     return logits[:, 0, :], new_suffix
 
 
@@ -1598,21 +1703,30 @@ def merge_suffix(cfg: TransformerConfig, cache: Params,
 
 
 def chunked_cross_entropy(x, head, labels, chunk: int,
-                          ignore_index: int = -100):
+                          ignore_index: int = -100,
+                          tied_embed: bool = False):
     """CE over sequence chunks: the fp32 logits exist only chunk-at-a-time
     (the head matmul re-runs in backward via jax.checkpoint). x: [B,S,H]
-    final hidden (already normed); head: [H,V]."""
+    final hidden (already normed); head: [H,V] — or, with
+    ``tied_embed=True``, the UNtransposed [V,H] embedding table contracted
+    on its embed dim (see lm_head_logits: the explicit transpose forces an
+    involuntary SPMD rematerialization on fsdp x tensor meshes)."""
     B, S, H = x.shape
     c = min(chunk, S)
     while S % c:
         c -= 1
     n = S // c
 
+    def proj(xc):
+        if tied_embed:
+            return tied_head_logits(xc, head)
+        return (xc @ head.astype(xc.dtype)).astype(jnp.float32)
+
     def body(carry, i):
         tot, cnt = carry
         xc = lax.dynamic_slice_in_dim(x, i * c, c, axis=1)
         lc = lax.dynamic_slice_in_dim(labels, i * c, c, axis=1)
-        logits = (xc @ head.astype(xc.dtype)).astype(jnp.float32)
+        logits = proj(xc)
         valid = lc != ignore_index
         safe = jnp.where(valid, lc, 0)
         logz = jax.nn.logsumexp(logits, axis=-1)
@@ -1642,10 +1756,12 @@ def lm_loss(params, batch, cfg: TransformerConfig, dropout_rng=None,
                          deterministic=deterministic, return_hidden=True,
                          pld_theta=pld_theta)
         head = params.get("lm_head")
-        if head is None:
-            head = params["tok_embed"].T
+        tied = head is None
+        if tied:
+            head = params["tok_embed"]
         with jax.named_scope("loss"):
-            loss = chunked_cross_entropy(x, head, labels, cfg.loss_chunk)
+            loss = chunked_cross_entropy(x, head, labels, cfg.loss_chunk,
+                                         tied_embed=tied)
     else:
         logits, aux = forward(params, ids, cfg, attention_mask=mask,
                               dropout_rng=dropout_rng,

@@ -32,6 +32,20 @@ Backward uses the standard flash decomposition (dQ kernel + joint dK/dV
 kernel) with the forward's log-sum-exp residuals; both are blocked the same
 way (dQ: KV innermost with dQ in scratch; dK/dV: Q innermost with dK/dV in
 scratch).
+
+``fused_backward=True`` folds the delta epilogue (``rowsum(dO * O)``) into
+both backward grids: the kernels read O directly and compute delta on-chip
+(dQ grid: once per Q block at the first KV step, held in VMEM scratch;
+dK/dV grid: recomputed per step — a [rows, D] elementwise-rowsum, noise
+next to the step's five matmuls). This removes the separate XLA delta pass
+— a full extra read of dO and O plus the [B, N, S, 1] delta tensor's HBM
+round-trip per layer per step — so the whole attention backward is two
+Pallas grids with no XLA prologue between forward and backward. The
+forward also tags its outputs with ``checkpoint_name`` ("flash_out" /
+"flash_lse"): the ``dots_and_attn`` remat policy
+(models/transformer._remat_policy) pins them across the fwd/bwd boundary
+so the backward does not replay the full online-softmax forward kernel
+under layer-level ``jax.checkpoint``.
 """
 
 import functools
@@ -240,8 +254,14 @@ def _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
 # backward
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
-                   dq_ref, dq_s, *, sm_scale, causal, rep, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, aux_ref, m_ref,
+                   dq_ref, dq_s, *scratch, sm_scale, causal, rep, block_q,
+                   block_k, fused=False):
+    """aux_ref carries the precomputed delta ([..., 1], unfused) or the
+    forward O block ([..., D], fused): the fused grid computes delta =
+    rowsum(dO * O) ONCE per Q block at the first KV step and holds it in
+    VMEM scratch across the KV sweep — no XLA delta pass, no [B,N,S,1]
+    HBM round-trip."""
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     num_kv = pl.num_programs(3)
@@ -251,6 +271,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
     @pl.when(kj == 0)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
+        if fused:
+            do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+            o = aux_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+            scratch[0][:] = jnp.broadcast_to(
+                jnp.sum(do * o, axis=-1, keepdims=True), scratch[0].shape)
 
     visible = _block_visible(qi, kj, block_q, block_k) if causal else True
 
@@ -259,7 +284,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
         q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d)
         do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
         lse = lse_ref[0, 0].reshape(rows, 1)
-        delta = delta_ref[0, 0].reshape(rows, 1)
+        delta = (scratch[0][:, 0:1] if fused
+                 else aux_ref[0, 0].reshape(rows, 1))
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -283,9 +309,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
         dq_ref[0, 0] = dq_s[:].reshape(rep, block_q, d).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, aux_ref, m_ref,
                     dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal, rep,
-                    block_q, block_k):
+                    block_q, block_k, fused=False):
+    """aux_ref: precomputed delta (unfused) or the forward O block (fused —
+    delta recomputed per (kj, qi) step; a [rows, D] rowsum is noise next to
+    the step's five matmuls and saves the separate delta pass)."""
     kj = pl.program_id(2)
     qi = pl.program_id(3)
     num_q = pl.num_programs(3)
@@ -307,7 +336,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
         q = q_ref[0, 0].astype(jnp.float32).reshape(rows, d)
         do = do_ref[0, 0].astype(jnp.float32).reshape(rows, d)
         lse = lse_ref[0, 0].reshape(rows, 1)
-        delta = delta_ref[0, 0].reshape(rows, 1)
+        if fused:
+            o = aux_ref[0, 0].astype(jnp.float32).reshape(rows, d)
+            delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        else:
+            delta = aux_ref[0, 0].reshape(rows, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -332,15 +365,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, aux_ref,
                           dq_ref, *scratch, **kw):
-    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, aux_ref, None,
                    dq_ref, *scratch, **kw)
 
 
-def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, aux_ref,
                            dk_ref, dv_ref, *scratch, **kw):
-    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, aux_ref, None,
                     dk_ref, dv_ref, *scratch, **kw)
 
 
@@ -355,7 +388,7 @@ def _q_index_map(causal, bq, bk):
     return index
 
 
-def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
+def _bwd(sm_scale, causal, block_q, block_k, fused, residuals, g):
     q, k, v, kv_mask, o, lse = residuals
     do = g
     B, N, S, D = q.shape
@@ -364,14 +397,17 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
     bq, bk = _pick_blocks(S, block_q, block_k, rep)
     rows = rep * bq
 
-    # delta = rowsum(dO * O) — cheap, let XLA fuse it
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [B,N,S,1]
-
     qg = q.reshape(B, Nkv, rep, S, D)
     dog = do.reshape(B, Nkv, rep, S, D)
     lseg = lse.reshape(B, Nkv, rep, S, 1)
-    deltag = delta.reshape(B, Nkv, rep, S, 1)
+    if fused:
+        # delta computed inside both grids from O directly — no XLA pass
+        auxg = o.reshape(B, Nkv, rep, S, D)
+    else:
+        # delta = rowsum(dO * O) — a separate XLA pass over dO and O
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # [B,N,S,1]
+        auxg = delta.reshape(B, Nkv, rep, S, 1)
 
     # ---- dQ: grid (B, Nkv, num_q, num_kv), KV innermost ----
     kv_blk = pl.BlockSpec((1, 1, bk, D), _kv_index_map(causal, bq, bk),
@@ -385,19 +421,21 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
     mask_kv = pl.BlockSpec((1, 8, bk), _mask_kv_index_map(causal, bq, bk),
                            memory_space=pltpu.VMEM)
     extra = () if kv_mask is None else (kv_mask,)
+    aux_blk = grp_blk if fused else grp_vec
     dq_kern = _bwd_dq_kernel if kv_mask is not None else _bwd_dq_kernel_nomask
     dq = pl.pallas_call(
         functools.partial(dq_kern, sm_scale=sm_scale, causal=causal,
-                          rep=rep, block_q=bq, block_k=bk),
+                          rep=rep, block_q=bq, block_k=bk, fused=fused),
         grid=(B, Nkv, S // bq, S // bk),
-        in_specs=[grp_blk, kv_blk, kv_blk, grp_blk, grp_vec, grp_vec]
+        in_specs=[grp_blk, kv_blk, kv_blk, grp_blk, grp_vec, aux_blk]
         + ([mask_kv] if kv_mask is not None else []),
         out_specs=grp_blk,
         out_shape=jax.ShapeDtypeStruct((B, Nkv, rep, S, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((rows, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((rows, D), jnp.float32)]
+        + ([pltpu.VMEM((rows, 128), jnp.float32)] if fused else []),
         compiler_params=_compiler_params(3),
         interpret=_interpret(),
-    )(qg, k, v, dog, lseg, deltag, *extra)
+    )(qg, k, v, dog, lseg, auxg, *extra)
 
     # ---- dK/dV: grid (B, Nkv, num_kv, num_q), Q innermost ----
     qmap = _q_index_map(causal, bq, bk)
@@ -410,11 +448,12 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
                             memory_space=pltpu.VMEM)
     dkv_kern = (_bwd_dkv_kernel if kv_mask is not None
                 else _bwd_dkv_kernel_nomask)
+    aux_q = grp_q if fused else grp_q_vec
     dk, dv = pl.pallas_call(
         functools.partial(dkv_kern, sm_scale=sm_scale, causal=causal,
-                          rep=rep, block_q=bq, block_k=bk),
+                          rep=rep, block_q=bq, block_k=bk, fused=fused),
         grid=(B, Nkv, S // bk, S // bq),
-        in_specs=[grp_q, kv_out, kv_out, grp_q, grp_q_vec, grp_q_vec]
+        in_specs=[grp_q, kv_out, kv_out, grp_q, grp_q_vec, aux_q]
         + ([mask_out] if kv_mask is not None else []),
         out_specs=[kv_out, kv_out],
         out_shape=[
@@ -427,7 +466,7 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
         ],
         compiler_params=_compiler_params(3),
         interpret=_interpret(),
-    )(qg, k, v, dog, lseg, deltag, *extra)
+    )(qg, k, v, dog, lseg, auxg, *extra)
     return dq.reshape(B, N, S, D), dk, dv
 
 
@@ -435,19 +474,28 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
 # public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_mask, sm_scale, causal, block_q, block_k, fused):
     o, _ = _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k)
     return o
 
 
-def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k, fused):
     o, lse = _fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k)
+    # named residuals: when this call sits inside a jax.checkpoint region
+    # (the layer scan body), the "dots_and_attn" remat policy saves O and
+    # the log-sum-exp across the fwd/bwd boundary — the backward then runs
+    # straight into the two backward grids instead of replaying the full
+    # online-softmax forward kernel first
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, kv_mask, o, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, g):
-    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, residuals, g)
+def _flash_bwd(sm_scale, causal, block_q, block_k, fused, residuals, g):
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, fused, residuals,
+                      g)
     kv_mask = residuals[3]
     import numpy as _np
     dmask = (None if kv_mask is None
@@ -462,12 +510,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     kv_mask=None,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_k: int = DEFAULT_BLOCK_K,
+                    fused_backward: bool = False):
     """q: [B, S, Nq, D]; k, v: [B, S, Nkv, D] (Nkv may divide Nq: GQA runs
     natively without repeating K/V) -> [B, S, Nq, D].
 
     kv_mask: optional [B, S] bool/int padding mask over keys — masked
-    positions are excluded inside the kernel (no O(S^2) fallback)."""
+    positions are excluded inside the kernel (no O(S^2) fallback).
+    fused_backward: fold the delta epilogue into the backward grids (the
+    kernels read O directly; no separate XLA delta pass)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if q.shape[2] % k.shape[2]:
@@ -491,7 +542,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
         kv_mask = jnp.broadcast_to(kv_mask[:, None, :],
                                    (kv_mask.shape[0], 8, kv_mask.shape[1]))
     o = _flash(qt, kt, vt, kv_mask, float(sm_scale), bool(causal), block_q,
-               block_k)
+               block_k, bool(fused_backward))
     return jnp.swapaxes(o, 1, 2)
 
 

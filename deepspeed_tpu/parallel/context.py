@@ -31,3 +31,29 @@ def current_plan():
 
 def seq_parallel_degree() -> int:
     return getattr(_PLAN, "seq", 1) if _PLAN is not None else 1
+
+
+def physical_mesh_env():
+    """(physical mesh | None, {axis: size}, shard_map-bound axis names) of
+    the ambient trace context.
+
+    The one sanctioned home for the jax._src introspection the model-internal
+    sharding hints need: ``thread_resources.env.physical_mesh`` is the mesh
+    the surrounding ``with mesh:`` / jit established; the bound set is the
+    axes a surrounding ``shard_map`` has already made manual (constraining
+    over those would double-partition). Both surfaces shift between jax
+    releases — keep every consumer on this helper so a rename breaks ONE
+    place."""
+    try:
+        from jax._src import mesh as _mesh_lib
+        env_mesh = _mesh_lib.thread_resources.env.physical_mesh
+    except Exception:  # pragma: no cover - jax internals drift
+        return None, {}, set()
+    if env_mesh is None or env_mesh.empty:
+        return None, {}, set()
+    try:
+        from jax._src import core as _core
+        bound = set(getattr(_core.get_axis_env(), "axis_sizes", {}) or {})
+    except Exception:  # pragma: no cover - jax internals drift
+        bound = set()
+    return env_mesh, dict(env_mesh.shape), bound

@@ -175,11 +175,41 @@ def synthetic_exposed_collective_trace() -> Dict[str, Any]:
     return {"displayTimeUnit": "ms", "traceEvents": evs}
 
 
-def run_corpus_entry() -> Report:
-    """The ``doctor`` corpus entry (analysis.corpus wires it into the lint
+def synthetic_serialized_backward_trace() -> Dict[str, Any]:
+    """The measured face of the ``serialized-backward`` defect (lint twin:
+    analysis/corpus.py): the backward's attention/MLP matmuls run, then the
+    tensor-axis reduction of the row-parallel projection crosses the wire
+    with NOTHING scheduled under it — the chunked collective-matmul overlap
+    path is silently off, so 6 ms of the 16 ms step is serial wire. The
+    attribution must price the full collective as exposed and
+    ``exposed-collective-measured`` must fire."""
+    evs = [
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 4_000.0,
+         "name": "dot.1", "args": {"hlo_op": "dot.1"}},           # attn bwd
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 4_100.0, "dur": 5_500.0,
+         "name": "dot.2", "args": {"hlo_op": "dot.2"}},           # mlp bwd
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 9_700.0, "dur": 6_000.0,
+         "name": "all-reduce.3", "args": {"hlo_op": "all-reduce.3"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 15_750.0, "dur": 250.0,
+         "name": "fusion.4", "args": {"hlo_op": "fusion.4"}},     # epilogue
+    ]
+    return {"displayTimeUnit": "ms", "traceEvents": evs}
+
+
+DOCTOR_CORPUS = {
+    "exposed-collective-trace": (synthetic_exposed_collective_trace,
+                                 "exposed_collective_trace"),
+    "serialized-backward": (synthetic_serialized_backward_trace,
+                            "serialized_backward"),
+}
+
+
+def run_corpus_entry(name: str = "exposed-collective-trace") -> Report:
+    """A ``doctor`` corpus entry (analysis.corpus wires them into the lint
     --corpus runner): the seeded exposed collective MUST fire the gate."""
-    diag = diagnose(synthetic_exposed_collective_trace())
-    return gate(diag, program="exposed_collective_trace")
+    make_trace, program = DOCTOR_CORPUS[name]
+    diag = diagnose(make_trace())
+    return gate(diag, program=program)
 
 
 # --------------------------------------------------------------------------
@@ -227,10 +257,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.corpus:
-        if args.corpus not in ("exposed-collective-trace", "doctor"):
-            p.error("unknown doctor corpus entry "
-                    f"'{args.corpus}' — use exposed-collective-trace")
-        report = run_corpus_entry()
+        name = ("exposed-collective-trace" if args.corpus == "doctor"
+                else args.corpus)
+        if name not in DOCTOR_CORPUS:
+            p.error(f"unknown doctor corpus entry '{args.corpus}' — one of "
+                    f"{sorted(DOCTOR_CORPUS)}")
+        report = run_corpus_entry(name)
         print(report.summary(), file=sys.stderr)
         return 0 if report.ok else 1
     if not args.trace:

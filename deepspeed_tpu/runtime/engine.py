@@ -172,6 +172,31 @@ class Engine:
                 "(values, indices) wire would need dynamic shapes and "
                 "moves more bytes at realistic vocab/batch sizes")
 
+        # --- model-level perf levers (`transformer` config section):
+        # applied with the act-quant rebuild idiom — dataclasses.replace +
+        # make_model keeps the param structure identical; only the compute
+        # path (fused attention backward, chunked TP collective overlap)
+        # changes. Runs BEFORE pipeline wrapping so staged models get the
+        # same levers.
+        tcfg = config.transformer
+        if tcfg.fused_backward or tcfg.tp_overlap_chunks > 1:
+            from deepspeed_tpu.models.transformer import (
+                TransformerConfig as _TC)
+            if isinstance(getattr(model, "config", None), _TC):
+                from deepspeed_tpu.models import make_model as _mk
+                model = _mk(dataclasses.replace(
+                    model.config, fused_backward=tcfg.fused_backward,
+                    tp_overlap_chunks=int(tcfg.tp_overlap_chunks)),
+                    name=model.name)
+                self.model = model
+                logger.info(
+                    "transformer tuning: fused_backward="
+                    f"{tcfg.fused_backward} tp_overlap_chunks="
+                    f"{tcfg.tp_overlap_chunks}")
+            else:
+                logger.warning("`transformer` config section ignored: model "
+                               "is not a transformer ModelSpec")
+
         # --- pipeline wrapping (reference: PipelineEngine construction)
         self._pp_mode = self.plan.pipe > 1
         if self._pp_mode and self.plan.seq > 1:

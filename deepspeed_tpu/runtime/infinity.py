@@ -349,21 +349,33 @@ class InfinityExecutor:
                 tp_tree, is_leaf=lambda x: isinstance(x, P))[0]
         # memory_kind="device" is load-bearing: a device_put from a
         # pinned_host source with no explicit kind can keep the array on the
-        # host tier, and every downstream jit then reads over PCIe
-        self._x_sh = NamedSharding(self.mesh, self._x_spec,
-                                   memory_kind="device")
-        self._bits_dev_sh = NamedSharding(self.mesh, self._bits_spec,
-                                          memory_kind="device")
-        self._opt_dev_sh = NamedSharding(self.mesh, self._opt_spec,
-                                         memory_kind="device")
-        self._repl_dev_sh = NamedSharding(self.mesh, P(),
-                                          memory_kind="device")
-        self._bits_host_sh = NamedSharding(self.mesh, self._bits_spec,
-                                           memory_kind="pinned_host")
-        self._opt_host_sh = NamedSharding(self.mesh, self._opt_spec,
-                                          memory_kind="pinned_host")
-        self._repl_host_sh = NamedSharding(self.mesh, P(),
-                                           memory_kind="pinned_host")
+        # host tier, and every downstream jit then reads over PCIe. Some
+        # CPU jaxlibs expose no device/pinned_host kinds at all (only
+        # unpinned_host) — there the host tier is numpy buffers and the
+        # un-kinded sharding means the same thing, so degrade to it rather
+        # than failing construction.
+        _degraded_kinds = set()
+
+        def _kinded(spec, kind):
+            try:
+                return NamedSharding(self.mesh, spec, memory_kind=kind)
+            except (ValueError, TypeError) as e:
+                if kind not in _degraded_kinds:
+                    _degraded_kinds.add(kind)
+                    logger.warning(
+                        f"memory_kind='{kind}' unsupported on this backend "
+                        f"({e}); using un-kinded shardings — on real TPU "
+                        "hardware this would defeat the host/HBM tiering, "
+                        "on CPU jaxlibs there is no tiering to defeat")
+                return NamedSharding(self.mesh, spec)
+
+        self._x_sh = _kinded(self._x_spec, "device")
+        self._bits_dev_sh = _kinded(self._bits_spec, "device")
+        self._opt_dev_sh = _kinded(self._opt_spec, "device")
+        self._repl_dev_sh = _kinded(P(), "device")
+        self._bits_host_sh = _kinded(self._bits_spec, "pinned_host")
+        self._opt_host_sh = _kinded(self._opt_spec, "pinned_host")
+        self._repl_host_sh = _kinded(P(), "pinned_host")
 
         # chunk rounded so every fsdp x tensor shard is lane-aligned
         align = 128 * self._F * self._TP
@@ -533,10 +545,11 @@ class InfinityExecutor:
             h = _norm(x, nl["final_norm_scale"], nl.get("final_norm_bias"),
                       cfg)
             head = nl.get("lm_head")
-            if head is None:
-                head = nl["tok_embed"].T
+            tied = head is None
+            if tied:
+                head = nl["tok_embed"]
             c = cfg.loss_chunk if cfg.loss_chunk else min(1024, x.shape[1])
-            return chunked_cross_entropy(h, head, labels, c)
+            return chunked_cross_entropy(h, head, labels, c, tied_embed=tied)
 
         def top_fwd_bwd(nl, x, labels, scale):
             def scaled(nl, x):
