@@ -333,6 +333,11 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 print(f"bench: decode bench failed: {e}", file=sys.stderr)
             gc.collect()
             try:
+                result.update(_serving_bench(size))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: serving bench failed: {e}", file=sys.stderr)
+            gc.collect()
+            try:
                 result.update(_capacity_bench())
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: capacity bench failed: {e}", file=sys.stderr)
@@ -351,6 +356,14 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: stall attribution failed: {e}",
                       file=sys.stderr)
+            # CPU smoke of the serving rung: tiny model, same engine/
+            # scheduler/pool code path incl. the SLO fields + the one-shot
+            # comparison, so the rung can't rot on boxes without the relay
+            try:
+                result.update(_serving_bench(size, n_requests=4, max_new=8,
+                                             small=True))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: serving bench failed: {e}", file=sys.stderr)
         return result
     raise RuntimeError(f"every bench rung OOM'd; last error: {last_err}")
 
@@ -697,7 +710,6 @@ def _kernel_parity_matrix() -> dict:
     import jax.numpy as jnp
     from deepspeed_tpu.ops.flash_attention import (flash_attention,
                                                    reference_attention)
-    from deepspeed_tpu.ops.decode_attention import decode_attention
 
     REL_TOL = 2e-2  # bf16 inputs: ~8e-3 observed; 2e-2 headroom for drift
     worst, cases, ok = 0.0, 0, True
@@ -744,29 +756,32 @@ def _kernel_parity_matrix() -> dict:
         ok = ok and max(errs_f) < 2e-3
         cases += 1
 
-    # decode kernel: legacy (row in buffer) and fresh-row modes, checked
-    # against the XLA fallback in models/transformer._decode_attention
-    # (cfg=None forces the XLA path) so the masking contract lives in ONE
-    # place instead of a re-implemented reference drifting here
-    from deepspeed_tpu.models.transformer import _decode_attention
-    for T, Nkv, rep, D, idx, row_mode in [(2048, 8, 1, 64, 1500, True),
-                                          (1024, 2, 4, 128, 600, True),
-                                          (1024, 4, 2, 64, 900, False)]:
-        ks = jax.random.split(jax.random.PRNGKey(T + idx), 5)
-        B = 2
-        q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.bfloat16)
-        ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.bfloat16)
-        cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.bfloat16)
-        if row_mode:
-            k_row = jax.random.normal(ks[3], (B, Nkv, 1, D), jnp.bfloat16)
-            v_row = jax.random.normal(ks[4], (B, Nkv, 1, D), jnp.bfloat16)
-            out = decode_attention(q, ck, cv, idx, kv_row=(k_row, v_row))
-            ref = _decode_attention(q, ck, cv, idx, None,
-                                    kv_row=(k_row, v_row))
-        else:
-            out = decode_attention(q, ck, cv, idx)
-            ref = _decode_attention(q, ck, cv, idx, None)
-        err = _rel_err(out, ref)
+    # paged decode kernel (block-table gather resolved in the index maps)
+    # vs the XLA gather path through models/transformer._paged_attention
+    # (which itself feeds _decode_attention) so the masking contract lives
+    # in ONE place instead of a re-implemented reference drifting here.
+    # Mixed per-slot lengths incl. 0 (fresh slot) and a full table.
+    from deepspeed_tpu.models.transformer import _paged_attention
+    for S, NB, MB, Nkv, rep, bs, D in [(8, 33, 4, 8, 1, 64, 64),
+                                       (4, 17, 4, 2, 4, 128, 128),
+                                       (2, 9, 4, 4, 2, 256, 64)]:
+        ks = jax.random.split(jax.random.PRNGKey(NB * bs + D), 5)
+        q = jax.random.normal(ks[0], (S, 1, Nkv * rep, D), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (NB, Nkv, bs, D), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (NB, Nkv, bs, D), jnp.bfloat16)
+        kr = jax.random.normal(ks[3], (S, Nkv, 1, D), jnp.bfloat16)
+        vr = jax.random.normal(ks[4], (S, Nkv, 1, D), jnp.bfloat16)
+        rng_t = np.random.default_rng(S + D)
+        tabs = jnp.asarray(rng_t.permutation(np.arange(1, NB))[:S * MB]
+                           .reshape(S, MB), jnp.int32)
+        lens = jnp.asarray(
+            np.concatenate([[0], rng_t.integers(1, MB * bs, size=S - 1)])
+            if S > 1 else [MB * bs], jnp.int32)
+        o_p = _paged_attention(q, kp, vp, tabs, lens, None,
+                               kv_row=(kr, vr), backend="pallas")
+        o_x = _paged_attention(q, kp, vp, tabs, lens, None,
+                               kv_row=(kr, vr), backend="xla")
+        err = _rel_err(o_p, o_x)
         worst = max(worst, err)
         ok = ok and err < REL_TOL
         cases += 1
@@ -1017,12 +1032,21 @@ def _sparse_kernel_bench(S: int = 32768, iters: int = 5) -> dict:
             f"sparse_{tag}_speedup": round(de / sp, 2)}
 
 
+# r4's measured decode_bs8_ctx256_bf16 — the floor the rung must never
+# silently sink below again (the r5 regression: a blanket int8-KV default
+# quietly flipped the "bf16" rung to a quantized cache; rungs now pin their
+# cache dtype explicitly and the floor assertion makes any regression LOUD)
+DECODE_CTX256_FLOOR = 2853.0
+
+
 def _decode_bench(size: str) -> dict:
     """KV-cache decode throughput sweep (generated tokens/sec across the
-    batch): batch x context x weight-dtype rungs via the jitted windowed
-    scan decode loop. Decode at short context is weight/op-latency bound
-    (int8 and batch scaling are the levers); long context adds the
-    length-aware cache-read term."""
+    batch): batch x context x weight/cache-dtype rungs via the jitted
+    windowed scan decode loop. Decode at short context is weight/op-latency
+    bound (int8 WEIGHTS and batch scaling are the levers — an int8 CACHE
+    only adds quantize overhead there); long context adds the cache-read
+    term, where int8 KV halves the bytes. Every rung pins kv_cache_bits +
+    max_tokens so its name tells the truth about what it measures."""
     import gc as _gc
     import jax
     import deepspeed_tpu
@@ -1031,16 +1055,18 @@ def _decode_bench(size: str) -> dict:
     cfg = llama_config(size, max_seq_len=4096)
     rng = np.random.default_rng(0)
     out = {}
-    # (key, batch, prompt, new, int8)
-    rungs = [("decode_bs8_ctx256_bf16", 8, 128, 128, False),
-             ("decode_bs8_ctx2048_bf16", 8, 1920, 128, False),
-             ("decode_bs32_ctx256_int8", 32, 128, 128, True)]
-    for key, B, prompt, new, int8 in rungs:
+    # (key, batch, prompt, new, quantize_weights, kv_bits, max_tokens)
+    rungs = [("decode_bs8_ctx256_bf16", 8, 128, 128, False, 0, 256),
+             ("decode_bs8_ctx2048_bf16", 8, 1920, 128, False, 0, 2048),
+             ("decode_bs8_ctx2048_int8kv", 8, 1920, 128, False, 8, 2048),
+             ("decode_bs32_ctx256_int8", 32, 128, 128, True, 8, 256)]
+    for key, B, prompt, new, int8w, kvb, mt in rungs:
         try:
             model = make_model(cfg, name=f"llama-{size}")
             eng = deepspeed_tpu.init_inference(model, config={
                 "train_batch_size": 1,
-                **({"quantize_bits": 8} if int8 else {})})
+                "kv_cache_bits": kvb, "max_tokens": mt,
+                **({"quantize_bits": 8} if int8w else {})})
             ids = rng.integers(0, cfg.vocab_size, size=(B, prompt),
                                dtype=np.int32)
             np.asarray(jax.device_get(eng.generate(ids, max_new_tokens=new)))
@@ -1052,6 +1078,144 @@ def _decode_bench(size: str) -> dict:
         except Exception as e:  # noqa: BLE001 — keep completed rungs
             print(f"bench: decode rung {key} failed: {e}", file=sys.stderr)
         _gc.collect()
+    if "decode_bs8_ctx256_bf16" in out:
+        ok = out["decode_bs8_ctx256_bf16"] >= DECODE_CTX256_FLOOR
+        out["decode_floor_ok"] = bool(ok)
+        if not ok:
+            print("bench: DECODE FLOOR FAILED: decode_bs8_ctx256_bf16 "
+                  f"{out['decode_bs8_ctx256_bf16']} < {DECODE_CTX256_FLOOR} "
+                  "(r4 measured floor — see ISSUE 9 satellite 1)",
+                  file=sys.stderr)
+    return out
+
+
+def _paged_backend_microbench(cfg, n_slots: int, num_blocks: int,
+                              block_size: int, MB: int,
+                              iters: int = 10) -> dict:
+    """Time the paged Pallas decode kernel vs the XLA gather on a bf16
+    pool with the serving rung's geometry. Delegates to the SAME
+    representative-load recipe ServingEngine._select_backend measures at
+    init (inference/serving.measure_paged_backends) — the bench's
+    serve_backend_* evidence can't desynchronize from the engine's."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.serving import measure_paged_backends
+
+    nkv, hd = cfg.kv_heads, cfg.dim_per_head
+    ks = jax.random.split(jax.random.PRNGKey(1), 2)
+    kp = jax.random.normal(ks[0], (num_blocks, nkv, block_size, hd),
+                           jnp.bfloat16)
+    vp = jax.random.normal(ks[1], (num_blocks, nkv, block_size, hd),
+                           jnp.bfloat16)
+    xla_ms, pallas_ms = measure_paged_backends(
+        cfg, kp, vp, max_seqs=n_slots, MB=MB, block_size=block_size,
+        num_blocks=num_blocks, dtype=jnp.bfloat16, iters=iters)
+    return {"serve_backend_xla_ms": round(xla_ms, 3),
+            "serve_backend_pallas_ms": round(pallas_ms, 3),
+            "serve_backend_pallas_speedup": round(xla_ms / pallas_ms, 3),
+            "serve_backend_note": "bf16-pool microbench (headline pool "
+                                  "is int8 -> engine auto-selects XLA)"}
+
+
+def _serving_bench(size: str, n_requests: int = 32,
+                   max_new: int = 64, small: bool = False) -> dict:
+    """Multi-tenant serving SLO rung: continuous batching + paged KV cache
+    + quantized decode at bs=32 over MIXED context lengths (64..1024 token
+    prompts). Emits time-to-first-token p50/p99 and aggregate generated
+    tok/s, plus the measured paged-kernel-vs-XLA micro-bench the engine's
+    backend auto-select ran at init.
+
+    The one-shot comparison serves the SAME requests sequentially through
+    the engine's generate() loop — `serve_vs_oneshot_speedup` > 1 is the
+    continuous-batching win the acceptance bar names (shared pool + slot
+    interleaving vs per-request batch-1 decode)."""
+    import gc as _gc
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama_config, make_model
+
+    overrides = dict(vocab_size=2048, num_layers=2, hidden_size=128,
+                     num_heads=4, num_kv_heads=2,
+                     intermediate_size=384) if small else {}
+    cfg = llama_config(size, max_seq_len=4096, **overrides)
+    rng = np.random.default_rng(0)
+    model = make_model(cfg, name=f"llama-{size}")
+    srv = deepspeed_tpu.init_serving(
+        model, config={"train_batch_size": 1},
+        serving=(dict(max_seqs=n_requests, block_size=16,
+                      max_model_len=128, decode_quantum=4,
+                      prompt_bucket=16) if small else
+                 # 640 blocks = the 32-request mixed load's ~544-block peak
+                 # + headroom, NOT full residency (32 slots x 2048 tokens
+                 # would pin 1025 blocks ~3GB int8 on a 7b rung); the
+                 # scheduler queues/preempts if the load runs hotter —
+                 # serve_preemptions in the JSON makes that visible
+                 dict(max_seqs=n_requests, block_size=64,
+                      max_model_len=2048, decode_quantum=8,
+                      num_blocks=640)))
+    prompts = [16, 32, 48] if small else [64, 128, 256, 512, 1024]
+    reqs = [(rng.integers(0, cfg.vocab_size,
+                          size=(prompts[i % len(prompts)],),
+                          ).astype(np.int32), max_new)
+            for i in range(n_requests)]
+    # warm the compiles outside the timed window (one prefill per prompt
+    # bucket + the shared quantum step), then serve the real load fresh
+    srv.run([(rng.integers(0, cfg.vocab_size, size=(p,)).astype(np.int32),
+              8) for p in prompts])
+    srv.reset_stats()
+    t0 = time.perf_counter()
+    srv.run(reqs)
+    serve_dt = time.perf_counter() - t0
+    st = srv.stats()
+    out = {
+        "serve_p50_ttft_ms": round(st.get("p50_ttft_ms", 0.0), 1),
+        "serve_p99_ttft_ms": round(st.get("p99_ttft_ms", 0.0), 1),
+        "serve_tok_per_sec_bs32_mixed": round(
+            st.get("generated_tokens", 0.0) / serve_dt, 1),
+        "serve_preemptions": int(st.get("preemptions", 0)),
+        "serve_pool_bytes": int(st.get("pool_bytes", 0)),
+        "serve_decode_backend": srv.decode_backend,
+    }
+    for k, v in srv.backend_bench.items():
+        if k != "backend":
+            out[f"serve_backend_{k}"] = v
+    # the acceptance bar wants the paged kernel MEASURED in-bench. The
+    # quantized headline pool is int8, which short-circuits the engine's
+    # auto-select to XLA without timing — so time both backends on a
+    # bf16 pool of the same geometry here (the layout the kernel exists
+    # for; if it keeps losing this micro-bench on real hardware, delete
+    # it like its contiguous predecessor).
+    if srv.backend_bench.get("reason", "").startswith("int8"):
+        try:
+            out.update(_paged_backend_microbench(
+                cfg, n_slots=n_requests, num_blocks=srv.num_blocks,
+                block_size=srv.config.block_size, MB=srv.MB))
+        except Exception as e:  # noqa: BLE001 — evidence rung, not gate
+            print(f"bench: paged-kernel microbench failed: {e}",
+                  file=sys.stderr)
+    # one-shot same-load comparison (sequential batch-1 generate through
+    # the same params/int8-KV config the serving engine runs)
+    try:
+        eng = srv.engine
+        total = 0
+        # warm the generate compiles for every prompt bucket in the load
+        for p in prompts:
+            np.asarray(jax.device_get(eng.generate(
+                rng.integers(0, cfg.vocab_size, size=(1, p)).astype(
+                    np.int32), max_new_tokens=max_new)))
+        t0 = time.perf_counter()
+        for p, n in reqs:
+            np.asarray(jax.device_get(
+                eng.generate(p[None], max_new_tokens=n)))
+            total += n
+        dt = time.perf_counter() - t0
+        out["oneshot_tok_per_sec_same_load"] = round(total / dt, 1)
+        out["serve_vs_oneshot_speedup"] = round(
+            out["serve_tok_per_sec_bs32_mixed"] / (total / dt), 2)
+    except Exception as e:  # noqa: BLE001 — comparison is secondary
+        print(f"bench: one-shot comparison failed: {e}", file=sys.stderr)
+    del srv
+    _gc.collect()
     return out
 
 

@@ -453,6 +453,61 @@ def serialized_backward(devices=None):
             max_exposed_collectives=0, min_exposed_bytes=1))
 
 
+def _paged_decode_program(num_blocks: int, devices=None):
+    """The serving tier's paged decode step (models/transformer
+    decode_step_paged) lowered on abstract shapes: a tiny transformer, 4
+    slots, a block pool of `num_blocks` 32-token blocks. The pool enters as
+    donated state, so MemoryLint's liveness model prices it like any other
+    resident buffer."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  make_model)
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=256,
+                            dtype=jnp.float32, attention_impl="xla")
+    model = make_model(cfg, name="tiny-serve")
+    S, MB, bs = 4, 8, 32
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    pools = jax.eval_shape(
+        lambda: model.init_paged_cache(num_blocks, bs))
+    toks = jax.ShapeDtypeStruct((S,), jnp.int32)
+    tables = jax.ShapeDtypeStruct((S, MB), jnp.int32)
+    lens = jax.ShapeDtypeStruct((S,), jnp.int32)
+
+    def step(params, pools, tokens, tables, lens):
+        logits, pools = model.decode_step_paged(params, tokens, pools,
+                                                tables, lens, backend="xla")
+        return jnp.argmax(logits, -1).astype(jnp.int32), pools
+
+    jitted = jax.jit(step, donate_argnums=(1,))
+    return lower_program(
+        jitted, abstractify(params), pools, toks, tables, lens,
+        name="serve_decode_step", donatable={"pools": pools},
+        donation_expected=False, meta={"skip_required": True})
+
+
+# between the two pool sizings: measured modeled peaks ~1.18 MiB (33-block
+# pool, correctly freed) vs ~2.21 MiB (96-block leak) on jax 0.4.37 —
+# re-measure BOTH variants before retuning (same protocol as remat-missing)
+PAGED_LEAK_BUDGET = 1536 << 10   # 1.5 MiB
+
+
+def paged_cache_leak(devices=None):
+    """Memory lint: a serving block pool sized as if FINISHED requests'
+    blocks were never freed — the classic paged-cache leak (an eviction
+    path that forgets allocator.free). Peak concurrency on this toy rung
+    is 4 slots x 8 blocks (+ trash) = 33 blocks; the leaked variant holds
+    the whole request history's 96 blocks resident, and the static peak
+    blows the budget (`memory-peak` must fire). The CORRECTLY-freed twin
+    (33 blocks, same program otherwise) stays under the identical budget —
+    tests assert both directions."""
+    art = _paged_decode_program(num_blocks=96, devices=devices)
+    return analyze_programs(
+        [art], _stage0_config(), _FakePlan(),
+        settings=AnalysisSettings(max_hbm_bytes=PAGED_LEAK_BUDGET))
+
+
 def exposed_collective_trace(devices=None):
     """Perf doctor gate: a TRACED step (not a compiled program) whose
     all-reduce runs with nothing scheduled under it — 8 ms of measured
@@ -475,6 +530,7 @@ CORPUS = {
     "deferred-sync-regression": deferred_sync_regression,
     "remat-missing": remat_missing,
     "stage3-replicated-opt": stage3_replicated_opt,
+    "paged-cache-leak": paged_cache_leak,
     "exposed-collective-trace": exposed_collective_trace,
     "serialized-backward": serialized_backward,
 }

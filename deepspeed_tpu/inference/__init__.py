@@ -1,1 +1,7 @@
-from deepspeed_tpu.inference.engine import InferenceEngine, InferenceConfig, init_inference
+from deepspeed_tpu.inference.engine import (InferenceEngine, InferenceConfig,
+                                            init_inference)
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
+                                              BlockPoolExhausted, blocks_for)
+from deepspeed_tpu.inference.scheduler import Request, RequestScheduler
+from deepspeed_tpu.inference.serving import (ServingConfig, ServingEngine,
+                                             init_serving)

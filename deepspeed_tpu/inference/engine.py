@@ -71,8 +71,11 @@ class InferenceConfig:
     fuse_gemms: Optional[bool] = None
     # int8 KV cache for decode: at long context the cache read is the
     # decode bound, and int8 halves it (per-position scales keep the
-    # softmax exact to ~1e-2 rel). None -> ON for transformer decode
-    # (pass 0 to opt out and keep the compute-dtype cache).
+    # softmax exact to ~1e-2 rel). None -> context-aware default: ON when
+    # max_tokens >= 1024, OFF below it. At short context decode is
+    # op-latency bound and the per-step quantize overhead can never pay
+    # for the halved read — the r5 blanket-int8 default cost the ctx-256
+    # rung 2.6% (2853 -> 2779 tok/s) before this threshold existed.
     kv_cache_bits: Optional[int] = None
 
 
@@ -102,18 +105,16 @@ class InferenceEngine:
         from deepspeed_tpu.models.transformer import TransformerConfig
         is_tf = isinstance(getattr(model, "config", None), TransformerConfig)
 
-        # int8 KV cache (default ON for transformer decode): the ModelSpec
-        # closures capture the config, so flip the flag by REBUILDING the
-        # spec before the quantize/fuse branches below read model.config.
-        # A model that explicitly asked for the Pallas decode kernel
-        # (attention_impl="pallas") keeps its float cache by default — the
-        # kernel reads float buffers, and silently bypassing it would
-        # change the path the user selected.
+        # int8 KV cache: the ModelSpec closures capture the config, so flip
+        # the flag by REBUILDING the spec before the quantize/fuse branches
+        # below read model.config. The default keys off the engine's
+        # declared context budget (max_tokens): the int8 read only pays
+        # where the cache read dominates the step, i.e. long context —
+        # measured crossover ~1k positions on v5e (see InferenceConfig).
         if is_tf:
             kvb = config.kv_cache_bits
             if kvb is None:
-                kvb = (model.config.kv_cache_bits
-                       if model.config.attention_impl == "pallas" else 8)
+                kvb = 8 if int(config.max_tokens or 0) >= 1024 else 0
             kvb = int(kvb)
             if kvb not in (0, 8):
                 raise ValueError(f"kv_cache_bits={kvb} unsupported "

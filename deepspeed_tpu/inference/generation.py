@@ -1,4 +1,4 @@
-"""Decode loops.
+"""One-shot decode loops (single batch, lockstep sequences).
 
 Primary path — KV cache (reference: the fixed decode workspace of
 ``csrc/transformer/inference/includes/inference_context.h`` plus the
@@ -9,6 +9,13 @@ and exactly two compilations per (batch, bucket) shape.
 
 Fallback — fixed-shape full recompute for models without the cache protocol:
 the token buffer is padded so the forward compiles once; correct but O(n^2).
+
+MULTI-TENANT serving (variable-length requests arriving/finishing
+independently) lives in ``inference/serving.py``: continuous batching over
+a paged KV cache, same per-step math (pinned bit-for-bit against this
+loop's decode in tests/unit/test_serving.py). This module remains the
+right tool for one batch decoded in lockstep — its whole-scan program has
+less dispatch overhead than the serving engine's per-step dispatches.
 """
 
 import jax

@@ -1,0 +1,95 @@
+"""Paged KV-cache management: a host-side free list over the device block
+pool.
+
+The device half lives in ``models/transformer``: fixed-size blocks in
+preallocated pools ``[L, NB, n_kv, block_size, head_dim]``, per-sequence
+block tables, gather-based attention reads (``decode_step_paged``). This
+module is the HOST half — which physical block holds which sequence's
+tokens. It is deliberately pure Python/numpy with no jax imports: block
+accounting runs on every scheduling boundary and must never trigger a
+device sync, and the scheduler tests exercise it with no devices at all.
+
+Reference analogue: the fixed decode workspace of
+``csrc/transformer/inference/includes/inference_context.h`` allocates ONE
+contiguous region per batch and rejects what doesn't fit; the block pool
+generalizes that region into units any request can hold, which is what lets
+admission/eviction happen at step boundaries without recompiling (vLLM's
+PagedAttention idea, SURVEY §6 capability bar).
+
+Block 0 is RESERVED as the trash block: null table entries point at it and
+inactive slots write their lockstep rows into it, so the compiled decode
+step needs no scatter masking and freed blocks never need zeroing (stale
+contents are masked by the per-slot length — pinned by the garbage tests).
+"""
+
+from typing import List
+
+
+class BlockPoolExhausted(Exception):
+    """Raised by ``alloc`` when the free list can't cover a request — the
+    scheduler catches this and queues/preempts instead of OOMing."""
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` pool blocks (block 0
+    reserved). O(1) alloc/free; double-free and trash-block-free raise —
+    an accounting bug here silently corrupts another request's cache."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks={num_blocks}: need >= 2 "
+                             "(block 0 is the reserved trash block)")
+        self.num_blocks = num_blocks
+        # LIFO: recently freed (cache-warm) blocks are reused first
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._held = [False] * num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(pool {self.num_blocks})")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._held[b] = True
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("freeing the reserved trash block 0")
+            if not self._held[b]:
+                raise ValueError(f"double free of block {b}")
+            self._held[b] = False
+            self._free.append(b)
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks covering n_tokens rows (0 tokens -> 0 blocks)."""
+    return -(-n_tokens // block_size)
+
+
+def pool_bytes(cfg, num_blocks: int, block_size: int, dtype=None) -> int:
+    """Resident bytes of the block pools for a transformer config — the
+    paged-cache memory math the README documents and the serving bench
+    reports. int8: 1 byte/elem payload + 4 bytes/row/head scale x2 (k, v);
+    float: itemsize of the POOL dtype x2 — pass the engine's compute dtype
+    (the pools are allocated with it, which may differ from cfg.dtype)."""
+    L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
+    rows = L * num_blocks * nkv * block_size
+    if cfg.kv_cache_bits == 8:
+        return rows * hd * 2 + rows * 4 * 2
+    import numpy as _np
+    itemsize = _np.dtype(dtype if dtype is not None else cfg.dtype).itemsize
+    return rows * hd * itemsize * 2

@@ -1,0 +1,213 @@
+"""Continuous-batching request scheduler (host side, no jax).
+
+Reference capability bar: the SURVEY §6 InferenceEngine serves ONE batch
+per generate() call — every request in a batch shares a shape bucket and
+the whole batch finishes together. Continuous (in-flight) batching admits
+and evicts sequences at DECODE-STEP boundaries instead: the compiled step
+is shaped by the block pool and the slot count only, so membership changes
+are pure data (block-table contents, active mask) — never a recompile.
+
+Policy (the vLLM shape):
+  - FIFO admission: waiting requests admit in arrival order whenever a slot
+    AND enough pool blocks (prompt + one scheduling quantum of growth) are
+    free. Pool exhaustion queues gracefully — never an error.
+  - Growth: before each quantum every running sequence gets blocks covering
+    its next `quantum` tokens. If the pool can't cover it, the NEWEST
+    running sequence is preempted (blocks freed, request re-queued at the
+    FRONT with its generated tokens kept) until growth fits — latest-
+    admitted-first keeps the oldest requests making progress, bounding
+    tail latency instead of deadlocking the whole pool.
+  - Eviction: a finished sequence frees its slot and blocks at the next
+    boundary; freed blocks admit the queue head immediately.
+
+Preempted requests resume by RE-PREFILLING prompt+generated (recompute, the
+vLLM default): cheap at serving contexts and needs zero extra pool state.
+"""
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator, blocks_for)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its full serving lifecycle."""
+    rid: int
+    prompt: np.ndarray                     # [P] int32 (original prompt)
+    max_new_tokens: int
+    submit_t: float = 0.0
+    # lifecycle: waiting -> running -> finished (preempt: back to waiting)
+    state: str = "waiting"
+    slot: Optional[int] = None
+    block_ids: List[int] = dataclasses.field(default_factory=list)
+    generated: List[int] = dataclasses.field(default_factory=list)
+    # KV rows actually in the pool (a (re-)prefill sets it to the context
+    # length; each decode step adds one) — the serving engine's masks and
+    # the scheduler's block-growth math both read THIS, not len(context)
+    cached_rows: int = 0
+    # set the moment an eos token is appended (O(1) finish checks — a
+    # membership scan of `generated` per token would be quadratic)
+    eos_seen: bool = False
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    preemptions: int = 0
+
+    @property
+    def context(self) -> np.ndarray:
+        """Tokens to (re-)prefill: prompt + everything generated so far."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate([self.prompt,
+                               np.asarray(self.generated, np.int32)])
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def output(self) -> np.ndarray:
+        """Final result ids — identical to `context` by design: what would
+        be re-prefilled on preemption IS what the caller receives."""
+        return self.context
+
+
+class RequestScheduler:
+    """Admission/eviction/preemption over a BlockAllocator + slot set.
+
+    Pure host logic: `schedule()` returns the decisions (admitted /
+    preempted requests); the serving engine turns them into prefill
+    dispatches and table updates. `prompt_blocks(n_tokens)` maps a
+    (re-)prefill context length to the blocks its padded bucket occupies —
+    injected so the scheduler stays ignorant of shape-bucketing policy.
+    """
+
+    def __init__(self, allocator: BlockAllocator, max_seqs: int,
+                 block_size: int, quantum: int,
+                 prompt_blocks: Callable[[int], int],
+                 max_blocks_per_seq: Optional[int] = None):
+        self.allocator = allocator
+        self.max_seqs = max_seqs
+        self.block_size = block_size
+        self.quantum = quantum
+        self.prompt_blocks = prompt_blocks
+        # block-table width: growth clamps here — a sequence at its context
+        # cap whose budget ran out mid-quantum writes its (discarded)
+        # overshoot rows into its own last block, never past the table
+        self.max_blocks_per_seq = max_blocks_per_seq or (1 << 30)
+        self.waiting: Deque[Request] = collections.deque()
+        self.running: List[Request] = []   # admission order (oldest first)
+        self._free_slots = list(range(max_seqs - 1, -1, -1))
+        self._next_rid = 0
+
+    # ---- request lifecycle -------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               rid: Optional[int] = None) -> Request:
+        req = Request(rid=self._next_rid if rid is None else rid,
+                      prompt=np.asarray(prompt, np.int32).reshape(-1),
+                      max_new_tokens=int(max_new_tokens),
+                      submit_t=time.perf_counter())
+        self._next_rid = max(self._next_rid, req.rid) + 1
+        self.waiting.append(req)
+        return req
+
+    def finish(self, req: Request) -> None:
+        """Evict a completed sequence: slot and blocks return to the pool."""
+        assert req.state == "running", req.state
+        req.state = "finished"
+        req.finish_t = time.perf_counter()
+        self.running.remove(req)
+        self._free_slots.append(req.slot)
+        if req.block_ids:
+            self.allocator.free(req.block_ids)
+        req.block_ids = []
+        req.slot = None
+
+    # ---- the per-quantum decision ------------------------------------
+
+    def _preempt_newest(self) -> Optional[Request]:
+        if not self.running:
+            return None
+        req = self.running.pop()               # newest admission
+        req.state = "waiting"
+        req.preemptions += 1
+        req.cached_rows = 0                    # resumes by re-prefilling
+        self._free_slots.append(req.slot)
+        self.allocator.free(req.block_ids)
+        req.block_ids = []
+        req.slot = None
+        self.waiting.appendleft(req)           # resumes before new arrivals
+        return req
+
+    def _grow(self, req: Request, target_len: int) -> bool:
+        want = min(blocks_for(target_len, self.block_size),
+                   self.max_blocks_per_seq)
+        need = want - len(req.block_ids)
+        if need <= 0:
+            return True
+        if not self.allocator.can_alloc(need):
+            return False
+        req.block_ids.extend(self.allocator.alloc(need))
+        return True
+
+    def schedule(self) -> Dict[str, List[Request]]:
+        """One step-boundary decision. Returns {"admitted": [...],
+        "preempted": [...]}; admitted requests have slot + prompt blocks
+        assigned (the engine must prefill them), running requests are
+        guaranteed block coverage for the next quantum."""
+        preempted: List[Request] = []
+        # 1. growth for the already-running, oldest first; exhaustion
+        #    preempts from the newest end until the oldest fit
+        for req in list(self.running):
+            if req.state != "running":
+                continue                        # lost its slot this round
+            # the quantum writes rows cached_rows .. cached_rows+quantum-1
+            target = req.cached_rows + self.quantum
+            while not self._grow(req, target):
+                victim = self._preempt_newest()
+                if victim is None or victim is req:
+                    # req itself was the newest: it stays preempted (its
+                    # re-admission below or later will retry smaller)
+                    if victim is req:
+                        preempted.append(req)
+                    break
+                preempted.append(victim)
+        # 2. FIFO admission while a slot AND blocks are free
+        admitted: List[Request] = []
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            ctx = len(req.context)
+            # the request holds its padded prompt bucket's blocks plus the
+            # first quantum's growth, whichever covers more — position-
+            # ordered (block_ids[i] covers rows [i*bs, (i+1)*bs))
+            need = min(max(self.prompt_blocks(ctx),
+                           blocks_for(ctx + self.quantum, self.block_size)),
+                       self.max_blocks_per_seq)
+            if not self.allocator.can_alloc(need):
+                break                           # graceful queuing, no OOM
+            self.waiting.popleft()
+            req.block_ids = self.allocator.alloc(need)
+            req.slot = self._free_slots.pop()
+            req.state = "running"
+            self.running.append(req)
+            admitted.append(req)
+        return {"admitted": admitted, "preempted": preempted}
+
+    # ---- introspection -----------------------------------------------
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    @property
+    def done(self) -> bool:
+        return not self.waiting and not self.running

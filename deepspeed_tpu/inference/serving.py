@@ -1,0 +1,545 @@
+"""Serving engine: continuous batching over a paged KV cache.
+
+Replaces the one-shot ``generate()`` loop as the multi-tenant serving path
+(ROADMAP item 2, SURVEY §6 capability bar). Three pieces:
+
+  1. **Paged KV cache** — fixed-size blocks in preallocated pools, per-
+     sequence block tables, gather-based reads (models/transformer
+     ``decode_step_paged``). The decode step compiles ONCE for the pool
+     shape; admitting/evicting sequences changes table CONTENTS only.
+  2. **Continuous batching** — a RequestScheduler admits/evicts/preempts at
+     step boundaries. The host loop reuses the PR-2 bounded-dispatch-window
+     idea: prefills of admitted requests and the quantum's decode steps all
+     dispatch WITHOUT a host sync between them (the device queue overlaps
+     prefill of new requests with decode of running ones); the only sync is
+     ONE fetch of the round's sampled tokens at the scheduling boundary.
+  3. **Quantized decode** — int8 KV blocks (dequant fused into the
+     attention read via score scaling, ops/quantizer) and int8 weights via
+     the InferenceEngine's existing ``quantize_bits`` path.
+
+The decode-attention backend (paged Pallas kernel vs the XLA gather) is
+picked by a MEASURED micro-bench on the real pool shapes at engine init —
+never a config flag — and the choice is logged as a structured telemetry
+event (``decode_backend_selected``).
+
+Token/row bookkeeping (the invariant every path maintains):
+``req.cached_rows`` = KV rows actually in the pool for this request. A
+(re-)prefill sets it to ``len(context)`` and leaves the NEXT sampled token
+pending in the device token vector; each decode step writes the pending
+token's row (cached_rows + 1) and samples a new pending token. Host-side
+``generated`` absorbs the pending chain at the round boundary from the one
+token fetch.
+"""
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.kv_cache import BlockAllocator, pool_bytes
+from deepspeed_tpu.inference.scheduler import Request, RequestScheduler
+
+
+def measure_paged_backends(mcfg, k_pool, v_pool, *, max_seqs: int, MB: int,
+                           block_size: int, num_blocks: int, dtype,
+                           iters: int = 10, mesh=None):
+    """Time the paged Pallas kernel vs the XLA gather over the given
+    single-layer pools on a representative load: every slot half-to-full,
+    blocks scattered through the pool (a fresh pool's identity layout
+    would flatter the gather). Returns (xla_ms, pallas_ms).
+
+    ONE recipe shared by ServingEngine._select_backend (real pools at
+    engine init) and bench._paged_backend_microbench (synthetic bf16
+    pools when the headline pool is int8) — the bench's serve_backend_*
+    evidence stays exactly what the engine measures."""
+    import contextlib
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.transformer import _paged_attention
+
+    nkv, hd, nq = mcfg.kv_heads, mcfg.dim_per_head, mcfg.num_heads
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (max_seqs, 1, nq, hd), dtype)
+    kr = jax.random.normal(ks[1], (max_seqs, nkv, 1, hd), dtype)
+    vr = jax.random.normal(ks[2], (max_seqs, nkv, 1, hd), dtype)
+    rng = np.random.default_rng(0)
+    ids = np.zeros((max_seqs, MB), np.int32)
+    perm = rng.permutation(np.arange(1, num_blocks))
+    n_per = max(1, min(MB, (num_blocks - 1) // max(1, max_seqs)))
+    for s in range(max_seqs):
+        row = perm[(s * n_per) % len(perm):][:n_per]
+        ids[s, :len(row)] = row
+    tables = jnp.asarray(ids)
+    lens = jnp.asarray(rng.integers(max(1, block_size * n_per // 2),
+                                    block_size * n_per + 1,
+                                    size=(max_seqs,)), jnp.int32)
+
+    def timed(backend):
+        f = jax.jit(lambda q, kp, vp: _paged_attention(
+            q, kp, vp, tables, lens, mcfg, kv_row=(kr, vr),
+            backend=backend))
+        np.asarray(jax.device_get(f(q, k_pool, v_pool)))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = f(q, k_pool, v_pool)
+        np.asarray(jax.device_get(o))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    with (mesh if mesh is not None else contextlib.nullcontext()):
+        return timed("xla"), timed("pallas")
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs of the serving tier (see README "Serving" for the memory
+    math). Pool sizing: ``num_blocks`` defaults to full residency —
+    every slot can hold ``max_model_len`` tokens — plus the trash block;
+    shrink it to oversubscribe (the scheduler queues/preempts instead of
+    OOMing)."""
+    max_seqs: int = 8                  # concurrent sequences (slots)
+    block_size: int = 64               # tokens per KV block
+    num_blocks: Optional[int] = None   # pool blocks incl. trash block 0
+    max_model_len: Optional[int] = None  # per-request context cap
+    decode_quantum: int = 8            # decode steps per scheduling round
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    decode_backend: str = "auto"       # auto | xla | pallas
+    prompt_bucket: int = 64            # prompt pad granularity (compile reuse)
+    backend_bench_iters: int = 10      # micro-bench timing iterations
+
+
+class ServingEngine:
+    """Continuous-batching server over an InferenceEngine's params/mesh.
+
+    >>> eng = init_inference(model, config={...})
+    >>> srv = ServingEngine(eng, ServingConfig(max_seqs=32))
+    >>> outs = srv.run([(prompt_ids, 64), ...])   # {rid: output ids}
+    >>> srv.stats()                               # TTFT p50/p99, tok/s
+    """
+
+    def __init__(self, engine, config: Optional[ServingConfig] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.parallel import spec_tree
+
+        self.engine = engine
+        self.config = config or ServingConfig()
+        c = self.config
+        model = engine.model
+        if model.decode_step_paged is None or model.prefill_paged is None:
+            raise ValueError("ServingEngine needs the paged decode "
+                             "protocol (models/transformer make_model)")
+        self.model = model
+        mcfg = model.config
+        if c.block_size < 8 or c.block_size % 8:
+            raise ValueError(f"block_size={c.block_size}: TPU tiling needs "
+                             "a multiple of 8")
+        if c.decode_backend not in ("auto", "xla", "pallas"):
+            # a typo'd backend would be recorded in telemetry while the
+            # attention dispatch silently ran XLA
+            raise ValueError(f"decode_backend={c.decode_backend!r}: one of "
+                             "auto | xla | pallas")
+        model_cap = getattr(mcfg, "max_seq_len", None)
+        want = int(c.max_model_len or model_cap or 2048)
+        want = -(-want // c.block_size) * c.block_size
+        if model_cap:
+            # never admit positions the model can't represent (learned
+            # position tables / rotary training range): clamp DOWN to the
+            # model cap, block-aligned
+            want = min(want, (model_cap // c.block_size) * c.block_size)
+        if want < c.block_size:
+            raise ValueError(
+                f"max_model_len/model max_seq_len ({c.max_model_len} / "
+                f"{model_cap}) leaves no room for one "
+                f"{c.block_size}-token block")
+        self.max_model_len = want
+        self.MB = self.max_model_len // c.block_size     # table width
+        num_blocks = c.num_blocks or (c.max_seqs * self.MB + 1)
+        if num_blocks - 1 < self.MB:
+            raise ValueError(
+                f"num_blocks={num_blocks}: one sequence at "
+                f"max_model_len={self.max_model_len} needs {self.MB} "
+                "blocks + the trash block")
+        self.num_blocks = num_blocks
+        # prompt buckets are block-aligned (prefill scatters whole blocks)
+        # and coarse (compiles are reused across nearby prompt lengths)
+        self._bucket = max(c.prompt_bucket, c.block_size)
+        if self._bucket % c.block_size:
+            self._bucket = -(-self._bucket // c.block_size) * c.block_size
+
+        self.allocator = BlockAllocator(num_blocks)
+        self.scheduler = RequestScheduler(
+            self.allocator, c.max_seqs, c.block_size, c.decode_quantum,
+            prompt_blocks=lambda n: self._pad_prompt(n) // c.block_size,
+            max_blocks_per_seq=self.MB)
+
+        # device state -------------------------------------------------
+        axes = (model.paged_cache_axes()
+                if model.paged_cache_axes is not None else None)
+        if axes is not None:
+            specs = spec_tree(axes, engine._rules)
+            self._pool_shardings = jax.tree.map(
+                lambda s: NamedSharding(engine.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            self._pool_shardings = None
+        with engine.mesh:
+            self.pools = jax.jit(
+                lambda: model.init_paged_cache(num_blocks, c.block_size,
+                                               dtype=engine.dtype),
+                out_shardings=self._pool_shardings)()
+        self.pool_bytes = pool_bytes(mcfg, num_blocks, c.block_size,
+                                     dtype=engine.dtype)
+        self._tokens = jnp.zeros((c.max_seqs,), jnp.int32)
+        self._requests: Dict[int, Request] = {}
+        self._finished: List[Request] = []
+        self._prefill_fns: Dict[int, Any] = {}
+        self._quantum_step = None
+        self._rng_counter = 0
+        self._stats_t0: Optional[float] = None
+
+        # backend micro-bench (one-time, on the REAL pool shapes) --------
+        self.decode_backend, self.backend_bench = self._select_backend()
+
+    # ---- shape bucketing ---------------------------------------------
+
+    def _pad_prompt(self, n: int) -> int:
+        return max(self._bucket,
+                   min(-(-n // self._bucket) * self._bucket,
+                       self.max_model_len))
+
+    # ---- backend selection (measured, not a flag) --------------------
+
+    def _select_backend(self):
+        """Time the paged Pallas kernel vs the XLA gather on THIS engine's
+        pool shapes and pick the winner; the decision is logged as a
+        telemetry event. Non-TPU backends and int8 pools skip straight to
+        XLA (interpret-mode Pallas is not a serving path; the int8 read
+        fuses dequant into the XLA score scaling)."""
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.robustness.events import emit
+
+        c = self.config
+        mcfg = self.model.config
+        forced = c.decode_backend if c.decode_backend != "auto" else None
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        # capability gate FIRST — _paged_attention would silently fall back
+        # to the XLA gather for these, so selecting (or honoring a forced)
+        # "pallas" here would make the telemetry event and the bench's
+        # serve_decode_backend misreport what actually runs
+        unavailable = None
+        if getattr(mcfg, "kv_cache_bits", 0) == 8:
+            unavailable = "int8 KV pool (fused-dequant XLA read)"
+        elif self.engine.dtype == jnp.float16:
+            unavailable = "f16 compute dtype (Mosaic has no f16)"
+        elif (getattr(mcfg, "position_type", None) == "alibi"
+              or getattr(mcfg, "attn_scale", None) is not None
+              or getattr(mcfg, "attn_windows", None)):
+            # attn_windows: decode_step_paged passes a TRACED per-layer
+            # window (even all-global entries), which the kernel gate
+            # rejects
+            unavailable = "kernel-unsupported attention variant"
+        elif mcfg.dim_per_head < 64:
+            # the deleted contiguous kernel carried the same hardware
+            # gate: sub-64 lanes don't lower well through Mosaic
+            unavailable = f"head_dim {mcfg.dim_per_head} < 64"
+        backend = reason = None
+        if unavailable is not None:
+            backend = "xla"
+            reason = (f"pallas unavailable ({unavailable})"
+                      if forced == "pallas" else unavailable)
+        elif forced:
+            backend, reason = forced, "forced by config"
+        elif not on_tpu:
+            backend, reason = "xla", "non-TPU backend"
+        if reason is not None:
+            bench = {"backend": backend, "reason": reason}
+            emit("decode_backend_selected", **bench)
+            return backend, bench
+
+        try:
+            xla_ms, pallas_ms = measure_paged_backends(
+                mcfg, self.pools["k"][0], self.pools["v"][0],
+                max_seqs=c.max_seqs, MB=self.MB, block_size=c.block_size,
+                num_blocks=self.num_blocks, dtype=self.engine.dtype,
+                iters=c.backend_bench_iters, mesh=self.engine.mesh)
+        except Exception as e:  # noqa: BLE001 — a Mosaic lowering failure
+            # on exotic shapes must degrade to the XLA gather, not take
+            # the whole serving engine down at init
+            bench = {"backend": "xla",
+                     "reason": f"pallas bench failed: {type(e).__name__}"}
+            emit("decode_backend_selected", **bench)
+            return "xla", bench
+        backend = "pallas" if pallas_ms < xla_ms else "xla"
+        bench = {"backend": backend, "xla_ms": round(xla_ms, 3),
+                 "pallas_ms": round(pallas_ms, 3),
+                 "pallas_speedup": round(xla_ms / pallas_ms, 3)}
+        emit("decode_backend_selected", **bench)
+        return backend, bench
+
+    # ---- jitted programs ---------------------------------------------
+
+    def _sample(self, logits, key):
+        import jax
+        import jax.numpy as jnp
+        t = self.config.temperature
+        if t and t > 0:
+            return jax.random.categorical(key, logits / t, axis=-1
+                                          ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _get_prefill_fn(self, P: int):
+        """One compile per prompt bucket P: prefill + block scatter + first
+        sampled token, all one program (one dispatch per admission)."""
+        fn = self._prefill_fns.get(P)
+        if fn is None:
+            import jax
+
+            def prefill(params, ids, pools, block_ids, length, key):
+                last, pools = self.model.prefill_paged(
+                    params, ids, pools, block_ids, length=length)
+                return self._sample(last, key), pools
+
+            fn = jax.jit(prefill, donate_argnums=(2,))
+            self._prefill_fns[P] = fn
+        return fn
+
+    def _get_quantum_step(self):
+        """The single decode step all slots share — compiled once for the
+        pool shape; dispatched `decode_quantum` times back-to-back with no
+        host sync in between (the PR-2 dispatch-window idea). Only the
+        pools and the length vector are donated: the sampled-token arrays
+        are collected across the quantum and fetched once."""
+        if self._quantum_step is None:
+            import jax
+            import jax.numpy as jnp
+
+            backend = self.decode_backend
+
+            def step(params, pools, tokens, tables, seq_lens, active, key):
+                logits, pools = self.model.decode_step_paged(
+                    params, tokens, pools, tables, seq_lens,
+                    active=active, backend=backend)
+                nxt = self._sample(logits, key)
+                nxt = jnp.where(active, nxt, tokens)
+                return pools, nxt, seq_lens + active.astype(jnp.int32)
+
+            self._quantum_step = jax.jit(step, donate_argnums=(1, 4))
+        return self._quantum_step
+
+    def _next_key(self):
+        import jax
+        self._rng_counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(20260803),
+                                  self._rng_counter)
+
+    # ---- request API -------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens: int = 64,
+                    request_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            # the prefill inherently samples one token; a 0-budget request
+            # would still emit it
+            raise ValueError(f"max_new_tokens={max_new_tokens}: must be "
+                             ">= 1")
+        if prompt.size + max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_model_len "
+                f"{self.max_model_len}")
+        req = self.scheduler.submit(prompt, max_new_tokens, rid=request_id)
+        self._requests[req.rid] = req
+        if self._stats_t0 is None:
+            self._stats_t0 = req.submit_t
+        return req.rid
+
+    def _dispatch_prefill(self, req: Request):
+        """Dispatch (no sync) the request's (re-)prefill: writes its
+        context rows into its blocks, leaves the next sampled token pending
+        in the device token vector AND as a per-request handle fetched at
+        the round boundary."""
+        import jax.numpy as jnp
+        ctx = req.context
+        P = self._pad_prompt(ctx.size)
+        buf = np.zeros((1, P), np.int32)
+        buf[0, :ctx.size] = ctx
+        nblk = P // self.config.block_size
+        block_ids = jnp.asarray(req.block_ids[:nblk], jnp.int32)
+        fn = self._get_prefill_fn(P)
+        with self.engine.mesh:
+            first, self.pools = fn(self.engine.params, jnp.asarray(buf),
+                                   self.pools, block_ids,
+                                   jnp.int32(ctx.size), self._next_key())
+        self._tokens = self._tokens.at[req.slot].set(first[0])
+        req.cached_rows = ctx.size
+        req._first_dev = first                 # fetched at round boundary
+
+    def _tables_device(self):
+        import jax.numpy as jnp
+        ids = np.zeros((self.config.max_seqs, self.MB), np.int32)
+        lens = np.zeros((self.config.max_seqs,), np.int32)
+        act = np.zeros((self.config.max_seqs,), bool)
+        for req in self.scheduler.running:
+            ids[req.slot, :len(req.block_ids)] = req.block_ids
+            lens[req.slot] = req.cached_rows
+            act[req.slot] = True
+        return jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(act)
+
+    def step(self) -> List[Request]:
+        """One scheduling round: evict/admit/preempt at the boundary, then
+        one decode quantum. Prefill dispatches and the quantum's K decode
+        dispatches issue with NO host sync between them; the single sync is
+        the token fetch at the end. Returns requests finished this round."""
+        import jax
+        import jax.numpy as jnp
+
+        decisions = self.scheduler.schedule()
+        for req in decisions["admitted"]:
+            self._dispatch_prefill(req)
+        if not self.scheduler.running:
+            return []
+
+        tables, seq_lens, active = self._tables_device()
+        step_fn = self._get_quantum_step()
+        tokens = self._tokens
+        tok_outs = []
+        with self.engine.mesh:
+            for _ in range(self.config.decode_quantum):
+                self.pools, tokens, seq_lens = step_fn(
+                    self.engine.params, self.pools, tokens, tables,
+                    seq_lens, active, self._next_key())
+                tok_outs.append(tokens)
+        self._tokens = tokens
+        # the ONE sync of the round: K x [S] sampled tokens AND every
+        # pending prefill token (computed before the quantum) ride a
+        # single device_get
+        pending = [(req, req._first_dev)
+                   for req in self.scheduler.running
+                   if getattr(req, "_first_dev", None) is not None]
+        toks, firsts = jax.device_get(
+            (jnp.stack(tok_outs), [f for _, f in pending]))
+        toks = np.asarray(toks)                                  # [K, S]
+        first_tok = {req.rid: int(np.asarray(f)[0])
+                     for (req, _), f in zip(pending, firsts)}
+        now = time.perf_counter()
+
+        finished: List[Request] = []
+        eos = self.config.eos_token_id
+        for req in list(self.scheduler.running):
+            slot = req.slot
+            if req.rid in first_tok:
+                # prefill's pending token: its KV row was written by the
+                # quantum's step 0, so it is part of the sequence now
+                self._append(req, first_tok[req.rid], eos)
+                req._first_dev = None
+                if req.first_token_t is None:
+                    req.first_token_t = now
+            for i in range(toks.shape[0]):
+                if self._done(req):
+                    break
+                self._append(req, int(toks[i, slot]), eos)
+            req.cached_rows += toks.shape[0]
+            if self._done(req):
+                self.scheduler.finish(req)
+                self._finished.append(req)
+                finished.append(req)
+        return finished
+
+    @staticmethod
+    def _append(req: Request, token: int, eos) -> None:
+        req.generated.append(token)
+        if eos is not None and token == eos:
+            req.eos_seen = True      # generated ends AT the eos token
+
+    def _done(self, req: Request) -> bool:
+        return req.remaining <= 0 or req.eos_seen
+
+    def run(self, requests, max_new_tokens: int = 64,
+            max_rounds: int = 100000) -> Dict[int, np.ndarray]:
+        """Submit-and-drain convenience: requests is a list of prompt-id
+        arrays or (prompt, max_new) tuples. Returns {rid: output ids} for
+        THIS call's requests only (stats() still aggregates across the
+        engine's lifetime — reset_stats() starts a fresh window)."""
+        rids = []
+        for r in requests:
+            if isinstance(r, tuple):
+                rids.append(self.add_request(r[0], r[1]))
+            else:
+                rids.append(self.add_request(r, max_new_tokens))
+        rounds = 0
+        while not self.scheduler.done:
+            self.step()
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError("serving run did not converge "
+                                   f"({rounds} rounds)")
+        mine = set(rids)
+        return {r.rid: r.output for r in self._finished if r.rid in mine}
+
+    # ---- stats -------------------------------------------------------
+
+    def reset_stats(self) -> None:
+        """Start a fresh measurement window: completed-request records and
+        the throughput clock reset (pool/scheduler state untouched — the
+        bench warms its compiles, resets, then serves the timed load)."""
+        self._finished = []
+        self._stats_t0 = None
+
+    def stats(self) -> Dict[str, float]:
+        """TTFT p50/p99 (ms) + aggregate generated-token throughput across
+        everything finished so far — the SLO numbers the serving bench
+        emits. TTFT is measured at the first round boundary where the
+        request's first token reached the host (includes the quantum it
+        landed in — the honest, observable number)."""
+        done = [r for r in self._finished if r.first_token_t is not None]
+        out: Dict[str, float] = {
+            "completed": float(len(self._finished)),
+            "preemptions": float(sum(r.preemptions
+                                     for r in self._finished)),
+            "pool_bytes": float(self.pool_bytes),
+        }
+        if done:
+            ttft = np.asarray([(r.first_token_t - r.submit_t) * 1e3
+                               for r in done])
+            out["p50_ttft_ms"] = float(np.percentile(ttft, 50))
+            out["p99_ttft_ms"] = float(np.percentile(ttft, 99))
+        if self._finished and self._stats_t0 is not None:
+            total = sum(len(r.generated) for r in self._finished)
+            span = max(r.finish_t for r in self._finished) - self._stats_t0
+            out["tok_per_sec"] = float(total / span) if span > 0 else 0.0
+            out["generated_tokens"] = float(total)
+        return out
+
+
+def init_serving(model, config=None, serving: Optional[dict] = None,
+                 mesh=None, params=None, rng=None, **kwargs):
+    """One-call constructor: init_inference + ServingEngine. `serving`
+    takes ServingConfig field names. The InferenceEngine's context-aware
+    int8-KV default keys off the serving context cap (long-context pools
+    quantize, short ones keep the compute dtype — the measured
+    crossover)."""
+    from deepspeed_tpu.inference.engine import init_inference
+    sc = ServingConfig(**(serving or {}))
+    model_cap = getattr(getattr(model, "config", None), "max_seq_len", None)
+    max_len = sc.max_model_len or model_cap or 2048
+    if model_cap:
+        # same clamp ServingEngine applies to the serving cap: max_tokens
+        # drives the context-aware int8-KV default, and deriving it from
+        # an over-asked max_model_len would quantize a short-context
+        # model's pool (the exact r5 regression class)
+        max_len = min(max_len, model_cap)
+    # default the engine's context budget to the serving cap WITHOUT
+    # overriding an explicit user setting: kwargs beat dict configs inside
+    # init_inference, so the default goes into the config dict itself; an
+    # InferenceConfig instance is respected verbatim
+    if (config is None or isinstance(config, dict)) \
+            and "max_tokens" not in kwargs:
+        config = dict(config or {})
+        config.setdefault("max_tokens", max_len)
+    eng = init_inference(model, config=config, mesh=mesh, params=params,
+                         rng=rng, **kwargs)
+    return ServingEngine(eng, sc)

@@ -599,6 +599,16 @@ def _activation(x, gate, cfg: TransformerConfig):
     return jax.nn.gelu(x)
 
 
+def _idx_col(v):
+    """Decode cursor as a broadcastable column: the one-shot loop carries a
+    SCALAR position (all rows in lockstep), the paged serving path a
+    per-slot [B] vector. Scalars pass through (identical program to the
+    pre-paged path); vectors become [B, 1] so masks over [.., T] broadcast
+    per row."""
+    a = jnp.asarray(v, jnp.int32)
+    return a[:, None] if a.ndim else a
+
+
 def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
                       kv_row=None, kv_scale=None, kv_suffix=None,
                       window=None):
@@ -606,7 +616,10 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
     the kv heads in memory (reference's decode kernels repeat in registers:
     ``csrc/transformer/inference/csrc/pt_binding.cpp:1716-1780``).
 
-    q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]; index: current position (scalar).
+    q: [B, 1, Nq, D]; ck/cv: [B, Nkv, T, D]; index: current position —
+    a scalar (one-shot decode loop, rows in lockstep) or a per-row [B]
+    vector (the paged serving path, where every slot sits at its own
+    sequence length).
 
     kv_row: the CURRENT token's (k, v) [B, Nkv, 1, D], kept OUT of the
     buffer — its logit joins the softmax separately and the caller writes
@@ -616,30 +629,17 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
     reference's fixed decode workspace has the same do-not-reallocate
     property, inference_context.h).
 
-    On TPU this dispatches to the length-aware Pallas kernel
-    (ops/decode_attention.py) — HBM traffic per step is the VALID cache
-    prefix, not max_len. The XLA fallback (CPU, alibi) masks after reading.
+    This is the XLA decode path; its length-awareness comes from the decode
+    loop's static read windows. The serving tier's paged layout has its own
+    Pallas kernel (ops/decode_attention.paged_decode_attention), selected
+    by a measured micro-bench at engine init — the old contiguous-layout
+    kernel lost to this path end-to-end on v5e and was deleted.
     """
     B, _, Nq, D = q.shape
     Nkv, T = ck.shape[1], ck.shape[2]
     rep = Nq // Nkv
     sm = (cfg.attn_scale if cfg is not None and cfg.attn_scale is not None
           else 1.0 / math.sqrt(D))
-    # the Pallas decode kernel is opt-in (attention_impl="pallas"): measured
-    # end-to-end on v5e it loses to the windowed-XLA path (24 pallas_calls
-    # per token cost more than the length-aware reads save; the XLA path
-    # gets its length-awareness from the decode loop's static read windows)
-    use_pallas = (cfg is not None and cfg.attention_impl == "pallas"
-                  and cfg.position_type != "alibi"
-                  and q.dtype != jnp.float16  # Mosaic has no f16
-                  and kv_scale is None        # kernel reads float caches
-                  and kv_suffix is None       # kernel knows no suffix rows
-                  and window is None          # kernel has no band mask
-                  and (cfg.attn_scale is None)  # kernel fixes sm=1/sqrt(D)
-                  and jax.default_backend() in ("tpu", "axon") and D >= 64)
-    if use_pallas:
-        from deepspeed_tpu.ops.decode_attention import decode_attention
-        return decode_attention(q, ck, cv, index, kv_row=kv_row)
     qg = q.reshape(B, Nkv, rep, D)
     if kv_scale is not None:
         # int8 cache, int8 MATH: a dequantize-then-bf16-dot would
@@ -660,9 +660,10 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
                             ).astype(jnp.float32)
     scores = scores * sm
     if cfg is not None and cfg.position_type == "alibi":
-        rel = (jnp.arange(T) - index).astype(jnp.float32)        # k - q
+        rel = (jnp.arange(T)[None, :] - _idx_col(index)
+               ).astype(jnp.float32)                             # k - q
         slopes = alibi_slopes(Nq).reshape(Nkv, rep)
-        scores = scores + slopes[None, :, :, None] * rel[None, None, None, :]
+        scores = scores + slopes[None, :, :, None] * rel[:, None, None, :]
     if kv_row is not None:
         k_row, v_row = kv_row                    # [B, Nkv, 1, D]
         if kv_suffix is not None:
@@ -677,13 +678,14 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
             prefix_len = index
         # buffer rows at >= prefix_len are stale; the current token's logit
         # comes from the fresh row (rel distance 0 — no alibi term)
-        keep = jnp.arange(T) < prefix_len
+        keep = jnp.arange(T)[None, :] < _idx_col(prefix_len)
         if window is not None:
             # local band: buffer position t (absolute) visible iff
             # index - t < window; <= 0 means global
             w = jnp.asarray(window, jnp.int32)
-            keep = keep & ((w <= 0) | (index - jnp.arange(T) < w))
-        valid = keep[None, None, None, :]
+            keep = keep & ((w <= 0)
+                           | (_idx_col(index) - jnp.arange(T)[None, :] < w))
+        valid = keep[:, None, None, :]
         scores = jnp.where(valid, scores, -1e30)
         s_self = jnp.einsum("bgrd,bgtd->bgrt", qg,
                             k_row.astype(qg.dtype)).astype(jnp.float32)
@@ -694,17 +696,17 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
                                sk.astype(qg.dtype)).astype(jnp.float32)
             s_suf = s_suf * sm
             if cfg is not None and cfg.position_type == "alibi":
-                rel_suf = (prefix_len + jnp.arange(Ssuf) - index
-                           ).astype(jnp.float32)
+                rel_suf = (_idx_col(prefix_len) + jnp.arange(Ssuf)[None, :]
+                           - _idx_col(index)).astype(jnp.float32)
                 slopes = alibi_slopes(Nq).reshape(Nkv, rep)
                 s_suf = s_suf + slopes[None, :, :, None] * \
-                    rel_suf[None, None, None, :]
-            skeep = jnp.arange(Ssuf) < count
+                    rel_suf[:, None, None, :]
+            skeep = jnp.broadcast_to(jnp.arange(Ssuf) < count, (1, Ssuf))
             if window is not None:
                 w = jnp.asarray(window, jnp.int32)
-                abs_pos = prefix_len + jnp.arange(Ssuf)
-                skeep = skeep & ((w <= 0) | (index - abs_pos < w))
-            s_suf = jnp.where(skeep[None, None, None, :], s_suf, -1e30)
+                abs_pos = _idx_col(prefix_len) + jnp.arange(Ssuf)[None, :]
+                skeep = skeep & ((w <= 0) | (_idx_col(index) - abs_pos < w))
+            s_suf = jnp.where(skeep[:, None, None, :], s_suf, -1e30)
             scores = jnp.concatenate([scores, s_suf, s_self], axis=-1)
             probs = jax.nn.softmax(scores, axis=-1)
             out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
@@ -719,14 +721,59 @@ def _decode_attention(q, ck, cv, index, cfg: TransformerConfig = None,
         out = _decode_pv(probs[..., :T], cv, kv_scale, q.dtype)
         out = out + probs[..., T:].astype(q.dtype) * v_row.astype(q.dtype)
         return out.reshape(B, 1, Nq, D)
-    keep = jnp.arange(T) <= index
+    keep = jnp.arange(T)[None, :] <= _idx_col(index)
     if window is not None:
         w = jnp.asarray(window, jnp.int32)
-        keep = keep & ((w <= 0) | (index - jnp.arange(T) < w))
-    scores = jnp.where(keep[None, None, None, :], scores, -1e30)
+        keep = keep & ((w <= 0)
+                       | (_idx_col(index) - jnp.arange(T)[None, :] < w))
+    scores = jnp.where(keep[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = _decode_pv(probs, cv, kv_scale, q.dtype)
     return out.reshape(B, 1, Nq, D)
+
+
+def _paged_attention(q, pool_k, pool_v, tables, index, cfg: TransformerConfig,
+                     kv_row, kv_scale=None, backend="xla", window=None):
+    """Single-token attention against the PAGED block pool.
+
+    q: [S, 1, Nq, D] (one in-flight token per slot); pool_k/pool_v:
+    [NB, Nkv, bs, D] (one layer's slice of the shared block pool);
+    tables: [S, MB] int32 block ids (0 = the reserved trash block, masked
+    by the length); index: per-slot sequence length [S].
+
+    backend="pallas": the block-table gather is resolved inside the kernel's
+    index maps (ops/decode_attention.paged_decode_attention) — only blocks
+    covering the valid prefix ever cross HBM->VMEM, nothing materializes.
+    backend="xla": ``jnp.take`` materializes the slot's blocks as a
+    contiguous [S, Nkv, MB*bs, D] view and the math is the EXACT ring-buffer
+    path (_decode_attention with a per-slot cursor) — same einsums, same
+    masking, which is what makes paged-vs-contiguous decode bit-for-bit
+    comparable in tests. The backend is chosen by a measured micro-bench at
+    serving-engine init, not a config flag.
+    """
+    S = q.shape[0]
+    NB, Nkv, bs, D = pool_k.shape
+    MB = tables.shape[1]
+    use_pallas = (backend == "pallas" and kv_scale is None
+                  and window is None and q.dtype != jnp.float16
+                  and (cfg is None or (cfg.position_type != "alibi"
+                                       and cfg.attn_scale is None)))
+    if use_pallas:
+        from deepspeed_tpu.ops.decode_attention import paged_decode_attention
+        return paged_decode_attention(q, pool_k, pool_v, tables, index,
+                                      kv_row=kv_row)
+
+    def view(pool):
+        g = jnp.take(pool, tables, axis=0)       # [S, MB, Nkv, bs, D]
+        return g.transpose(0, 2, 1, 3, 4).reshape(S, Nkv, MB * bs, D)
+
+    sc = None
+    if kv_scale is not None:
+        ks, vs = kv_scale                        # [NB, Nkv, bs] f32
+        sc = tuple(jnp.take(s, tables, axis=0).transpose(0, 2, 1, 3)
+                   .reshape(S, Nkv, MB * bs) for s in (ks, vs))
+    return _decode_attention(q, view(pool_k), view(pool_v), index, cfg,
+                             kv_row=kv_row, kv_scale=sc, window=window)
 
 
 def _decode_pv(probs, cv, kv_scale, dtype):
@@ -865,7 +912,8 @@ def fused_logical_axes(cfg: TransformerConfig) -> Params:
 
 def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                       positions=None, dropout_rng=None, deterministic=True,
-                      cache=None, return_kv: bool = False, attn_window=None):
+                      cache=None, return_kv: bool = False, attn_window=None,
+                      paged=None):
     """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
     cache=(ck, cv, index[, read_len]): decode mode — x is [B, 1, H]. The
@@ -875,6 +923,11 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     CALLER to write at `index` (decode_step batches all layers' rows into
     one tiny column update). return_kv: also return the (post-rotary) K/V
     so a prefill pass can seed the cache.
+
+    paged=(block_tables, backend): the cache tuple carries one layer's
+    BLOCK-POOL slices ([NB, nkv, bs, hd]) instead of per-batch ring
+    buffers, and `index` is the per-slot sequence-length vector —
+    attention reads through the block table (decode_step_paged).
     """
     p = _maybe_dequant(layer_params, cfg)
     B, S, H = x.shape
@@ -941,7 +994,15 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         # windowed decode: attention reads a STATIC prefix of the ring
         # buffer (the decode loop guarantees index < read_len), so XLA only
         # touches O(read_len) bytes instead of max_len
-        if read_len is not None and read_len < ck.shape[2]:
+        if paged is not None:
+            tables, backend = paged
+            with jax.named_scope("attn"):
+                attn_out = _paged_attention(q, ck, cv, tables, index, cfg,
+                                            kv_row=(k_row, v_row),
+                                            kv_scale=kv_scale,
+                                            backend=backend,
+                                            window=attn_window)
+        elif read_len is not None and read_len < ck.shape[2]:
             sc = (tuple(s[:, :, :read_len] for s in kv_scale)
                   if kv_scale is not None else None)
             with jax.named_scope("attn"):
@@ -1438,13 +1499,9 @@ def _quant_kv(x):
     """Per-(…, position) symmetric int8: x [..., T, D] float ->
     (int8 [..., T, D], f32 scale [..., T]). The scale multiplies OUT of the
     d-contraction, so both attention einsums consume the int8 bytes
-    directly."""
-    x32 = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(x32), axis=-1)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
-    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127
-                 ).astype(jnp.int8)
-    return q, scale
+    directly (shared with the paged block pool — ops/quantizer)."""
+    from deepspeed_tpu.ops.quantizer import quantize_rows
+    return quantize_rows(x)
 
 
 def prefill(params: Params, input_ids, cfg: TransformerConfig, cache: Params,
@@ -1702,6 +1759,178 @@ def merge_suffix(cfg: TransformerConfig, cache: Params,
     return new_cache
 
 
+# --------------------------------------------------------------------------
+# Paged KV cache (serving tier): fixed-size blocks in a shared pool,
+# per-sequence block tables, gather-based attention reads. The decode step
+# compiles ONCE for the pool shape and admits variable-length multi-tenant
+# batches — the vLLM idea on TPU (reference capability bar: the fixed decode
+# workspace of inference_context.h, which this generalizes from one
+# contiguous region per batch to a block pool shared across requests).
+# --------------------------------------------------------------------------
+
+
+def init_paged_cache(cfg: TransformerConfig, num_blocks: int,
+                     block_size: int, dtype=None) -> Params:
+    """Block pools [L, NB, n_kv, block_size, head_dim]. Block 0 is the
+    reserved TRASH block: null block-table entries point at it and inactive
+    slots write into it, so the compiled step needs no scatter masking —
+    trash contents are never read (masked by the per-slot length).
+
+    kv_cache_bits=8: int8 payloads + per-(block, head, row) f32 scales —
+    the attention read consumes the int8 bytes directly with dequant fused
+    into the score scaling (see _decode_attention / ops/quantizer)."""
+    dtype = dtype or cfg.dtype
+    L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
+    shape = (L, num_blocks, nkv, block_size, hd)
+    if cfg.kv_cache_bits == 8:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+                "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_cache_logical_axes(cfg: Optional[TransformerConfig] = None
+                             ) -> Params:
+    """TP shards the pool over kv heads exactly like the weights; the block
+    dim stays unsharded (any block serves any sequence)."""
+    out = {"k": ("layers", None, "heads", None, None),
+           "v": ("layers", None, "heads", None, None)}
+    if cfg is not None and cfg.kv_cache_bits == 8:
+        out["k_scale"] = ("layers", None, "heads", None)
+        out["v_scale"] = ("layers", None, "heads", None)
+    return out
+
+
+def decode_step_paged(params: Params, tokens, cfg: TransformerConfig,
+                      pools: Params, block_tables, seq_lens, active=None,
+                      backend: str = "xla"
+                      ) -> Tuple[jnp.ndarray, Params]:
+    """One decode step for every slot of a paged serving batch.
+
+    tokens: [S] int32 (one in-flight token per slot); block_tables:
+    [S, MB] int32; seq_lens: [S] = tokens already in each slot's cache
+    (the fresh row is written AT seq_lens); active: [S] bool (None = all).
+    Returns (logits [S, V], pools). The program is shaped by the POOL and
+    table dims only — admitting/evicting sequences changes the table
+    contents, never the compiled program.
+
+    Inactive slots still compute (lockstep SPMD) but their K/V rows land in
+    the reserved trash block 0 and their logits are discarded host-side.
+    """
+    S = tokens.shape[0]
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    if active is None:
+        active = jnp.ones((S,), jnp.bool_)
+    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)   # [S, 1, H]
+    if cfg.position_type == "learned":
+        x = x + params["pos_embed"][seq_lens][:, None].astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"), cfg)
+    positions = seq_lens[:, None]                                # [S, 1]
+    int8_kv = cfg.kv_cache_bits == 8
+    bs = pools["k"].shape[3]
+
+    def at_layer(tree, i):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tree)
+
+    wins = (jnp.asarray(cfg.attn_windows, jnp.int32)
+            if cfg.attn_windows else None)
+
+    def body(x_c, i):
+        layer_p = at_layer(params["layers"], i)
+        pk = lax.dynamic_index_in_dim(pools["k"], i, 0, keepdims=False)
+        pv = lax.dynamic_index_in_dim(pools["v"], i, 0, keepdims=False)
+        sc = ((lax.dynamic_index_in_dim(pools["k_scale"], i, 0,
+                                        keepdims=False),
+               lax.dynamic_index_in_dim(pools["v_scale"], i, 0,
+                                        keepdims=False))
+              if int8_kv else None)
+        c = (pk, pv, seq_lens, None, sc)
+        if cfg.offload_params:
+            layer_p = _fetch_layer(layer_p, cfg)
+        y, _, (k_row, v_row) = transformer_layer(
+            x_c, layer_p, cfg, positions=positions, deterministic=True,
+            cache=c, return_kv=False, paged=(block_tables, backend),
+            attn_window=None if wins is None else wins[i])
+        return y, (k_row, v_row)
+
+    x, (k_rows, v_rows) = lax.scan(body, x, jnp.arange(cfg.num_layers))
+    # one [S, L, nkv, hd] scatter writes every layer's fresh row at
+    # (block_tables[s, len // bs], len % bs); inactive slots hit the trash
+    # block (duplicate trash writes are unordered and never read)
+    blk = jnp.take_along_axis(block_tables, (seq_lens // bs)[:, None],
+                              axis=1)[:, 0]
+    blk = jnp.where(active, blk, 0)
+    off = jnp.where(active, seq_lens % bs, 0)
+    if int8_kv:
+        kq, ks_ = _quant_kv(k_rows)           # [L, S, nkv, 1, hd] -> + [.,1]
+        vq, vs_ = _quant_kv(v_rows)
+        new_pools = {
+            "k": pools["k"].at[:, blk, :, off, :].set(
+                jnp.moveaxis(kq[:, :, :, 0, :], 1, 0)),
+            "v": pools["v"].at[:, blk, :, off, :].set(
+                jnp.moveaxis(vq[:, :, :, 0, :], 1, 0)),
+            "k_scale": pools["k_scale"].at[:, blk, :, off].set(
+                jnp.moveaxis(ks_[:, :, :, 0], 1, 0)),
+            "v_scale": pools["v_scale"].at[:, blk, :, off].set(
+                jnp.moveaxis(vs_[:, :, :, 0], 1, 0)),
+        }
+    else:
+        new_pools = {
+            "k": pools["k"].at[:, blk, :, off, :].set(
+                jnp.moveaxis(k_rows[:, :, :, 0, :].astype(pools["k"].dtype),
+                             1, 0)),
+            "v": pools["v"].at[:, blk, :, off, :].set(
+                jnp.moveaxis(v_rows[:, :, :, 0, :].astype(pools["v"].dtype),
+                             1, 0)),
+        }
+    if cfg.final_norm:
+        x = _norm(x, params["final_norm_scale"],
+                  params.get("final_norm_bias"), cfg)
+    logits = lm_head_logits(x, params)
+    return logits[:, 0, :], new_pools
+
+
+def prefill_paged(params: Params, input_ids, cfg: TransformerConfig,
+                  pools: Params, block_ids, length: Optional[int] = None
+                  ) -> Tuple[jnp.ndarray, Params]:
+    """Prefill ONE request and scatter its K/V into the slot's blocks.
+
+    input_ids: [1, P] with P a multiple of the block size (shape-bucketed:
+    one compile per bucket); block_ids: [P // bs] int32 pool blocks the
+    scheduler allocated; length: true prompt length (pad rows land in the
+    last blocks but are masked by seq_len and overwritten as decode
+    appends). Returns (last_logits [1, V], pools). The contiguous prefill
+    cache is a jit-local temporary — it never leaves the program."""
+    B, P = input_ids.shape
+    bs = pools["k"].shape[3]
+    nblk = P // bs
+    cache = init_cache(cfg, B, P)
+    last, cache = prefill(params, input_ids, cfg, cache, length=length)
+
+    def to_blocks(a):          # [L, 1, nkv, P, hd] -> [L, nblk, nkv, bs, hd]
+        L_, _, nkv, _, hd = a.shape
+        return (a[:, 0].reshape(L_, nkv, nblk, bs, hd)
+                .transpose(0, 2, 1, 3, 4))
+
+    def to_blocks_s(a):        # [L, 1, nkv, P] -> [L, nblk, nkv, bs]
+        L_, _, nkv, _ = a.shape
+        return a[:, 0].reshape(L_, nkv, nblk, bs).transpose(0, 2, 1, 3)
+
+    new_pools = {"k": pools["k"].at[:, block_ids].set(to_blocks(cache["k"])),
+                 "v": pools["v"].at[:, block_ids].set(to_blocks(cache["v"]))}
+    if cfg.kv_cache_bits == 8:
+        new_pools["k_scale"] = pools["k_scale"].at[:, block_ids].set(
+            to_blocks_s(cache["k_scale"]))
+        new_pools["v_scale"] = pools["v_scale"].at[:, block_ids].set(
+            to_blocks_s(cache["v_scale"]))
+    return last, new_pools
+
+
 def chunked_cross_entropy(x, head, labels, chunk: int,
                           ignore_index: int = -100,
                           tied_embed: bool = False):
@@ -1803,6 +2032,17 @@ class ModelSpec:
     decode_step_suffix: Optional[Callable[..., Tuple[jnp.ndarray,
                                                      Params]]] = None
     merge_suffix: Optional[Callable[..., Params]] = None
+    # paged serving protocol (block pool + block tables; the ServingEngine
+    # consumes these): init_paged_cache(num_blocks, block_size) -> pools;
+    # prefill_paged(params, ids, pools, block_ids, length) ->
+    # (last_logits, pools); decode_step_paged(params, tokens, pools,
+    # block_tables, seq_lens, active, backend) -> (logits, pools).
+    init_paged_cache: Optional[Callable[..., Params]] = None
+    prefill_paged: Optional[Callable[..., Tuple[jnp.ndarray,
+                                                Params]]] = None
+    decode_step_paged: Optional[Callable[..., Tuple[jnp.ndarray,
+                                                    Params]]] = None
+    paged_cache_axes: Optional[Callable[[], Params]] = None
 
     def flops_per_token(self) -> float:
         """Approximate train FLOPs/token (6N rule + attention)."""
@@ -1839,4 +2079,13 @@ def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
         decode_step_suffix=lambda params, token, cache, suffix, **kw:
             decode_step_suffix(params, token, cfg, cache, suffix, **kw),
         merge_suffix=lambda cache, suffix: merge_suffix(cfg, cache, suffix),
+        init_paged_cache=lambda num_blocks, block_size, dtype=None:
+            init_paged_cache(cfg, num_blocks, block_size, dtype=dtype),
+        prefill_paged=lambda params, input_ids, pools, block_ids, **kw:
+            prefill_paged(params, input_ids, cfg, pools, block_ids, **kw),
+        decode_step_paged=lambda params, tokens, pools, block_tables,
+            seq_lens, **kw:
+            decode_step_paged(params, tokens, cfg, pools, block_tables,
+                              seq_lens, **kw),
+        paged_cache_axes=lambda: paged_cache_logical_axes(cfg),
     )

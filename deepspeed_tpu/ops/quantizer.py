@@ -18,7 +18,28 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["QuantizedTensor", "quantize", "dequantize", "pack_int4",
-           "unpack_int4", "fake_quant", "quantize_tree", "dequantize_tree"]
+           "unpack_int4", "fake_quant", "quantize_tree", "dequantize_tree",
+           "quantize_rows"]
+
+
+def quantize_rows(x):
+    """Per-row symmetric int8 for KV-cache storage: x [..., T, D] float ->
+    (int8 [..., T, D], f32 scale [..., T]).
+
+    The scale factors OUT of the head-dim contraction, so decode attention
+    consumes the int8 bytes directly (scores = int8-dot * q_scale * k_scale)
+    instead of materializing a dequantized copy — the "dequant fused into
+    the attention read" contract both the contiguous ring cache and the
+    paged block pool rely on (reference: the int8 inference kernel path,
+    ``csrc/transformer/inference``; here the fusion is the XLA program
+    itself). Shared by ``models/transformer._quant_kv`` and the serving
+    tier's block writes."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127
+                 ).astype(jnp.int8)
+    return q, scale
 
 
 @dataclasses.dataclass

@@ -64,6 +64,10 @@ for m in "${modules[@]}"; do
         # x 20 fp16 steps (fused attention backward + chunked TP overlap,
         # ZeRO 1/3) — interpret-mode Pallas makes the fused pair the cost
         *test_perf_levers*) budget="${PERF_LEVERS_BUDGET:-420}" ;;
+        # ISSUE-9 serving tier: multi-tenant end-to-end runs (engine
+        # rebuilds + per-bucket prefill compiles + int8 pool parity over
+        # 24 decode steps) own a budget independent of the tier default
+        *test_serving*) budget="${SERVING_BUDGET:-420}" ;;
     esac
     t0=$(date +%s)
     out=$(timeout -k 10 "$budget" \

@@ -1,120 +1,126 @@
-"""Length-aware Pallas decode attention parity (reference test model:
-tests/unit/ops kernel-vs-torch parity, SURVEY §4)."""
+"""Paged Pallas decode-attention parity (reference test model:
+tests/unit/ops kernel-vs-torch parity, SURVEY §4).
 
-import math
+The XLA reference is the materialized block-table gather fed through
+``models/transformer._decode_attention`` (the ring-buffer math with a
+per-slot cursor) — the same function the serving engine's XLA backend uses,
+so the masking contract lives in ONE place instead of a re-implemented
+reference drifting here.
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deepspeed_tpu.ops.decode_attention import decode_attention
+from deepspeed_tpu.ops.decode_attention import paged_decode_attention
 
 
-def _ref(q, ck, cv, index):
-    B, _, Nq, D = q.shape
-    Nkv, T = ck.shape[1], ck.shape[2]
-    rep = Nq // Nkv
-    qg = q.reshape(B, Nkv, rep, D)
-    s = jnp.einsum("bgrd,bgtd->bgrt", qg.astype(jnp.float32),
-                   ck.astype(jnp.float32)) / math.sqrt(D)
-    s = jnp.where((jnp.arange(T) <= index)[None, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bgrt,bgtd->bgrd", p, cv.astype(jnp.float32))
-    return out.reshape(B, 1, Nq, D).astype(q.dtype)
+def _ref_paged(q, k_pool, v_pool, tables, lens, k_row, v_row):
+    from deepspeed_tpu.models.transformer import _decode_attention
+    S = q.shape[0]
+    NB, Nkv, bs, D = k_pool.shape
+    MB = tables.shape[1]
+
+    def view(pool):
+        g = jnp.take(pool, tables, axis=0)        # [S, MB, Nkv, bs, D]
+        return g.transpose(0, 2, 1, 3, 4).reshape(S, Nkv, MB * bs, D)
+
+    return _decode_attention(q, view(k_pool), view(v_pool),
+                             jnp.asarray(lens, jnp.int32), None,
+                             kv_row=(k_row, v_row))
 
 
-@pytest.mark.parametrize("index", [0, 5, 63, 130, 255])
+def _rand_case(key, S, NB, MB, Nkv, rep, bs, D, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(key), 5)
+    q = jax.random.normal(ks[0], (S, 1, Nkv * rep, D), dtype)
+    k_pool = jax.random.normal(ks[1], (NB, Nkv, bs, D), dtype)
+    v_pool = jax.random.normal(ks[2], (NB, Nkv, bs, D), dtype)
+    k_row = jax.random.normal(ks[3], (S, Nkv, 1, D), dtype)
+    v_row = jax.random.normal(ks[4], (S, Nkv, 1, D), dtype)
+    # distinct non-trash blocks per slot (block 0 reserved), shuffled so the
+    # table gather is a REAL permutation, not identity
+    rng = np.random.default_rng(key)
+    ids = rng.permutation(np.arange(1, NB))[:S * MB].reshape(S, MB)
+    return q, k_pool, v_pool, jnp.asarray(ids, jnp.int32), k_row, v_row
+
+
+@pytest.mark.parametrize("lens", [[0, 1], [5, 37], [32, 64], [64, 63]])
 @pytest.mark.parametrize("rep", [1, 4])
-def test_decode_parity(index, rep):
-    B, Nkv, T, D = 2, 2, 256, 64
-    ks = jax.random.split(jax.random.PRNGKey(index + rep), 3)
-    q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.float32)
-    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
-    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
-    out = decode_attention(q, ck, cv, index, block_k=64)
-    ref = _ref(q, ck, cv, index)
+def test_paged_parity(lens, rep):
+    """Mixed per-slot lengths: empty slot, partial block, exact block
+    boundary, full table."""
+    S, NB, MB, Nkv, bs, D = 2, 8, 2, 2, 32, 64
+    q, kp, vp, tables, kr, vr = _rand_case(sum(lens) * 7 + rep, S, NB, MB,
+                                           Nkv, rep, bs, D)
+    lens = jnp.asarray(lens, jnp.int32)
+    out = paged_decode_attention(q, kp, vp, tables, lens, kv_row=(kr, vr))
+    ref = _ref_paged(q, kp, vp, tables, lens, kr, vr)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
 
 
-def test_decode_bf16():
-    B, Nkv, rep, T, D = 1, 4, 2, 512, 64
-    ks = jax.random.split(jax.random.PRNGKey(7), 3)
-    q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.bfloat16)
-    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.bfloat16)
-    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.bfloat16)
-    out = decode_attention(q, ck, cv, 100, block_k=128)
-    ref = _ref(q.astype(jnp.float32), ck.astype(jnp.float32),
-               cv.astype(jnp.float32), 100)
+def test_paged_bf16():
+    S, NB, MB, Nkv, rep, bs, D = 2, 10, 3, 4, 2, 32, 64
+    q, kp, vp, tables, kr, vr = _rand_case(11, S, NB, MB, Nkv, rep, bs, D,
+                                           jnp.bfloat16)
+    lens = jnp.asarray([70, 96], jnp.int32)
+    out = paged_decode_attention(q, kp, vp, tables, lens, kv_row=(kr, vr))
+    ref = _ref_paged(q, kp, vp, tables, lens, kr, vr)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                rtol=5e-2, atol=5e-2)
 
 
-def test_garbage_beyond_index_ignored():
-    """Rows past the cursor must not leak into the output even when they
-    hold huge values (the uninitialized-ring-buffer case)."""
-    B, Nkv, T, D = 1, 2, 128, 64
-    ks = jax.random.split(jax.random.PRNGKey(3), 3)
-    q = jax.random.normal(ks[0], (B, 1, 2, D), jnp.float32)
-    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
-    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
-    ck = ck.at[:, :, 40:].set(1e4)
-    cv = cv.at[:, :, 40:].set(1e4)
-    out = decode_attention(q, ck, cv, 39, block_k=32)
-    ref = _ref(q, ck, cv, 39)
+def test_trash_block_and_stale_rows_ignored():
+    """Block 0 (the reserved trash block null table entries point at) and
+    rows past each slot's length hold huge garbage — none of it may leak
+    into the output (the scheduler reuses freed blocks without zeroing)."""
+    S, NB, MB, Nkv, rep, bs, D = 2, 6, 2, 2, 1, 32, 64
+    q, kp, vp, tables, kr, vr = _rand_case(3, S, NB, MB, Nkv, rep, bs, D)
+    kp = kp.at[0].set(1e4)                    # trash block
+    vp = vp.at[0].set(1e4)
+    lens = jnp.asarray([40, 0], jnp.int32)
+    # slot 0's second block is half stale; slot 1 is EMPTY with an all-null
+    # table -> its output must be exactly the fresh-row value
+    tables = tables.at[1].set(0)
+    blk2 = int(tables[0, 1])
+    kp = kp.at[blk2, :, 8:].set(1e4)          # rows 40.. of slot 0 stale
+    vp = vp.at[blk2, :, 8:].set(1e4)
+    out = paged_decode_attention(q, kp, vp, tables, lens, kv_row=(kr, vr))
+    ref = _ref_paged(q, kp, vp, tables, lens, kr, vr)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
     assert float(jnp.max(jnp.abs(out))) < 100.0
+    # the empty slot attends only to itself
+    np.testing.assert_allclose(np.asarray(out[1]),
+                               np.asarray(vr[1].reshape(1, Nkv * rep, D)),
+                               rtol=1e-5, atol=1e-5)
 
 
-def _ref_row(q, ck, cv, index, k_row, v_row):
-    """XLA reference for the fresh-row mode: buffer rows < index valid, the
-    row's logit joins separately (mirrors models/transformer._decode_attention
-    kv_row path)."""
-    B, _, Nq, D = q.shape
-    Nkv, T = ck.shape[1], ck.shape[2]
-    rep = Nq // Nkv
-    qg = q.reshape(B, Nkv, rep, D).astype(jnp.float32)
-    s = jnp.einsum("bgrd,bgtd->bgrt", qg,
-                   ck.astype(jnp.float32)) / math.sqrt(D)
-    s = jnp.where((jnp.arange(T) < index)[None, None, None, :], s, -1e30)
-    s1 = jnp.einsum("bgrd,bgtd->bgrt", qg,
-                    k_row.astype(jnp.float32)) / math.sqrt(D)
-    full = jnp.concatenate([s, s1], axis=-1)
-    p = jax.nn.softmax(full, axis=-1)
-    out = (jnp.einsum("bgrt,bgtd->bgrd", p[..., :T], cv.astype(jnp.float32))
-           + p[..., T:] * v_row.astype(jnp.float32))
-    return out.reshape(B, 1, Nq, D).astype(q.dtype)
-
-
-@pytest.mark.parametrize("index", [0, 1, 63, 130, 255])
-@pytest.mark.parametrize("rep", [1, 4])
-def test_decode_row_mode_parity(index, rep):
-    """kv_row mode: fresh row out of the buffer, strict prefix masking."""
-    B, Nkv, T, D = 2, 2, 256, 64
-    ks = jax.random.split(jax.random.PRNGKey(index * 7 + rep), 5)
-    q = jax.random.normal(ks[0], (B, 1, Nkv * rep, D), jnp.float32)
-    ck = jax.random.normal(ks[1], (B, Nkv, T, D), jnp.float32)
-    cv = jax.random.normal(ks[2], (B, Nkv, T, D), jnp.float32)
-    k_row = jax.random.normal(ks[3], (B, Nkv, 1, D), jnp.float32)
-    v_row = jax.random.normal(ks[4], (B, Nkv, 1, D), jnp.float32)
-    # garbage at >= index must not leak (ring rows incl. index are stale)
-    ck = ck.at[:, :, index:].set(1e4)
-    cv = cv.at[:, :, index:].set(1e4)
-    out = decode_attention(q, ck, cv, index, kv_row=(k_row, v_row),
-                           block_k=64)
-    ref = _ref_row(q, ck, cv, index, k_row, v_row)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
-    assert float(jnp.max(jnp.abs(out))) < 100.0
+def test_table_permutation_invariance():
+    """Physically scattered blocks must read identically to the same data
+    laid out contiguously — the whole point of the table indirection."""
+    S, NB, MB, Nkv, rep, bs, D = 1, 9, 4, 2, 2, 32, 64
+    q, kp, vp, _, kr, vr = _rand_case(5, S, NB, MB, Nkv, rep, bs, D)
+    lens = jnp.asarray([100], jnp.int32)
+    t1 = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    t2 = jnp.asarray([[5, 7, 6, 8]], jnp.int32)
+    # copy the logical contents of layout 1 into layout 2's blocks
+    kp2, vp2 = kp, vp
+    for a, b in zip([1, 2, 3, 4], [5, 7, 6, 8]):
+        kp2 = kp2.at[b].set(kp[a])
+        vp2 = vp2.at[b].set(vp[a])
+    o1 = paged_decode_attention(q, kp, vp, t1, lens, kv_row=(kr, vr))
+    o2 = paged_decode_attention(q, kp2, vp2, t2, lens, kv_row=(kr, vr))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
 
 
 class TestInt8KVCache:
-    """int8 KV ring buffers (models/transformer kv_cache_bits=8): the
-    per-position scales factor out of the d-contraction so both attention
-    einsums run on int8 bytes (int8 MXU path on TPU). Parity vs the
+    """int8 KV storage (contiguous ring buffers AND the paged pool share
+    this math): the per-position scales factor out of the d-contraction so
+    both attention einsums run on int8 bytes (int8 MXU path on TPU) —
+    dequant is fused into the read, nothing materializes. Parity vs the
     float-cache XLA decode attention."""
 
     def test_decode_attention_int8_parity(self):

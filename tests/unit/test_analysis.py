@@ -330,6 +330,7 @@ _CORPUS_RULES = {
     "deferred-sync-regression": "collective-census-drift",
     "remat-missing": "memory-peak",
     "stage3-replicated-opt": "memory-law",
+    "paged-cache-leak": "memory-peak",
 }
 
 

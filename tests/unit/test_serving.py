@@ -1,0 +1,437 @@
+"""Serving engine: continuous batching + paged KV cache + quantized decode.
+
+Reference behavior being exceeded: SURVEY §6's InferenceEngine serves one
+shape-bucketed batch per generate() call; the serving tier admits/evicts at
+decode-step boundaries over a shared block pool. The load-bearing contracts
+pinned here:
+
+  - paged decode is BIT-FOR-BIT the contiguous ring-buffer decode (same
+    einsums on a gathered view — greedy tokens AND logits identical over
+    20+ steps, float and int8-KV caches);
+  - the scheduler admits FIFO, evicts on finish, preempts newest-first
+    under pool pressure, and queues gracefully on exhaustion (never OOM);
+  - the Pallas paged kernel and the XLA gather agree (backend is a
+    measured choice, logged as a telemetry event, never silently wrong);
+  - a leaked block pool is a lint failure (`paged-cache-leak` corpus).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
+                                              BlockPoolExhausted, blocks_for)
+from deepspeed_tpu.inference.scheduler import RequestScheduler
+from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+from deepspeed_tpu.models import TransformerConfig, make_model
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, max_seq_len=256, position_type="rotary",
+                activation="silu_glu", norm_type="rmsnorm",
+                tie_embeddings=False, dtype=jnp.float32,
+                attention_impl="xla")
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Block allocator (pure host)
+# ---------------------------------------------------------------------------
+
+class TestBlockAllocator:
+    def test_block0_reserved_and_lifo_reuse(self):
+        a = BlockAllocator(8)
+        assert a.free_blocks == 7           # block 0 never in the free list
+        got = a.alloc(3)
+        assert 0 not in got
+        a.free(got)
+        assert a.alloc(1) == [got[-1]]      # LIFO: warmest block first
+
+    def test_exhaustion_raises_typed(self):
+        a = BlockAllocator(4)
+        a.alloc(3)
+        assert not a.can_alloc(1)
+        with pytest.raises(BlockPoolExhausted):
+            a.alloc(1)
+
+    def test_double_free_and_trash_free_raise(self):
+        a = BlockAllocator(4)
+        ids = a.alloc(2)
+        a.free(ids)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([ids[0]])
+        with pytest.raises(ValueError, match="trash"):
+            a.free([0])
+
+    def test_blocks_for(self):
+        assert blocks_for(0, 16) == 0
+        assert blocks_for(1, 16) == 1
+        assert blocks_for(16, 16) == 1
+        assert blocks_for(17, 16) == 2
+
+
+# ---------------------------------------------------------------------------
+# Scheduler (pure host: admit / evict / preempt ordering)
+# ---------------------------------------------------------------------------
+
+def _sched(num_blocks=32, max_seqs=4, bs=16, quantum=4, mb=8):
+    alloc = BlockAllocator(num_blocks)
+    return alloc, RequestScheduler(
+        alloc, max_seqs, bs, quantum,
+        prompt_blocks=lambda n: blocks_for(max(n, bs), bs),
+        max_blocks_per_seq=mb)
+
+
+class TestScheduler:
+    def test_fifo_admission_order(self):
+        _, s = _sched()
+        reqs = [s.submit(np.arange(10), 8) for _ in range(3)]
+        out = s.schedule()
+        assert out["admitted"] == reqs      # arrival order
+        assert [r.state for r in reqs] == ["running"] * 3
+
+    def test_slot_limit_queues(self):
+        _, s = _sched(max_seqs=2)
+        reqs = [s.submit(np.arange(10), 8) for _ in range(3)]
+        out = s.schedule()
+        assert len(out["admitted"]) == 2
+        assert s.num_waiting == 1 and reqs[2].state == "waiting"
+
+    def test_pool_exhaustion_queues_not_raises(self):
+        # 9 usable blocks; each request needs ceil((32+4)/16)=3 -> 3 admit
+        alloc, s = _sched(num_blocks=10, max_seqs=8)
+        reqs = [s.submit(np.arange(32), 8) for _ in range(5)]
+        out = s.schedule()
+        assert len(out["admitted"]) == 3
+        assert s.num_waiting == 2
+        assert alloc.free_blocks == 0
+        # finishing one frees its blocks and the queue head admits next
+        s.finish(reqs[0])
+        out = s.schedule()
+        assert out["admitted"] == [reqs[3]]
+
+    def test_growth_preempts_newest_first(self):
+        # two running, pool exactly covers their prompts; growth pressure
+        # must preempt the NEWEST and keep the oldest progressing
+        alloc, s = _sched(num_blocks=7, max_seqs=4, bs=16, quantum=4)
+        r1 = s.submit(np.arange(30), 64)    # 3 blocks (ctx+quantum=34)
+        r2 = s.submit(np.arange(30), 64)
+        assert len(s.schedule()["admitted"]) == 2
+        assert alloc.free_blocks == 0
+        # simulate r1 decoding to the edge of its coverage
+        r1.cached_rows = 46                 # needs blocks_for(50)=4 next
+        r1.generated = list(range(16))
+        out = s.schedule()
+        assert out["preempted"] == [r2]
+        assert r2.state == "waiting" and r2.preemptions == 1
+        assert len(r1.block_ids) == 4       # oldest got its growth
+        # the preempted request resumes at the FRONT of the queue with its
+        # generated tokens intact (re-prefill recomputes its rows)
+        r3 = s.submit(np.arange(8), 8)
+        assert s.waiting[0] is r2 and s.waiting[1] is r3
+        assert r2.cached_rows == 0
+
+    def test_growth_clamps_at_table_width(self):
+        alloc, s = _sched(num_blocks=32, max_seqs=2, bs=16, quantum=8, mb=3)
+        r = s.submit(np.arange(40), 16)
+        s.schedule()
+        r.cached_rows = 47                  # target 55 -> 4 blocks > mb=3
+        s.schedule()
+        assert len(r.block_ids) == 3        # clamped, no table overflow
+
+
+# ---------------------------------------------------------------------------
+# Paged vs contiguous decode: bit-for-bit
+# ---------------------------------------------------------------------------
+
+def _paged_vs_contiguous(kv_bits, dtype, steps=24):
+    cfg = _cfg(dtype=dtype, kv_cache_bits=kv_bits)
+    model = make_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    B, P, bs, MB = 2, 32, 16, 6            # gathered width == max_len == 96
+    ids = rng.integers(0, cfg.vocab_size, (B, P)).astype(np.int32)
+
+    cache = model.init_cache(B, MB * bs, dtype=dtype)
+    lg_c, cache = model.prefill(params, jnp.asarray(ids), cache)
+
+    pools = model.init_paged_cache(num_blocks=B * MB + 1, block_size=bs,
+                                   dtype=dtype)
+    tabs = np.zeros((B, MB), np.int32)
+    nxt_blk = 1
+    lg_rows = []
+    for s in range(B):
+        row = list(range(nxt_blk, nxt_blk + MB))
+        nxt_blk += MB
+        tabs[s] = row
+        lgp, pools = model.prefill_paged(params, jnp.asarray(ids[s:s + 1]),
+                                         pools,
+                                         jnp.asarray(row[:P // bs],
+                                                     jnp.int32), length=P)
+        lg_rows.append(lgp)
+    lg_p = jnp.concatenate(lg_rows, 0)
+    np.testing.assert_array_equal(np.asarray(lg_c), np.asarray(lg_p))
+
+    tok = jnp.argmax(lg_c, -1).astype(jnp.int32)
+    tok_p = jnp.argmax(lg_p, -1).astype(jnp.int32)
+    tabs_d = jnp.asarray(tabs)
+    lens = jnp.asarray([P] * B, jnp.int32)
+    dsc = jax.jit(lambda p, t, c: model.decode_step(p, t, c))
+    dsp = jax.jit(lambda p, t, pl, tb, ln: model.decode_step_paged(
+        p, t, pl, tb, ln, backend="xla"))
+    for i in range(steps):
+        lc, cache = dsc(params, tok, cache)
+        lp, pools = dsp(params, tok_p, pools, tabs_d, lens)
+        lens = lens + 1
+        # bit-for-bit: the paged read is the SAME einsum chain on a
+        # gathered view of identical values (junk masked to exact zeros)
+        np.testing.assert_array_equal(np.asarray(lc), np.asarray(lp),
+                                      err_msg=f"step {i}")
+        tok = jnp.argmax(lc, -1).astype(jnp.int32)
+        tok_p = jnp.argmax(lp, -1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(tok), np.asarray(tok_p))
+
+
+def test_paged_matches_contiguous_bf16():
+    """>= 20 greedy decode steps, bf16 cache: logits and tokens exactly
+    equal between the paged pool and the contiguous ring buffer."""
+    _paged_vs_contiguous(0, jnp.bfloat16)
+
+
+@pytest.mark.slow
+def test_paged_matches_contiguous_int8_kv():
+    """Same contract through the int8-quantized pool (scales gathered and
+    fused into the score scaling — identical math to the int8 ring)."""
+    _paged_vs_contiguous(8, jnp.bfloat16)
+
+
+def test_paged_kernel_agrees_with_xla_gather():
+    """_paged_attention backend parity on mixed lengths (interpret-mode
+    Pallas on CPU): the measured backend choice must never change
+    results."""
+    from deepspeed_tpu.models.transformer import _paged_attention
+    cfg = _cfg()
+    S, NB, MB, nkv, nq, bs, D = 3, 10, 3, 2, 4, 32, 16
+    # D=16 < the kernel's TPU-lane sweet spot but interpret mode is exact
+    ks = jax.random.split(jax.random.PRNGKey(5), 5)
+    q = jax.random.normal(ks[0], (S, 1, nq, D), jnp.float32)
+    pk = jax.random.normal(ks[1], (NB, nkv, bs, D), jnp.float32)
+    pv = jax.random.normal(ks[2], (NB, nkv, bs, D), jnp.float32)
+    kr = jax.random.normal(ks[3], (S, nkv, 1, D), jnp.float32)
+    vr = jax.random.normal(ks[4], (S, nkv, 1, D), jnp.float32)
+    tabs = jnp.asarray(
+        np.random.default_rng(0).permutation(np.arange(1, 10))[:S * MB]
+        .reshape(S, MB), jnp.int32)
+    lens = jnp.asarray([0, 17, 96], jnp.int32)
+    o_x = _paged_attention(q, pk, pv, tabs, lens, cfg, kv_row=(kr, vr),
+                           backend="xla")
+    o_p = _paged_attention(q, pk, pv, tabs, lens, cfg, kv_row=(kr, vr),
+                           backend="pallas")
+    np.testing.assert_allclose(np.asarray(o_x), np.asarray(o_p),
+                               rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Engine end-to-end
+# ---------------------------------------------------------------------------
+
+def _serving(model=None, params=None, **serving):
+    model = model or make_model(_cfg())
+    defaults = dict(max_seqs=2, block_size=16, max_model_len=128,
+                    decode_quantum=4, prompt_bucket=16)
+    defaults.update(serving)
+    return deepspeed_tpu.init_serving(model, config={}, serving=defaults,
+                                      dtype=jnp.float32, params=params)
+
+
+def test_serving_matches_oneshot_generate():
+    """Two concurrent variable-length requests through the serving engine
+    produce exactly the one-shot greedy generate() outputs."""
+    model = make_model(_cfg())
+    srv = _serving(model)
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, 128, size=(7,)).astype(np.int32), 9),
+            (rng.integers(0, 128, size=(21,)).astype(np.int32), 6)]
+    outs = srv.run(reqs)
+    assert srv.scheduler.done
+    eng = deepspeed_tpu.init_inference(
+        model, config={"kv_cache_bits": 0}, dtype=jnp.float32,
+        params=jax.device_get(srv.engine.params))
+    for i, (p, n) in enumerate(reqs):
+        one = np.asarray(eng.generate(p[None], max_new_tokens=n))[0]
+        np.testing.assert_array_equal(outs[i], one)
+    st = srv.stats()
+    assert st["completed"] == 2 and st["generated_tokens"] == 15
+    assert st["p50_ttft_ms"] > 0 and st["tok_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_serving_multitenant_queue_and_exhaustion():
+    """More requests than slots + a pool sized BELOW full residency: the
+    scheduler queues and (under growth pressure) preempts, every request
+    still completes with the exact one-shot output, and the pool never
+    OOMs. Also pins continuous batching actually interleaving: with 2
+    slots and 5 requests the engine must run multiple rounds."""
+    model = make_model(_cfg())
+    # 9 usable blocks < 2 slots x 8 full-residency blocks
+    srv = _serving(model, num_blocks=10)
+    rng = np.random.default_rng(3)
+    reqs = [(rng.integers(0, 128, size=(n,)).astype(np.int32), k)
+            for n, k in ((30, 40), (25, 30), (5, 12), (40, 20), (17, 8))]
+    outs = srv.run(reqs)
+    assert len(outs) == 5 and srv.allocator.used_blocks == 0
+    eng = deepspeed_tpu.init_inference(
+        model, config={"kv_cache_bits": 0}, dtype=jnp.float32,
+        params=jax.device_get(srv.engine.params))
+    for i, (p, n) in enumerate(reqs):
+        one = np.asarray(eng.generate(p[None], max_new_tokens=n))[0]
+        np.testing.assert_array_equal(outs[i], one,
+                                      err_msg=f"request {i} diverged")
+
+
+@pytest.mark.slow
+def test_serving_int8_kv_pool():
+    """Quantized serving: int8 KV blocks end to end (the int8 pool rides
+    the same scheduler/tables; dequant is fused into the read)."""
+    model = make_model(_cfg())
+    # kv_cache_bits=8 flows through the InferenceConfig surface
+    srv = deepspeed_tpu.init_serving(
+        model, config={"kv_cache_bits": 8}, serving=dict(
+            max_seqs=2, block_size=16, max_model_len=128,
+            decode_quantum=4, prompt_bucket=16), dtype=jnp.float32)
+    assert srv.model.config.kv_cache_bits == 8
+    assert srv.pools["k"].dtype == jnp.int8
+    rng = np.random.default_rng(5)
+    reqs = [(rng.integers(0, 128, size=(12,)).astype(np.int32), 8),
+            (rng.integers(0, 128, size=(33,)).astype(np.int32), 8)]
+    outs = srv.run(reqs)
+    # int8 parity bar: same as the contiguous int8 cache — compare against
+    # the one-shot engine with the SAME int8 cache (bit-for-bit paged ==
+    # contiguous is pinned in test_paged_matches_contiguous_int8_kv)
+    eng = deepspeed_tpu.init_inference(
+        model, config={"kv_cache_bits": 8}, dtype=jnp.float32,
+        params=jax.device_get(srv.engine.params))
+    for i, (p, n) in enumerate(reqs):
+        one = np.asarray(eng.generate(p[None], max_new_tokens=n))[0]
+        # windowed-read staging differs from the paged read here, so the
+        # bar is greedy-token agreement on the first tokens + near-total
+        got = outs[i]
+        assert (got[:p.size + 4] == one[:p.size + 4]).all(), (got, one)
+        assert (got == one).mean() > 0.9
+
+
+def test_backend_selection_event_and_reason():
+    """The backend choice short-circuits with a recorded reason and lands
+    in the telemetry event stream. Capability gates take precedence over
+    everything (a FORCED pallas that the decode step would silently
+    downgrade must be refused with the why), then the non-TPU check."""
+    from deepspeed_tpu.robustness import events
+    events.clear()
+    srv = _serving()                      # head_dim 16: kernel-ineligible
+    assert srv.decode_backend == "xla"
+    assert srv.backend_bench["reason"] == "head_dim 16 < 64"
+    evs = events.history("decode_backend_selected")
+    assert evs and evs[-1]["backend"] == "xla"
+    # forced pallas on an ineligible config: refused, reason says why
+    srv2 = _serving(model=make_model(_cfg()), decode_backend="pallas")
+    assert srv2.decode_backend == "xla"
+    assert "pallas unavailable" in srv2.backend_bench["reason"]
+    # kernel-eligible shape on CPU: the non-TPU short-circuit
+    big = make_model(_cfg(hidden_size=256))   # head_dim 64
+    srv3 = _serving(model=big)
+    assert srv3.backend_bench["reason"] == "non-TPU backend"
+
+
+def test_kv_cache_bits_default_is_context_aware():
+    """The r5 regression fix: short-context engines keep the compute-dtype
+    cache (decode there is op-latency bound; blanket int8 cost the ctx-256
+    rung 2.6%), long-context engines default to int8."""
+    model = make_model(_cfg())
+    short = deepspeed_tpu.init_inference(model, config={"max_tokens": 256},
+                                         dtype=jnp.float32)
+    assert short.model.config.kv_cache_bits == 0
+    model2 = make_model(_cfg(max_seq_len=4096))
+    long = deepspeed_tpu.init_inference(model2,
+                                        config={"max_tokens": 2048},
+                                        dtype=jnp.float32)
+    assert long.model.config.kv_cache_bits == 8
+
+
+def test_init_serving_respects_explicit_max_tokens():
+    """The serving-cap default must not override an explicit user
+    max_tokens (which drives the context-aware int8-KV default)."""
+    model = make_model(_cfg(max_seq_len=4096))
+    srv = deepspeed_tpu.init_serving(
+        model, config={"max_tokens": 256},
+        serving=dict(max_seqs=2, block_size=16, max_model_len=2048),
+        dtype=jnp.float32)
+    assert srv.engine.config.max_tokens == 256
+    assert srv.model.config.kv_cache_bits == 0    # user's short-ctx intent
+    srv2 = deepspeed_tpu.init_serving(
+        model, serving=dict(max_seqs=2, block_size=16, max_model_len=2048),
+        dtype=jnp.float32)
+    assert srv2.engine.config.max_tokens == 2048  # default: serving cap
+    assert srv2.model.config.kv_cache_bits == 8
+
+
+def test_init_serving_clamps_max_tokens_to_model_cap():
+    """Over-asking max_model_len on a short-context model must not flip
+    the engine's int8-KV default: max_tokens clamps to the model cap the
+    same way the serving cap does (the r5 regression class)."""
+    model = make_model(_cfg())                     # max_seq_len 256
+    srv = deepspeed_tpu.init_serving(model, serving=dict(
+        max_seqs=2, block_size=16, max_model_len=2048), dtype=jnp.float32)
+    assert srv.max_model_len == 256
+    assert srv.engine.config.max_tokens == 256
+    assert srv.model.config.kv_cache_bits == 0
+
+
+def test_measure_paged_backends_returns_timings():
+    """The shared micro-bench recipe (engine init + bench evidence) runs
+    both backends and returns positive timings (interpret-mode Pallas on
+    CPU — tiny shapes)."""
+    from deepspeed_tpu.inference.serving import measure_paged_backends
+    cfg = _cfg()
+    nkv, hd = cfg.kv_heads, cfg.dim_per_head
+    kp = jnp.zeros((5, nkv, 8, hd), jnp.float32)
+    xla_ms, pallas_ms = measure_paged_backends(
+        cfg, kp, kp, max_seqs=2, MB=2, block_size=8, num_blocks=5,
+        dtype=jnp.float32, iters=1)
+    assert xla_ms > 0 and pallas_ms > 0
+
+
+def test_add_request_validates_context_cap():
+    srv = _serving()
+    with pytest.raises(ValueError, match="max_model_len"):
+        srv.add_request(np.arange(120, dtype=np.int32), 64)
+
+
+def test_pool_must_fit_one_sequence():
+    with pytest.raises(ValueError, match="num_blocks"):
+        _serving(num_blocks=4)   # max_model_len 128 / bs 16 needs 8 + trash
+
+
+def test_paged_cache_leak_corpus_entry():
+    """The seeded defect must fire `memory-peak`; the correctly-freed twin
+    stays under the identical budget (regression floor for modeling the
+    block pool in MemoryLint)."""
+    from deepspeed_tpu.analysis.analyzers import AnalysisSettings
+    from deepspeed_tpu.analysis.corpus import (PAGED_LEAK_BUDGET,
+                                               _paged_decode_program,
+                                               run_corpus)
+    from deepspeed_tpu.analysis.lint import analyze_programs
+    from deepspeed_tpu.analysis.corpus import _FakePlan, _stage0_config
+    rep = run_corpus("paged-cache-leak")
+    assert not rep.ok
+    assert any(f.rule == "memory-peak" for f in rep.findings)
+    art = _paged_decode_program(num_blocks=33)
+    rep2 = analyze_programs(
+        [art], _stage0_config(), _FakePlan(),
+        settings=AnalysisSettings(max_hbm_bytes=PAGED_LEAK_BUDGET))
+    assert rep2.ok, [f.rule for f in rep2.findings]
