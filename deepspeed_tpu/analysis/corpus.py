@@ -508,6 +508,18 @@ def paged_cache_leak(devices=None):
         settings=AnalysisSettings(max_hbm_bytes=PAGED_LEAK_BUDGET))
 
 
+def serving_unbounded_queue(devices=None):
+    """Admission audit: the serving scheduler configured with NO admission
+    watermark under a sustained exhaustion storm — every arrival queues,
+    the queue grows monotonically without bound, nothing is shed.
+    ``queue-growth`` must fire. The correctly-watermarked twin (same
+    overload, ``max_queue=8``) sheds typed ``AdmissionRejected``s, keeps
+    the queue bounded, and passes — tests assert both directions; the twin
+    is also CLI-runnable (``serving_lint --max-queue 8``)."""
+    from deepspeed_tpu.analysis.serving_lint import audit_admission
+    return audit_admission(max_queue=None)
+
+
 def exposed_collective_trace(devices=None):
     """Perf doctor gate: a TRACED step (not a compiled program) whose
     all-reduce runs with nothing scheduled under it — 8 ms of measured
@@ -531,6 +543,7 @@ CORPUS = {
     "remat-missing": remat_missing,
     "stage3-replicated-opt": stage3_replicated_opt,
     "paged-cache-leak": paged_cache_leak,
+    "serving-unbounded-queue": serving_unbounded_queue,
     "exposed-collective-trace": exposed_collective_trace,
     "serialized-backward": serialized_backward,
 }

@@ -1,0 +1,138 @@
+"""Serving admission audit: flag unbounded queue growth under exhaustion.
+
+The serving scheduler queues gracefully when the block pool is exhausted —
+which is exactly right for transient pressure and exactly wrong as the ONLY
+response to sustained overload: with no admission watermark every arrival
+is accepted, the queue grows without bound, and every queued request's
+latency grows with it (the failure mode deadline enforcement then converts
+into a 100% miss rate). Production serving treats backpressure as table
+stakes: beyond a watermark, shed with a TYPED rejection the client can
+retry against, never silent queue growth.
+
+This module is the lint face of that rule. ``audit_admission`` replays a
+deterministic overload (a permanently squeezed pool + a steady arrival
+stream) through the REAL ``RequestScheduler`` — pure host code, no jax —
+and fires a ``queue-growth`` finding when the queue grew monotonically
+through the whole run with nothing shed. A scheduler configured with a
+queue watermark sheds typed ``AdmissionRejected``s instead and passes.
+
+Both directions are CLI-runnable::
+
+    python -m deepspeed_tpu.analysis.serving_lint                # defect
+    python -m deepspeed_tpu.analysis.serving_lint --max-queue 8  # twin
+
+and the defect is seeded as the ``serving-unbounded-queue`` corpus entry
+(``python -m deepspeed_tpu.analysis.lint --corpus serving-unbounded-queue``)
+so the CI gate proves the rule still fires.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.analysis.report import Finding, Report
+
+# bound the audit's tolerance: a queue this deep after a sustained
+# exhaustion storm (vs `max_seqs` slots) is growth, not jitter
+QUEUE_GROWTH_BOUND = 8
+
+
+def simulate_admission(max_queue: Optional[int] = None,
+                       pool_watermark: Optional[float] = None,
+                       rounds: int = 24, arrivals_per_round: int = 2,
+                       num_blocks: int = 8, max_seqs: int = 2,
+                       block_size: int = 16) -> Dict[str, Any]:
+    """Deterministic overload replay through the real scheduler: the pool
+    is squeezed to nothing (a pool_exhaust storm that never lifts), and
+    ``arrivals_per_round`` requests arrive every scheduling round. Returns
+    the queue-depth trajectory plus shed/admit counts."""
+    from deepspeed_tpu.inference.kv_cache import BlockAllocator, blocks_for
+    from deepspeed_tpu.inference.scheduler import (AdmissionRejected,
+                                                   RequestScheduler)
+
+    alloc = BlockAllocator(num_blocks)
+    sched = RequestScheduler(
+        alloc, max_seqs, block_size, quantum=4,
+        prompt_blocks=lambda n: blocks_for(max(n, block_size), block_size),
+        max_queue=max_queue, pool_watermark=pool_watermark)
+    alloc.set_reserve(alloc.free_blocks)      # sustained exhaustion
+    prompt = np.arange(block_size, dtype=np.int32)
+    shed = submitted = 0
+    depths = []
+    for _ in range(rounds):
+        for _ in range(arrivals_per_round):
+            submitted += 1
+            try:
+                sched.submit(prompt, 16)
+            except AdmissionRejected:
+                shed += 1
+        sched.schedule()
+        depths.append(sched.num_waiting)
+    return {"queue_depths": depths, "shed": shed, "submitted": submitted,
+            "admitted": submitted - shed - sched.num_waiting,
+            "max_queue": max_queue, "pool_watermark": pool_watermark}
+
+
+def audit_admission(max_queue: Optional[int] = None,
+                    pool_watermark: Optional[float] = None,
+                    **sim_kwargs) -> Report:
+    """Run the overload replay and gate it: monotone queue growth past
+    ``QUEUE_GROWTH_BOUND`` with zero shed = the ``queue-growth`` defect."""
+    sim = simulate_admission(max_queue=max_queue,
+                             pool_watermark=pool_watermark, **sim_kwargs)
+    depths = sim["queue_depths"]
+    monotone = all(b >= a for a, b in zip(depths, depths[1:]))
+    report = Report(meta={"analyzer": "serving-admission", **sim})
+    if monotone and depths[-1] >= QUEUE_GROWTH_BOUND and sim["shed"] == 0:
+        report.extend([Finding(
+            rule="queue-growth",
+            message=(f"admission queue grew monotonically to "
+                     f"{depths[-1]} requests over {len(depths)} exhausted "
+                     "rounds with nothing shed — configure an admission "
+                     "watermark (serving max_queue / pool_watermark) so "
+                     "overload sheds with a typed AdmissionRejected "
+                     "instead of growing latency without bound"),
+            severity="error", program="serving_admission",
+            ident="unbounded-queue",
+            data={"final_queue": depths[-1], "rounds": len(depths),
+                  "shed": sim["shed"]})])
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis.serving_lint",
+        description="Admission bounded-queue audit (queue-growth gate): "
+                    "replays a deterministic exhaustion overload through "
+                    "the serving scheduler. Non-zero exit = unbounded.")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="queue watermark to audit (omit = no watermark, "
+                        "the seeded defect)")
+    p.add_argument("--pool-watermark", type=float, default=None,
+                   help="held-pool-fraction watermark to audit")
+    p.add_argument("--rounds", type=int, default=24)
+    p.add_argument("--json", action="store_true",
+                   help="print the full report as JSON")
+    args = p.parse_args(argv)
+    report = audit_admission(max_queue=args.max_queue,
+                             pool_watermark=args.pool_watermark,
+                             rounds=args.rounds)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1, default=str))
+    else:
+        sim = report.meta
+        print(f"serving_lint: queue depth {sim['queue_depths'][-1]} after "
+              f"{len(sim['queue_depths'])} exhausted rounds, "
+              f"{sim['shed']}/{sim['submitted']} shed")
+        for f in report.findings:
+            print(f"  {f.severity}: {f.rule}: {f.message}")
+        if report.ok:
+            print("serving_lint: OK (queue bounded)")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

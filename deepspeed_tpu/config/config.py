@@ -368,8 +368,10 @@ class FaultsConfig(ConfigModel):
     enabled: bool = False
     seed: int = 0
     # list of fault dicts: {"kind": "device_fault"|"io_error"|"torn_save"|
-    # "corrupt_payload"|"preempt"|"step_fault"|"clock_skew", ...} — see
-    # robustness.FaultSchedule for the per-kind keys
+    # "corrupt_payload"|"preempt"|"step_fault"|"clock_skew"|
+    # "decode_dispatch"|"pool_exhaust"|"backend_fault", ...} — see
+    # robustness.FaultSchedule for the per-kind keys (the last three are
+    # the serving-tier seams; `preempt` also takes a serving `round`)
     entries: List[Dict[str, Any]] = config_field([])
 
     def validate(self):

@@ -1,7 +1,10 @@
 from deepspeed_tpu.inference.engine import (InferenceEngine, InferenceConfig,
                                             init_inference)
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
-                                              BlockPoolExhausted, blocks_for)
-from deepspeed_tpu.inference.scheduler import Request, RequestScheduler
-from deepspeed_tpu.inference.serving import (ServingConfig, ServingEngine,
+                                              BlockPoolExhausted,
+                                              InvalidBlock, blocks_for)
+from deepspeed_tpu.inference.scheduler import (AdmissionRejected, Request,
+                                               RequestScheduler)
+from deepspeed_tpu.inference.serving import (DecodeDispatchHang,
+                                             ServingConfig, ServingEngine,
                                              init_serving)

@@ -22,7 +22,7 @@ step needs no scatter masking and freed blocks never need zeroing (stale
 contents are masked by the per-slot length — pinned by the garbage tests).
 """
 
-from typing import List
+from typing import List, Optional
 
 
 class BlockPoolExhausted(Exception):
@@ -30,10 +30,33 @@ class BlockPoolExhausted(Exception):
     scheduler catches this and queues/preempts instead of OOMing."""
 
 
+class InvalidBlock(ValueError):
+    """A block id outside the pool's range reached ``free`` — a table/
+    cursor accounting bug. Typed (vs the bare index error Python would
+    raise, or the silent corruption a NEGATIVE id would cause through
+    list wraparound) and names both the block and the owning sequence so
+    the broken bookkeeping is attributable from the traceback alone."""
+
+    def __init__(self, block: int, num_blocks: int, owner=None):
+        self.block = block
+        self.num_blocks = num_blocks
+        self.owner = owner
+        who = f" freed by sequence {owner}" if owner is not None else ""
+        super().__init__(
+            f"block id {block} outside pool range [1, {num_blocks}){who}")
+
+
 class BlockAllocator:
     """Free-list allocator over ``num_blocks`` pool blocks (block 0
-    reserved). O(1) alloc/free; double-free and trash-block-free raise —
-    an accounting bug here silently corrupts another request's cache."""
+    reserved). O(1) alloc/free; double-free, trash-block-free and
+    out-of-range ids raise — an accounting bug here silently corrupts
+    another request's cache.
+
+    ``set_reserve(n)`` hides n free blocks from ``can_alloc``/``alloc``
+    without touching ownership: the fault injector's ``pool_exhaust``
+    storms squeeze the visible pool so the scheduler's queue/preempt
+    paths run under REAL exhaustion pressure while every held block
+    stays accounted."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -43,30 +66,46 @@ class BlockAllocator:
         # LIFO: recently freed (cache-warm) blocks are reused first
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._held = [False] * num_blocks
+        self._reserve = 0
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        return max(0, len(self._free) - self._reserve)
 
     @property
     def used_blocks(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
+    @property
+    def used_fraction(self) -> float:
+        """Held fraction of the usable pool (trash block excluded) — the
+        admission pool-watermark's measure."""
+        usable = self.num_blocks - 1
+        return self.used_blocks / usable if usable else 1.0
+
+    def set_reserve(self, n: int) -> None:
+        """Hide n free blocks from allocation (0 restores the full pool)."""
+        self._reserve = max(0, int(n))
+
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= self.free_blocks
 
     def alloc(self, n: int) -> List[int]:
-        if n > len(self._free):
+        if n > self.free_blocks:
             raise BlockPoolExhausted(
-                f"need {n} blocks, {len(self._free)} free "
-                f"(pool {self.num_blocks})")
+                f"need {n} blocks, {self.free_blocks} free "
+                f"(pool {self.num_blocks}"
+                + (f", {self._reserve} squeezed" if self._reserve else "")
+                + ")")
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._held[b] = True
         return out
 
-    def free(self, blocks: List[int]) -> None:
+    def free(self, blocks: List[int], owner: Optional[int] = None) -> None:
         for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise InvalidBlock(b, self.num_blocks, owner=owner)
             if b == 0:
                 raise ValueError("freeing the reserved trash block 0")
             if not self._held[b]:

@@ -11,12 +11,26 @@ Policy (the vLLM shape):
   - FIFO admission: waiting requests admit in arrival order whenever a slot
     AND enough pool blocks (prompt + one scheduling quantum of growth) are
     free. Pool exhaustion queues gracefully — never an error.
+  - Admission control: optional watermarks bound the queue. With
+    ``max_queue`` / ``pool_watermark`` set, ``submit`` sheds load with a
+    TYPED ``AdmissionRejected`` (never silent unbounded queue growth — the
+    ``serving-unbounded-queue`` corpus entry pins the failure mode of NOT
+    setting one). Both default off for API compatibility.
   - Growth: before each quantum every running sequence gets blocks covering
-    its next `quantum` tokens. If the pool can't cover it, the NEWEST
-    running sequence is preempted (blocks freed, request re-queued at the
-    FRONT with its generated tokens kept) until growth fits — latest-
-    admitted-first keeps the oldest requests making progress, bounding
-    tail latency instead of deadlocking the whole pool.
+    its next `quantum` tokens. If the pool can't cover it, the running
+    sequence with the NEWEST *first admission* is preempted (blocks freed,
+    request re-queued at the FRONT with its generated tokens kept) until
+    growth fits — latest-admitted-first keeps the oldest requests making
+    progress, bounding tail latency instead of deadlocking the whole pool.
+  - Anti-starvation aging: a preempted request KEEPS its original
+    admission sequence number when it resumes. Without this, the resumed
+    request is always the newest admission and sustained growth pressure
+    re-preempts it forever (livelock); with it, a fresher arrival becomes
+    the next victim, so the same request is never preempted twice in a row
+    while any younger tenant is running (regression-pinned).
+  - Deadlines: ``cancel`` evicts a request mid-decode (slot and blocks
+    return to the pool immediately); the serving engine drives it from
+    per-request TTFT/total deadlines at round boundaries.
   - Eviction: a finished sequence frees its slot and blocks at the next
     boundary; freed blocks admit the queue head immediately.
 
@@ -34,6 +48,19 @@ import numpy as np
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator, blocks_for)
 
 
+class AdmissionRejected(Exception):
+    """Typed load-shed: the queue or pool watermark refused a submission.
+    The caller sees WHY (queue_full | pool_pressure | draining) plus the
+    measurements behind the decision — never a silently growing queue."""
+
+    def __init__(self, reason: str, **detail):
+        self.reason = reason
+        self.detail = detail
+        extra = " ".join(f"{k}={v}" for k, v in detail.items())
+        super().__init__(f"admission rejected ({reason})"
+                         + (f": {extra}" if extra else ""))
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request and its full serving lifecycle."""
@@ -41,7 +68,8 @@ class Request:
     prompt: np.ndarray                     # [P] int32 (original prompt)
     max_new_tokens: int
     submit_t: float = 0.0
-    # lifecycle: waiting -> running -> finished (preempt: back to waiting)
+    # lifecycle: waiting -> running -> finished (preempt: back to waiting;
+    # a missed deadline or shed: -> cancelled)
     state: str = "waiting"
     slot: Optional[int] = None
     block_ids: List[int] = dataclasses.field(default_factory=list)
@@ -56,6 +84,18 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     preemptions: int = 0
+    # deadlines (ms from submit_t; None = unbounded). TTFT applies until
+    # the first token reaches the host, total until completion — the
+    # serving engine enforces both at round boundaries and cancels past-
+    # deadline requests, returning their blocks to the pool mid-decode.
+    ttft_deadline_ms: Optional[float] = None
+    deadline_ms: Optional[float] = None
+    # anti-starvation aging: assigned at FIRST admission and kept across
+    # preemptions, so a resumed request ages as its original admission
+    # (newest-first victim selection can then never livelock it while a
+    # fresher tenant is running)
+    admission_seq: Optional[int] = None
+    cancel_reason: Optional[str] = None
 
     @property
     def context(self) -> np.ndarray:
@@ -76,6 +116,14 @@ class Request:
         return self.context
 
 
+# each preemption ages a request by this many admission slots in the
+# victim ordering. 2 (not 1): a single preemption must push the resumed
+# request STRICTLY below the tenant it lost to, so the next victim under
+# sustained pressure is someone else — never the same request twice in a
+# row (1 would tie and the tie-break would re-pick it)
+AGING_BONUS = 2
+
+
 class RequestScheduler:
     """Admission/eviction/preemption over a BlockAllocator + slot set.
 
@@ -89,7 +137,9 @@ class RequestScheduler:
     def __init__(self, allocator: BlockAllocator, max_seqs: int,
                  block_size: int, quantum: int,
                  prompt_blocks: Callable[[int], int],
-                 max_blocks_per_seq: Optional[int] = None):
+                 max_blocks_per_seq: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 pool_watermark: Optional[float] = None):
         self.allocator = allocator
         self.max_seqs = max_seqs
         self.block_size = block_size
@@ -99,22 +149,56 @@ class RequestScheduler:
         # cap whose budget ran out mid-quantum writes its (discarded)
         # overshoot rows into its own last block, never past the table
         self.max_blocks_per_seq = max_blocks_per_seq or (1 << 30)
+        # admission watermarks (None = unbounded, the pre-reliability
+        # behavior): queue length cap and held-pool-fraction cap beyond
+        # which submit() sheds with a typed AdmissionRejected
+        self.max_queue = max_queue
+        self.pool_watermark = pool_watermark
         self.waiting: Deque[Request] = collections.deque()
         self.running: List[Request] = []   # admission order (oldest first)
         self._free_slots = list(range(max_seqs - 1, -1, -1))
         self._next_rid = 0
+        self._next_seq = 0                 # first-admission counter (aging)
 
     # ---- request lifecycle -------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
-               rid: Optional[int] = None) -> Request:
+               rid: Optional[int] = None,
+               ttft_deadline_ms: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Request:
+        if self.max_queue is not None and len(self.waiting) >= self.max_queue:
+            raise AdmissionRejected("queue_full",
+                                    queue_len=len(self.waiting),
+                                    max_queue=self.max_queue)
+        if self.pool_watermark is not None \
+                and self.allocator.used_fraction >= self.pool_watermark:
+            raise AdmissionRejected(
+                "pool_pressure",
+                pool_used=round(self.allocator.used_fraction, 3),
+                pool_watermark=self.pool_watermark)
         req = Request(rid=self._next_rid if rid is None else rid,
                       prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=int(max_new_tokens),
-                      submit_t=time.perf_counter())
+                      submit_t=time.perf_counter(),
+                      ttft_deadline_ms=ttft_deadline_ms,
+                      deadline_ms=deadline_ms)
         self._next_rid = max(self._next_rid, req.rid) + 1
         self.waiting.append(req)
         return req
+
+    def restore(self, req: Request) -> None:
+        """Re-enqueue a deserialized request (drain/resume path): bypasses
+        the admission watermarks — the request was already admitted once,
+        shedding it on resume would drop accepted work. Appended in call
+        order; the resume path replays the drained engine's order."""
+        req.state = "waiting"
+        req.submit_t = time.perf_counter()
+        req.cached_rows = 0
+        req.slot = None
+        req.block_ids = []
+        req.admission_seq = None
+        self._next_rid = max(self._next_rid, req.rid) + 1
+        self.waiting.append(req)
 
     def finish(self, req: Request) -> None:
         """Evict a completed sequence: slot and blocks return to the pool."""
@@ -124,25 +208,74 @@ class RequestScheduler:
         self.running.remove(req)
         self._free_slots.append(req.slot)
         if req.block_ids:
-            self.allocator.free(req.block_ids)
+            self.allocator.free(req.block_ids, owner=req.rid)
         req.block_ids = []
         req.slot = None
 
+    def cancel(self, req: Request, reason: str = "cancelled") -> None:
+        """Evict a request wherever it is in its lifecycle (deadline miss /
+        shed): a running request's slot and blocks return to the pool
+        MID-decode, a waiting one leaves the queue. Its partial output
+        (prompt + whatever was generated) stays readable."""
+        if req.state == "running":
+            self.running.remove(req)
+            self._free_slots.append(req.slot)
+            if req.block_ids:
+                self.allocator.free(req.block_ids, owner=req.rid)
+            req.block_ids = []
+            req.slot = None
+        elif req.state == "waiting":
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        elif req.state in ("finished", "cancelled"):
+            return
+        req.state = "cancelled"
+        req.cancel_reason = reason
+        req.finish_t = time.perf_counter()
+
     # ---- the per-quantum decision ------------------------------------
 
+    @staticmethod
+    def _effective_seq(req: Request) -> int:
+        """Victim-ordering key: first-admission order minus the aging
+        bonus earned per preemption (higher = fresher = preempted first)."""
+        return (req.admission_seq or 0) - AGING_BONUS * req.preemptions
+
     def _preempt_newest(self) -> Optional[Request]:
+        """Preempt the running request with the newest EFFECTIVE admission:
+        ``admission_seq - AGING_BONUS * preemptions``. A resumed request
+        keeps its original admission_seq AND earns a bonus per preemption,
+        so it is never the victim while any younger tenant runs, and even
+        in a 2-slot pool the victim ROTATES instead of livelocking — the
+        pre-aging ``running.pop()`` always took the resumed request (it
+        was always the newest list entry), re-preempting it forever under
+        sustained growth (regression-pinned)."""
         if not self.running:
             return None
-        req = self.running.pop()               # newest admission
+        req = max(self.running, key=self._effective_seq)
+        self.running.remove(req)
         req.state = "waiting"
         req.preemptions += 1
         req.cached_rows = 0                    # resumes by re-prefilling
         self._free_slots.append(req.slot)
-        self.allocator.free(req.block_ids)
+        self.allocator.free(req.block_ids, owner=req.rid)
         req.block_ids = []
         req.slot = None
         self.waiting.appendleft(req)           # resumes before new arrivals
         return req
+
+    def preempt_all(self) -> int:
+        """Evict every running request back to the queue (fault recovery:
+        the device pool is being rebuilt, host cursors are authoritative).
+        Victims are taken newest-first, so the queue ends oldest-first and
+        FIFO re-admission preserves the original service order."""
+        n = 0
+        while self.running:
+            self._preempt_newest()
+            n += 1
+        return n
 
     def _grow(self, req: Request, target_len: int) -> bool:
         want = min(blocks_for(target_len, self.block_size),
@@ -161,9 +294,11 @@ class RequestScheduler:
         assigned (the engine must prefill them), running requests are
         guaranteed block coverage for the next quantum."""
         preempted: List[Request] = []
-        # 1. growth for the already-running, oldest first; exhaustion
-        #    preempts from the newest end until the oldest fit
-        for req in list(self.running):
+        # 1. growth for the already-running, oldest EFFECTIVE admission
+        #    first (aging order, not list order — a resumed request
+        #    regrows before fresher tenants); exhaustion preempts from the
+        #    newest effective end until the oldest fit
+        for req in sorted(self.running, key=self._effective_seq):
             if req.state != "running":
                 continue                        # lost its slot this round
             # the quantum writes rows cached_rows .. cached_rows+quantum-1
@@ -194,6 +329,9 @@ class RequestScheduler:
             req.block_ids = self.allocator.alloc(need)
             req.slot = self._free_slots.pop()
             req.state = "running"
+            if req.admission_seq is None:      # aging: resumed requests
+                req.admission_seq = self._next_seq  # keep their first seq
+                self._next_seq += 1
             self.running.append(req)
             admitted.append(req)
         return {"admitted": admitted, "preempted": preempted}

@@ -29,16 +29,42 @@ pending in the device token vector; each decode step writes the pending
 token's row (cached_rows + 1) and samples a new pending token. Host-side
 ``generated`` absorbs the pending chain at the round boundary from the one
 token fetch.
+
+Reliability tier (ISSUE 10 — see README "Serving reliability"): per-request
+TTFT/total **deadlines** with mid-decode cancellation, **admission
+watermarks** that shed load with a typed ``AdmissionRejected``,
+**anti-starvation aging** in the scheduler, **fault-tolerant rounds** — the
+quantum dispatch runs under an optional watchdog, and any round failure
+(failed/hung dispatch, injected fault, kernel failure) recovers by
+preempting every running request back to the queue, rebuilding the device
+pool, and re-prefilling from host-side cursors (bit-exact by the same
+recompute math preemption resume uses). A Pallas ``backend_fault`` degrades
+the decode backend to the XLA gather mid-serve (``backend_degraded``
+event). SIGTERM **drains**: in-flight requests checkpoint through the
+integrity chain (manifest + COMMITTED marker) and a restarted engine
+``resume()``s them with byte-identical continuations. Every
+shed/deadline/degrade/recovery decision is a structured robustness event,
+drained into the telemetry JSONL at round boundaries.
 """
 
 import dataclasses
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from deepspeed_tpu.inference.kv_cache import BlockAllocator, pool_bytes
-from deepspeed_tpu.inference.scheduler import Request, RequestScheduler
+from deepspeed_tpu.inference.scheduler import (AdmissionRejected, Request,
+                                               RequestScheduler)
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.preemption import Preempted
+
+
+class DecodeDispatchHang(RuntimeError):
+    """The watchdog timed out a decode round: the dispatch (or its token
+    fetch) never came back within ``dispatch_timeout_s``."""
 
 
 def measure_paged_backends(mcfg, k_pool, v_pool, *, max_seqs: int, MB: int,
@@ -107,6 +133,28 @@ class ServingConfig:
     decode_backend: str = "auto"       # auto | xla | pallas
     prompt_bucket: int = 64            # prompt pad granularity (compile reuse)
     backend_bench_iters: int = 10      # micro-bench timing iterations
+    # --- reliability tier (all default off = pre-reliability behavior) ---
+    # default per-request deadlines (ms from submit; add_request overrides
+    # per request; None = unbounded). Enforced at round boundaries:
+    # missed requests are CANCELLED — slot and blocks return to the pool
+    # mid-decode — and counted in stats()["deadline_misses"].
+    ttft_deadline_ms: Optional[float] = None
+    deadline_ms: Optional[float] = None
+    # admission watermarks: queue-length cap / held-pool-fraction cap
+    # beyond which add_request sheds with a typed AdmissionRejected
+    # (never silent queue growth — `serving-unbounded-queue` corpus)
+    max_queue: Optional[int] = None
+    pool_watermark: Optional[float] = None
+    # dispatch watchdog: a scheduling round (quantum dispatch + token
+    # fetch) that exceeds this raises DecodeDispatchHang and recovers by
+    # rebuilding the batch from host-side cursors. None = no watchdog.
+    dispatch_timeout_s: Optional[float] = None
+    # round recovery attempts before the failure propagates (a transient
+    # fault heals on the first retry; a deterministic bug still raises)
+    round_retries: int = 2
+    # robustness/telemetry events drain into this JSONL at round
+    # boundaries (same record schema as the training engine's sink)
+    telemetry_jsonl: Optional[str] = None
 
 
 class ServingEngine:
@@ -169,11 +217,15 @@ class ServingEngine:
         if self._bucket % c.block_size:
             self._bucket = -(-self._bucket // c.block_size) * c.block_size
 
+        if c.pool_watermark is not None and not 0 < c.pool_watermark <= 1:
+            raise ValueError(f"pool_watermark={c.pool_watermark}: a held-"
+                             "pool fraction in (0, 1]")
         self.allocator = BlockAllocator(num_blocks)
         self.scheduler = RequestScheduler(
             self.allocator, c.max_seqs, c.block_size, c.decode_quantum,
             prompt_blocks=lambda n: self._pad_prompt(n) // c.block_size,
-            max_blocks_per_seq=self.MB)
+            max_blocks_per_seq=self.MB, max_queue=c.max_queue,
+            pool_watermark=c.pool_watermark)
 
         # device state -------------------------------------------------
         axes = (model.paged_cache_axes()
@@ -185,20 +237,41 @@ class ServingEngine:
                 is_leaf=lambda x: isinstance(x, P))
         else:
             self._pool_shardings = None
+        # fresh-pool program cached: fault recovery rebuilds the pool with
+        # the same jitted init the constructor uses
+        self._init_pools_fn = jax.jit(
+            lambda: model.init_paged_cache(num_blocks, c.block_size,
+                                           dtype=engine.dtype),
+            out_shardings=self._pool_shardings)
         with engine.mesh:
-            self.pools = jax.jit(
-                lambda: model.init_paged_cache(num_blocks, c.block_size,
-                                               dtype=engine.dtype),
-                out_shardings=self._pool_shardings)()
+            self.pools = self._init_pools_fn()
         self.pool_bytes = pool_bytes(mcfg, num_blocks, c.block_size,
                                      dtype=engine.dtype)
         self._tokens = jnp.zeros((c.max_seqs,), jnp.int32)
         self._requests: Dict[int, Request] = {}
         self._finished: List[Request] = []
+        self._cancelled: List[Request] = []
         self._prefill_fns: Dict[int, Any] = {}
         self._quantum_step = None
         self._rng_counter = 0
         self._stats_t0: Optional[float] = None
+        # reliability bookkeeping ---------------------------------------
+        self._counters = {"shed": 0, "deadline_misses": 0, "degraded": 0,
+                          "recoveries": 0, "recovery_ms": 0.0}
+        # recovery epoch: a watchdog-abandoned round thread re-checks this
+        # after its (injected) stall and bails out WITHOUT dispatching —
+        # stale work never races the recovered engine
+        self._epoch = 0
+        # the watchdog arms only once the quantum step has run once: the
+        # first round's jit compile is legitimate wall time, not a hang
+        self._quantum_warm = False
+        self._draining = False
+        self._preemption = None            # attach_preemption()
+        self._drain_dir: Optional[str] = None
+        self._jsonl = None
+        if c.telemetry_jsonl:
+            from deepspeed_tpu.monitor.monitor import JSONLMonitor
+            self._jsonl = JSONLMonitor(c.telemetry_jsonl)
 
         # backend micro-bench (one-time, on the REAL pool shapes) --------
         self.decode_backend, self.backend_bench = self._select_backend()
@@ -339,7 +412,12 @@ class ServingEngine:
     # ---- request API -------------------------------------------------
 
     def add_request(self, prompt_ids, max_new_tokens: int = 64,
-                    request_id: Optional[int] = None) -> int:
+                    request_id: Optional[int] = None,
+                    ttft_deadline_ms: Optional[float] = None,
+                    deadline_ms: Optional[float] = None) -> int:
+        """Submit one request. Raises the typed ``AdmissionRejected`` when
+        a watermark sheds it or the engine is draining — shed requests are
+        counted (stats()["shed"]) and evented, never silently queued."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if max_new_tokens < 1:
             # the prefill inherently samples one token; a 0-budget request
@@ -351,7 +429,22 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_model_len "
                 f"{self.max_model_len}")
-        req = self.scheduler.submit(prompt, max_new_tokens, rid=request_id)
+        if self._draining:
+            self._counters["shed"] += 1
+            rb_events.emit("request_shed", reason="draining")
+            raise AdmissionRejected("draining")
+        try:
+            req = self.scheduler.submit(
+                prompt, max_new_tokens, rid=request_id,
+                ttft_deadline_ms=(ttft_deadline_ms
+                                  if ttft_deadline_ms is not None
+                                  else self.config.ttft_deadline_ms),
+                deadline_ms=(deadline_ms if deadline_ms is not None
+                             else self.config.deadline_ms))
+        except AdmissionRejected as e:
+            self._counters["shed"] += 1
+            rb_events.emit("request_shed", reason=e.reason, **e.detail)
+            raise
         self._requests[req.rid] = req
         if self._stats_t0 is None:
             self._stats_t0 = req.submit_t
@@ -390,43 +483,116 @@ class ServingEngine:
         return jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(act)
 
     def step(self) -> List[Request]:
-        """One scheduling round: evict/admit/preempt at the boundary, then
-        one decode quantum. Prefill dispatches and the quantum's K decode
-        dispatches issue with NO host sync between them; the single sync is
-        the token fetch at the end. Returns requests finished this round."""
+        """One scheduling round: enforce deadlines, evict/admit/preempt at
+        the boundary, then one decode quantum. Prefill dispatches and the
+        quantum's K decode dispatches issue with NO host sync between them;
+        the single sync is the token fetch at the end. Returns requests
+        finished this round.
+
+        Reliability: a latched SIGTERM drains the engine first (raising
+        ``Preempted``); a round failure — failed/hung dispatch, injected
+        fault, backend failure — recovers by preempting every running
+        request, rebuilding the pool, and retrying (``round_retries``
+        times) before the error propagates."""
+        if self._preemption is not None and self._preemption.requested:
+            path = self.drain(self._drain_dir)
+            raise Preempted("serving engine drained on SIGTERM",
+                            ckpt_path=path)
+        self._enforce_deadlines()
+        finished: Optional[List[Request]] = None
+        last_err: Optional[BaseException] = None
+        for _attempt in range(max(0, self.config.round_retries) + 1):
+            try:
+                finished = self._round()
+                break
+            except (Preempted, KeyboardInterrupt):
+                raise
+            except rb_faults.BackendFault as e:
+                last_err = e
+                self._degrade_backend()
+                self._recover("backend_fault")
+            except Exception as e:  # noqa: BLE001 — ANY round failure
+                # (injected or real) must not kill every in-flight request:
+                # preempt-all + pool rebuild makes the retry bit-exact
+                last_err = e
+                self._recover(type(e).__name__)
+        self._drain_events()
+        if finished is None:
+            raise RuntimeError(
+                "serving round failed after "
+                f"{self.config.round_retries} recovery retries") from last_err
+        return finished
+
+    def _round(self) -> List[Request]:
         import jax
         import jax.numpy as jnp
 
-        decisions = self.scheduler.schedule()
-        for req in decisions["admitted"]:
-            self._dispatch_prefill(req)
-        if not self.scheduler.running:
-            return []
+        info = rb_faults.serving_round_seam()
+        keep = info.get("squeeze")
+        if keep is not None:
+            # pool_exhaust storm: hide all but `keep` free blocks for this
+            # round — the scheduler's queue/preempt paths run under real
+            # exhaustion, then the reserve lifts
+            self.allocator.set_reserve(
+                max(0, self.allocator.free_blocks - int(keep)))
+        try:
+            decisions = self.scheduler.schedule()
+            for req in decisions["admitted"]:
+                self._dispatch_prefill(req)
+            if not self.scheduler.running:
+                return []
 
-        tables, seq_lens, active = self._tables_device()
-        step_fn = self._get_quantum_step()
-        tokens = self._tokens
-        tok_outs = []
-        with self.engine.mesh:
-            for _ in range(self.config.decode_quantum):
-                self.pools, tokens, seq_lens = step_fn(
-                    self.engine.params, self.pools, tokens, tables,
-                    seq_lens, active, self._next_key())
-                tok_outs.append(tokens)
-        self._tokens = tokens
-        # the ONE sync of the round: K x [S] sampled tokens AND every
-        # pending prefill token (computed before the quantum) ride a
-        # single device_get
-        pending = [(req, req._first_dev)
-                   for req in self.scheduler.running
-                   if getattr(req, "_first_dev", None) is not None]
-        toks, firsts = jax.device_get(
-            (jnp.stack(tok_outs), [f for _, f in pending]))
-        toks = np.asarray(toks)                                  # [K, S]
+            tables, seq_lens, active = self._tables_device()
+            step_fn = self._get_quantum_step()
+            # keys precomputed so the watchdogged closure touches NO engine
+            # state: an abandoned (hung) round thread finishing late can
+            # only drop its local result, never clobber recovered state
+            keys = [self._next_key()
+                    for _ in range(self.config.decode_quantum)]
+            pending = [(req, req._first_dev)
+                       for req in self.scheduler.running
+                       if getattr(req, "_first_dev", None) is not None]
+            pools, tokens = self.pools, self._tokens
+            params, mesh = self.engine.params, self.engine.mesh
+            epoch = self._epoch
+
+            def quantum_and_fetch():
+                # the decode_dispatch fault seam lives INSIDE the guard: a
+                # hang here is exactly what the watchdog must time out
+                rb_faults.dispatch_seam()
+                if self._epoch != epoch:
+                    return None     # abandoned by a recovery: bail before
+                p, t, lens = pools, tokens, seq_lens   # touching the device
+                outs = []
+                with mesh:
+                    for k in keys:
+                        if self._epoch != epoch:
+                            return None
+                        p, t, lens = step_fn(params, p, t, tables, lens,
+                                             active, k)
+                        outs.append(t)
+                # the ONE sync of the round: K x [S] sampled tokens AND
+                # every pending prefill token ride a single device_get
+                toks, firsts = jax.device_get(
+                    (jnp.stack(outs), [f for _, f in pending]))
+                return p, t, toks, firsts
+
+            out = self._with_watchdog(quantum_and_fetch,
+                                      armed=self._quantum_warm)
+            if out is None:         # only reachable through a stale epoch
+                raise DecodeDispatchHang("round abandoned by recovery")
+            p, t, toks, firsts = out
+            self._quantum_warm = True
+            self.pools, self._tokens = p, t
+        finally:
+            if keep is not None:
+                self.allocator.set_reserve(0)
+        return self._commit_round(np.asarray(toks), pending, firsts)
+
+    def _commit_round(self, toks, pending, firsts) -> List[Request]:
         first_tok = {req.rid: int(np.asarray(f)[0])
                      for (req, _), f in zip(pending, firsts)}
         now = time.perf_counter()
-
         finished: List[Request] = []
         eos = self.config.eos_token_id
         for req in list(self.scheduler.running):
@@ -449,6 +615,230 @@ class ServingEngine:
                 finished.append(req)
         return finished
 
+    # ---- reliability: watchdog / recovery / degradation --------------
+
+    def _with_watchdog(self, fn, armed: bool = True):
+        timeout = self.config.dispatch_timeout_s
+        if not timeout or not armed:
+            return fn()
+        box: Dict[str, Any] = {}
+
+        def run():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["error"] = e
+
+        t = threading.Thread(target=run, daemon=True, name="serving-round")
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            # the zombie thread holds only locals (the caller commits
+            # pools/tokens on success), so its late result is dropped
+            raise DecodeDispatchHang(
+                f"decode round exceeded dispatch_timeout_s={timeout}")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _recover(self, reason: str) -> None:
+        """Fault recovery: every running request preempts back to the
+        queue (host cursors — prompt + generated — are authoritative), the
+        device pool rebuilds fresh, and normal re-admission re-prefills.
+        Bit-exact by the same recompute math preemption resume uses."""
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        self._epoch += 1          # abandoned round threads see this and bail
+        self.allocator.set_reserve(0)
+        n = self.scheduler.preempt_all()
+        for req in self._requests.values():
+            req._first_dev = None
+        self._tokens = jnp.zeros((self.config.max_seqs,), jnp.int32)
+        with self.engine.mesh:
+            self.pools = self._init_pools_fn()
+        ms = (time.perf_counter() - t0) * 1e3
+        self._counters["recoveries"] += 1
+        self._counters["recovery_ms"] += ms
+        rb_events.emit("serving_recovered", reason=reason, preempted=n,
+                       ms=round(ms, 2))
+
+    def _degrade_backend(self) -> None:
+        """Degradation ladder pallas -> XLA gather: a kernel failure
+        mid-serve swaps the quantum step to the gather backend (same math
+        on a gathered view — the parity the serving tests pin). Already at
+        the floor: nothing to swap; the recovery retry covers it."""
+        old = self.decode_backend
+        if old == "xla":
+            return
+        self.decode_backend = "xla"
+        self._quantum_step = None      # recompile with the gather backend
+        self._quantum_warm = False     # and re-warm before re-arming
+        self._counters["degraded"] += 1
+        self.backend_bench = dict(self.backend_bench, backend="xla",
+                                  degraded_from=old)
+        rb_events.emit("backend_degraded", **{"from": old, "to": "xla",
+                                              "reason": "backend_fault"})
+
+    def _enforce_deadlines(self) -> None:
+        """Round-boundary deadline sweep: TTFT deadlines apply until the
+        first token reached the host, total deadlines until completion.
+        A missed request is CANCELLED — a running one returns its slot and
+        blocks to the pool mid-decode — and its partial output stays
+        readable on ``cancelled``."""
+        now = time.perf_counter()
+        for req in (list(self.scheduler.waiting)
+                    + list(self.scheduler.running)):
+            elapsed_ms = (now - req.submit_t) * 1e3
+            if req.deadline_ms is not None and elapsed_ms > req.deadline_ms:
+                kind, budget = "total", req.deadline_ms
+            elif (req.ttft_deadline_ms is not None
+                  and req.first_token_t is None
+                  and elapsed_ms > req.ttft_deadline_ms):
+                kind, budget = "ttft", req.ttft_deadline_ms
+            else:
+                continue
+            self.scheduler.cancel(req, reason=f"{kind}_deadline")
+            self._cancelled.append(req)
+            self._counters["deadline_misses"] += 1
+            rb_events.emit("deadline_miss", rid=req.rid, kind=kind,
+                           budget_ms=budget,
+                           elapsed_ms=round(elapsed_ms, 1),
+                           generated=len(req.generated))
+
+    def _drain_events(self) -> None:
+        """Round-boundary drain of pending robustness events into the
+        configured JSONL sink. Without a sink the queue is left pending
+        (a co-resident training engine's monitor may own the drain)."""
+        if self._jsonl is None or not self._jsonl.enabled:
+            return
+        recs = rb_events.drain()
+        if recs:
+            self._jsonl.write_records(recs)
+
+    # ---- reliability: drain & resume ---------------------------------
+
+    def attach_preemption(self, handler, save_dir: Optional[str]) -> None:
+        """SIGTERM contract (PR-6 PreemptionHandler): the handler latches
+        the signal; the next step() boundary drains the engine into
+        ``save_dir`` and raises ``Preempted``. A restarted engine picks the
+        work back up with ``resume(save_dir)``."""
+        self._preemption = handler
+        self._drain_dir = save_dir
+
+    @property
+    def cancelled(self) -> List[Request]:
+        """Requests shed by deadline enforcement (partial outputs kept)."""
+        return list(self._cancelled)
+
+    def drain(self, save_dir: Optional[str] = None,
+              tag: str = "serving_drain") -> Optional[str]:
+        """Stop admission and checkpoint every unfinished request — block
+        tables + host cursors + generated tokens — through the integrity
+        chain (state payload, then manifest, then the COMMITTED marker
+        LAST, so a torn drain reads as torn). Returns the tag dir (None
+        when no save_dir: admission stops, nothing persists).
+
+        Only the host cursors (prompt + generated + budget) drive
+        ``resume`` — the restarted engine rebuilds device state by
+        re-prefilling. The block table / slot / cached_rows snapshot is
+        recorded for post-mortems (which slot held what at the drain),
+        not restored: a fresh pool has no use for the old physical ids."""
+        import json
+        import os
+        from deepspeed_tpu.robustness import integrity
+
+        self._draining = True
+        live = (sorted(self.scheduler.running,
+                       key=lambda r: r.admission_seq or 0)
+                + list(self.scheduler.waiting))
+        if save_dir is None:
+            rb_events.emit("serving_drained", requests=len(live), tag=None)
+            self._drain_events()
+            return None
+        tag_dir = os.path.join(save_dir, tag)
+        os.makedirs(tag_dir, exist_ok=True)
+        integrity.invalidate(tag_dir)      # rewriting in place: torn-able
+        state = {
+            "version": 1,
+            "rng_counter": self._rng_counter,
+            "requests": [{
+                "rid": req.rid,
+                "prompt": np.asarray(req.prompt).tolist(),
+                "generated": list(req.generated),
+                "max_new_tokens": req.max_new_tokens,
+                "preemptions": req.preemptions,
+                "cached_rows": req.cached_rows,
+                "block_ids": list(req.block_ids),
+                "slot": req.slot,
+                "state": req.state,
+                "ttft_deadline_ms": req.ttft_deadline_ms,
+                "deadline_ms": req.deadline_ms,
+            } for req in live],
+        }
+        integrity.atomic_write(os.path.join(tag_dir, "state.json"),
+                               json.dumps(state, indent=1),
+                               what="serving drain state write")
+        integrity.write_manifest(tag_dir)
+        integrity.write_commit_marker(tag_dir)
+        rb_events.emit("serving_drained", requests=len(live), tag=tag,
+                       path=tag_dir)
+        self._drain_events()
+        return tag_dir
+
+    def resume(self, save_dir: str, tag: Optional[str] = None) -> List[int]:
+        """Re-enqueue the requests a drained engine checkpointed: each
+        resumes by re-prefilling prompt + generated, so its continuation
+        is byte-identical to the uninterrupted run (the chaos soak pins
+        this). ``tag=None`` resolves the newest tag that passes integrity
+        validation — a torn drain is skipped, not loaded."""
+        import json
+        import os
+        from deepspeed_tpu.robustness import integrity
+
+        if tag is None:
+            tag = integrity.newest_valid_tag(save_dir)
+            if tag is None:
+                raise FileNotFoundError(
+                    f"no integrity-valid serving drain tag under {save_dir}")
+        tag_dir = os.path.join(save_dir, tag)
+        ok, reason = integrity.validate_tag(tag_dir)
+        if not ok:
+            raise ValueError(
+                f"serving drain tag '{tag}' failed integrity: {reason}")
+        with open(os.path.join(tag_dir, "state.json")) as f:
+            state = json.load(f)
+        self._rng_counter = max(self._rng_counter,
+                                int(state.get("rng_counter", 0)))
+        rids: List[int] = []
+        for rec in state["requests"]:
+            req = Request(rid=int(rec["rid"]),
+                          prompt=np.asarray(rec["prompt"], np.int32),
+                          max_new_tokens=int(rec["max_new_tokens"]),
+                          generated=[int(x) for x in rec.get("generated",
+                                                             [])],
+                          preemptions=int(rec.get("preemptions", 0)),
+                          ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                          deadline_ms=rec.get("deadline_ms"))
+            # the add_request context-cap validation, re-applied: resuming
+            # into an engine with a SMALLER max_model_len must refuse
+            # loudly — past the block-table width the clamp would overwrite
+            # the last block and silently corrupt the continuation
+            if req.prompt.size + req.max_new_tokens > self.max_model_len:
+                raise ValueError(
+                    f"resumed request {req.rid}: prompt ({req.prompt.size})"
+                    f" + max_new_tokens ({req.max_new_tokens}) exceeds this"
+                    f" engine's max_model_len {self.max_model_len} — "
+                    "resume into an engine at least as large as the "
+                    "drained one")
+            self.scheduler.restore(req)
+            self._requests[req.rid] = req
+            rids.append(req.rid)
+        if self._stats_t0 is None and rids:
+            self._stats_t0 = time.perf_counter()
+        rb_events.emit("serving_resumed", requests=len(rids), tag=tag)
+        self._drain_events()
+        return rids
+
     @staticmethod
     def _append(req: Request, token: int, eos) -> None:
         req.generated.append(token)
@@ -459,17 +849,24 @@ class ServingEngine:
         return req.remaining <= 0 or req.eos_seen
 
     def run(self, requests, max_new_tokens: int = 64,
-            max_rounds: int = 100000) -> Dict[int, np.ndarray]:
+            max_rounds: int = 100000,
+            shed_ok: bool = False) -> Dict[int, np.ndarray]:
         """Submit-and-drain convenience: requests is a list of prompt-id
         arrays or (prompt, max_new) tuples. Returns {rid: output ids} for
-        THIS call's requests only (stats() still aggregates across the
-        engine's lifetime — reset_stats() starts a fresh window)."""
+        THIS call's COMPLETED requests only — deadline-cancelled ones keep
+        their partial output on ``cancelled``, and watermark-shed
+        submissions raise ``AdmissionRejected`` (``shed_ok=True`` drops
+        them instead: they are already counted and evented). stats() still
+        aggregates across the engine's lifetime — reset_stats() starts a
+        fresh window."""
         rids = []
         for r in requests:
-            if isinstance(r, tuple):
-                rids.append(self.add_request(r[0], r[1]))
-            else:
-                rids.append(self.add_request(r, max_new_tokens))
+            prompt, n = r if isinstance(r, tuple) else (r, max_new_tokens)
+            try:
+                rids.append(self.add_request(prompt, n))
+            except AdmissionRejected:
+                if not shed_ok:
+                    raise
         rounds = 0
         while not self.scheduler.done:
             self.step()
@@ -483,25 +880,35 @@ class ServingEngine:
     # ---- stats -------------------------------------------------------
 
     def reset_stats(self) -> None:
-        """Start a fresh measurement window: completed-request records and
-        the throughput clock reset (pool/scheduler state untouched — the
-        bench warms its compiles, resets, then serves the timed load)."""
+        """Start a fresh measurement window: completed-request records,
+        cancellations, reliability counters and the throughput clock reset
+        (pool/scheduler state untouched — the bench warms its compiles,
+        resets, then serves the timed load)."""
         self._finished = []
+        self._cancelled = []
         self._stats_t0 = None
+        self._counters = {"shed": 0, "deadline_misses": 0, "degraded": 0,
+                          "recoveries": 0, "recovery_ms": 0.0}
 
     def stats(self) -> Dict[str, float]:
         """TTFT p50/p99 (ms) + aggregate generated-token throughput across
         everything finished so far — the SLO numbers the serving bench
-        emits. TTFT is measured at the first round boundary where the
-        request's first token reached the host (includes the quantum it
-        landed in — the honest, observable number)."""
+        emits — plus the reliability counters (shed / deadline_misses /
+        cancelled / degraded / recoveries / recovery_ms). TTFT is measured
+        at the first round boundary where the request's first token reached
+        the host (includes the quantum it landed in — the honest,
+        observable number)."""
         done = [r for r in self._finished if r.first_token_t is not None]
         out: Dict[str, float] = {
             "completed": float(len(self._finished)),
             "preemptions": float(sum(r.preemptions
                                      for r in self._finished)),
             "pool_bytes": float(self.pool_bytes),
+            "cancelled": float(len(self._cancelled)),
+            "queue_depth": float(self.scheduler.num_waiting),
         }
+        out.update({k: float(round(v, 3)) if isinstance(v, float)
+                    else float(v) for k, v in self._counters.items()})
         if done:
             ttft = np.asarray([(r.first_token_t - r.submit_t) * 1e3
                                for r in done])

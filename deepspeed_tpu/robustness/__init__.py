@@ -6,10 +6,12 @@ preemption-recovery rung (`__graft_entry__.dryrun_multichip`).
 """
 
 from deepspeed_tpu.robustness import events
-from deepspeed_tpu.robustness.faults import (FaultInjector, FaultSchedule,
-                                             active, clear, install,
-                                             install_from_config, io_seam,
-                                             mutate_seam)
+from deepspeed_tpu.robustness.faults import (BackendFault, DispatchFault,
+                                             FaultInjector, FaultSchedule,
+                                             active, clear, dispatch_seam,
+                                             install, install_from_config,
+                                             io_seam, mutate_seam,
+                                             serving_round_seam)
 from deepspeed_tpu.robustness.integrity import (newest_valid_tag, prune_tags,
                                                 validate_tag, write_commit_marker,
                                                 write_manifest)
@@ -17,8 +19,9 @@ from deepspeed_tpu.robustness.preemption import Preempted, PreemptionHandler
 from deepspeed_tpu.robustness.retry import retry_io
 
 __all__ = [
-    "FaultInjector", "FaultSchedule", "Preempted", "PreemptionHandler",
-    "active", "clear", "events", "install", "install_from_config", "io_seam",
-    "mutate_seam", "newest_valid_tag", "prune_tags", "retry_io",
+    "BackendFault", "DispatchFault", "FaultInjector", "FaultSchedule",
+    "Preempted", "PreemptionHandler", "active", "clear", "dispatch_seam",
+    "events", "install", "install_from_config", "io_seam", "mutate_seam",
+    "newest_valid_tag", "prune_tags", "retry_io", "serving_round_seam",
     "validate_tag", "write_commit_marker", "write_manifest",
 ]

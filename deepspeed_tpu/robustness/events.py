@@ -25,6 +25,10 @@ _LOCK = threading.Lock()
 _PENDING: List[Dict[str, Any]] = []
 _HISTORY: List[Dict[str, Any]] = []
 _MAX_HISTORY = 4096
+# pending is bounded too: a process with no drain wired (e.g. a serving
+# engine without a telemetry sink) must not grow this list forever under
+# a shed storm — oldest records drop, history keeps its bounded copy
+_MAX_PENDING = 4096
 
 
 def emit(event_type: str, **fields) -> Dict[str, Any]:
@@ -32,6 +36,7 @@ def emit(event_type: str, **fields) -> Dict[str, Any]:
     rec = {"type": event_type, "ts": time.time(), **fields}
     with _LOCK:
         _PENDING.append(rec)
+        del _PENDING[:-_MAX_PENDING]
         _HISTORY.append(rec)
         del _HISTORY[:-_MAX_HISTORY]
     logger.warning(f"robustness: {event_type} "

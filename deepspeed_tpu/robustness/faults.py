@@ -28,10 +28,32 @@ seams the production code already exposes:
                     checksum-invalid)
   preemption      — delivers a real SIGTERM to this process at step n,
                     exercising the ``PreemptionHandler`` path end-to-end
+                    (training: ``step`` key; serving: ``round`` key — the
+                    ServingEngine drains through the same handler)
   clock           — ``make_clock(base)`` wraps the rendezvous' injectable
                     clock with scheduled skew (a skewed host reads its peers
                     as dead / itself as live: heartbeat loss without
                     touching the store)
+
+Serving seams (ISSUE 10 — the serving tier's reliability layer calls these
+at its scheduling-round boundaries; ``at``/``round`` count the seam's own
+0-based INVOCATION index, exactly like the I/O seams count ops — recovery
+retries re-invoke the seams, so an index is "rounds attempted", not
+"rounds committed", and a fault that triggers a recovery shifts every
+later index by one attempt):
+
+  decode_dispatch — ``dispatch_seam()`` inside the watchdog-guarded quantum
+                    dispatch: mode "fail" (default) raises DispatchFault (a
+                    failed dispatch); mode "hang" sleeps ``hang_s`` so the
+                    engine's dispatch watchdog times the round out — both
+                    recover by rebuilding the batch from host-side cursors
+  pool_exhaust    — ``serving_round_seam()`` returns a squeeze: the engine
+                    hides (free - keep) blocks from the allocator for the
+                    round, forcing a REAL exhaustion storm through the
+                    scheduler's queue/preempt paths
+  backend_fault   — ``serving_round_seam()`` raises BackendFault (a Pallas
+                    kernel failure): the engine degrades to the XLA gather
+                    backend mid-serve and logs ``backend_degraded``
 
 Schedules are deterministic by construction: explicit entries fire at exact
 step/op indices, and the optional ``seed`` only feeds probabilistic rates
@@ -53,7 +75,18 @@ _ERRNO_BY_NAME = {"EIO": _errno.EIO, "ENOSPC": _errno.ENOSPC,
                   "ETIMEDOUT": _errno.ETIMEDOUT}
 
 KINDS = ("device_fault", "step_fault", "io_error", "torn_save",
-         "corrupt_payload", "preempt", "clock_skew")
+         "corrupt_payload", "preempt", "clock_skew",
+         "decode_dispatch", "pool_exhaust", "backend_fault")
+
+
+class DispatchFault(RuntimeError):
+    """Injected decode-dispatch failure (the serving engine's recovery
+    path treats it exactly like a real failed dispatch)."""
+
+
+class BackendFault(RuntimeError):
+    """Injected decode-kernel failure: the serving engine degrades to the
+    XLA gather backend and retries the round."""
 
 
 class FaultSchedule:
@@ -75,6 +108,14 @@ class FaultSchedule:
       probes          health consults the cull stays armed for
                       (device_fault; default 1 = transient blip)
       skew_s / after  clock_skew: add skew_s seconds after `after` reads
+      round           preempt only: 0-based serving round-seam invocation
+                      (the serving alternative to `step`; recovery retries
+                      advance it — see "Serving seams" above)
+      mode / hang_s   decode_dispatch: "fail" (default, raises) or "hang"
+                      (sleeps hang_s, default 30 — the engine's dispatch
+                      watchdog must time it out)
+      keep            pool_exhaust: free blocks left visible during the
+                      storm (default 0 = total exhaustion)
       rate            instead of step/at: per-opportunity probability drawn
                       from the schedule seed (still deterministic)
     """
@@ -90,11 +131,15 @@ class FaultSchedule:
                                  f" (choose from {KINDS})")
             # an entry with no trigger would validate and then never fire —
             # a chaos schedule that silently tests nothing
-            if kind in ("device_fault", "step_fault", "preempt") \
-                    and "step" not in e:
+            if kind in ("device_fault", "step_fault") and "step" not in e:
                 raise ValueError(f"faults.entries[{i}] ({kind}): needs "
                                  "'step' (1-based global step)")
-            if kind in ("io_error", "torn_save", "corrupt_payload") \
+            if kind == "preempt" and "step" not in e and "round" not in e:
+                raise ValueError(f"faults.entries[{i}] ({kind}): needs "
+                                 "'step' (1-based global step) or 'round' "
+                                 "(0-based serving round-seam invocation)")
+            if kind in ("io_error", "torn_save", "corrupt_payload",
+                        "decode_dispatch", "pool_exhaust", "backend_fault") \
                     and "at" not in e and "rate" not in e:
                 raise ValueError(f"faults.entries[{i}] ({kind}): needs 'at' "
                                  "(0-based op index) or 'rate'")
@@ -216,6 +261,53 @@ class FaultInjector:
             self._fire(e, "ckpt_mutate", path=victim, index=idx,
                        truncated_to=keep)
 
+    # -- serving seams (ServingEngine scheduling rounds) ----------------
+    def serving_round(self) -> Dict[str, Any]:
+        """Round-boundary seam, called once per scheduling-round ATTEMPT
+        (recovery retries included) BEFORE the admission/growth decisions.
+        Delivers round-keyed preemptions (SIGTERM), raises scheduled
+        BackendFaults, and returns the round's pool squeeze
+        ({"squeeze": blocks-to-keep-visible or None})."""
+        idx = self._count("serving_round")
+        squeeze = None
+        for e in self.schedule.entries:
+            kind = e["kind"]
+            if kind == "preempt" and e.get("round") == idx \
+                    and not e.get("_done"):
+                e["_done"] = True
+                self._fire(e, "serving_round", round=idx, signal="SIGTERM")
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif kind == "backend_fault" and self._matches_index(e, idx):
+                self._fire(e, "serving_round", round=idx)
+                raise BackendFault(
+                    f"injected backend_fault at serving round {idx} "
+                    "(robustness.faults)")
+            elif kind == "pool_exhaust" and self._matches_index(e, idx):
+                keep = int(e.get("keep", 0))
+                self._fire(e, "serving_round", round=idx, keep=keep)
+                squeeze = keep if squeeze is None else min(squeeze, keep)
+        return {"squeeze": squeeze}
+
+    def decode_dispatch(self) -> None:
+        """Dispatch seam, called inside the engine's watchdog-guarded
+        quantum dispatch. "fail" raises (failed dispatch); "hang" sleeps
+        past the watchdog (hung dispatch) — the watchdog's timeout, not
+        this sleep, is what the engine recovers from."""
+        import time as _time
+        idx = self._count("decode_dispatch")
+        for e in self.schedule.entries:
+            if e["kind"] != "decode_dispatch" \
+                    or not self._matches_index(e, idx):
+                continue
+            mode = e.get("mode", "fail")
+            self._fire(e, "decode_dispatch", index=idx, mode=mode)
+            if mode == "hang":
+                _time.sleep(float(e.get("hang_s", 30.0)))
+            else:
+                raise DispatchFault(
+                    f"injected decode_dispatch failure (op {idx}) "
+                    "(robustness.faults)")
+
     # -- clock seam (rendezvous) ---------------------------------------
     def make_clock(self, base=None):
         """Wrap a clock with scheduled skew: after `after` reads, add
@@ -299,3 +391,18 @@ def io_seam(category: str, path: Optional[str] = None,
 def mutate_seam(tag_dir: str) -> None:
     if _ACTIVE is not None:
         _ACTIVE.mutate_tag(tag_dir)
+
+
+def serving_round_seam() -> Dict[str, Any]:
+    """ServingEngine round-boundary hook: a no-op unless an injector is
+    installed. May raise BackendFault or deliver SIGTERM; returns the
+    round's pool squeeze decision."""
+    if _ACTIVE is not None:
+        return _ACTIVE.serving_round()
+    return {"squeeze": None}
+
+
+def dispatch_seam() -> None:
+    """ServingEngine decode-dispatch hook (inside the watchdog guard)."""
+    if _ACTIVE is not None:
+        _ACTIVE.decode_dispatch()

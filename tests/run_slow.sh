@@ -15,6 +15,9 @@
 #   CHAOS_BUDGET=1200 tests/run_slow.sh chaos  # chaos-soak override: the
 #       soak replays ~15 steps on top of 2x50 and rebuilds engines 4+ times,
 #       so it carries its own budget independent of the default tier budget
+#   SERVING_CHAOS_BUDGET=600 tests/run_slow.sh serving_chaos  # serving soak:
+#       3 interpret-Pallas engine builds + a 40-round faulted load +
+#       drain/resume (ISSUE 10)
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -64,6 +67,11 @@ for m in "${modules[@]}"; do
         # x 20 fp16 steps (fused attention backward + chunked TP overlap,
         # ZeRO 1/3) — interpret-mode Pallas makes the fused pair the cost
         *test_perf_levers*) budget="${PERF_LEVERS_BUDGET:-420}" ;;
+        # ISSUE-10 serving chaos soak: three engine builds on interpret-
+        # mode Pallas + a 40-round faulted load + drain/resume — budgeted
+        # separately from the quick serving module (matched FIRST: the
+        # *test_serving* glob below would swallow it)
+        *test_serving_chaos*) budget="${SERVING_CHAOS_BUDGET:-600}" ;;
         # ISSUE-9 serving tier: multi-tenant end-to-end runs (engine
         # rebuilds + per-bucket prefill compiles + int8 pool parity over
         # 24 decode steps) own a budget independent of the tier default

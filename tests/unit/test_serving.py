@@ -435,3 +435,324 @@ def test_paged_cache_leak_corpus_entry():
         [art], _stage0_config(), _FakePlan(),
         settings=AnalysisSettings(max_hbm_bytes=PAGED_LEAK_BUDGET))
     assert rep2.ok, [f.rule for f in rep2.findings]
+
+
+# ---------------------------------------------------------------------------
+# Reliability tier (ISSUE 10): typed allocator errors, aging, watermarks,
+# deadlines, fault recovery, drain/resume
+# ---------------------------------------------------------------------------
+
+from deepspeed_tpu.inference.kv_cache import InvalidBlock  # noqa: E402
+from deepspeed_tpu.inference.scheduler import AdmissionRejected  # noqa: E402
+from deepspeed_tpu.robustness import events as rb_events  # noqa: E402
+from deepspeed_tpu.robustness import faults as rb_faults  # noqa: E402
+from deepspeed_tpu.robustness.faults import (FaultInjector,  # noqa: E402
+                                             FaultSchedule)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Reliability tests install process-global injectors; never leak one
+    into a neighboring test."""
+    rb_faults.clear()
+    yield
+    rb_faults.clear()
+
+
+class TestInvalidBlock:
+    def test_out_of_range_free_raises_typed_with_owner(self):
+        """Both directions of the satellite: an out-of-range id (high OR
+        negative — the negative case previously WRAPPED into another
+        block's held bit via Python list indexing) raises InvalidBlock
+        naming the block and owning sequence; a valid free still works."""
+        a = BlockAllocator(8)
+        ids = a.alloc(3)
+        with pytest.raises(InvalidBlock, match=r"block id 99.*sequence 7"):
+            a.free([99], owner=7)
+        with pytest.raises(InvalidBlock, match=r"block id -1"):
+            a.free([-1])
+        # the failed frees changed nothing: the held blocks free cleanly
+        a.free(ids, owner=7)
+        assert a.free_blocks == 7
+        with pytest.raises(ValueError, match="double free"):
+            a.free([ids[0]])
+
+    def test_invalid_block_is_a_value_error(self):
+        # callers catching the pre-typed ValueError keep working
+        assert issubclass(InvalidBlock, ValueError)
+
+    def test_reserve_squeezes_visible_pool_only(self):
+        a = BlockAllocator(8)
+        a.set_reserve(5)
+        assert a.free_blocks == 2
+        assert not a.can_alloc(3)
+        got = a.alloc(2)
+        with pytest.raises(BlockPoolExhausted, match="squeezed"):
+            a.alloc(1)
+        a.set_reserve(0)
+        assert a.free_blocks == 5
+        a.free(got)
+
+
+class TestSchedulerAntiStarvation:
+    def test_resumed_tenant_is_not_revictimized(self):
+        """The satellite pin, 2-slot pool: when growth pressure returns
+        and the only co-tenant is a request that was ALREADY preempted
+        once, the victim ROTATES — the grower yields — instead of
+        re-preempting the same resumed request. The pre-aging
+        ``running.pop()`` picked the resumed request every time (it was
+        always the newest list entry): the livelock this pins against."""
+        alloc, s = _sched(num_blocks=7, max_seqs=2, bs=16, quantum=4, mb=8)
+        r1 = s.submit(np.arange(30), 64)       # 3 blocks each
+        r2 = s.submit(np.arange(30), 64)
+        assert len(s.schedule()["admitted"]) == 2
+        assert (r1.admission_seq, r2.admission_seq) == (0, 1)
+        assert alloc.free_blocks == 0
+        # r2 stands in for a request that was preempted once and resumed:
+        # same slot, same blocks, but it carries the aging bonus
+        r2.preemptions = 1
+        r1.cached_rows = 46                    # r1 needs a 4th block
+        r1.generated = list(range(16))
+        out = s.schedule()
+        # effective seq: r1 = 0, r2 = 1 - AGING_BONUS*1 = -1 -> the GROWER
+        # rotates out; r2 keeps its slot and makes progress
+        assert out["preempted"] == [r1]
+        assert r2.state == "running" and r2.preemptions == 1
+        assert r1.state == "waiting" and r1.preemptions == 1
+        # r1's generated tokens survive for its re-prefill resume
+        assert r1.generated == list(range(16))
+
+    def test_two_slot_adversarial_no_repeat_victim(self):
+        """End-to-end adversarial pattern: 2 slots, a 5-block pool, a new
+        arrival every round, every tenant growing a quantum per round and
+        finishing at 24 tokens. Sustained churn must never preempt the
+        same request twice in a row while another tenant was running, and
+        the queue keeps draining (no livelock: requests finish)."""
+        alloc, s = _sched(num_blocks=5, max_seqs=2, bs=16, quantum=8,
+                          mb=8)
+        reqs = [s.submit(np.arange(16), 24) for _ in range(2)]
+        victims = []          # (rid, tenants alive at preemption)
+        done = 0
+        for rnd in range(16):
+            out = s.schedule()
+            victims += [(r.rid, len(s.running) + len(out["preempted"]))
+                        for r in out["preempted"]]
+            for r in list(s.running):  # a quantum of growth per round
+                r.generated.extend([1] * 8)
+                r.cached_rows = len(r.prompt) + len(r.generated)
+                if len(r.generated) >= r.max_new_tokens:
+                    s.finish(r)
+                    done += 1
+            reqs.append(s.submit(np.arange(16), 24))   # adversarial stream
+        assert len(victims) >= 3, victims
+        repeats = [(a, b) for a, b in zip(victims, victims[1:])
+                   if a[0] == b[0] and b[1] >= 2]
+        assert not repeats, f"victim repeated with tenants alive: {victims}"
+        assert done >= 5          # the pool kept serving through the churn
+        # every preempted request either finished or is still en route —
+        # none is starved with multiple preemptions
+        for rid, _ in victims:
+            req = next(r for r in reqs if r.rid == rid)
+            assert req.preemptions <= 2, (rid, req.preemptions)
+
+
+class TestAdmissionWatermarks:
+    def test_queue_watermark_sheds_typed_and_counts(self):
+        rb_events.clear()
+        srv = _serving(max_queue=1)
+        srv.add_request(np.arange(4, dtype=np.int32), 4)
+        with pytest.raises(AdmissionRejected, match="queue_full"):
+            srv.add_request(np.arange(4, dtype=np.int32), 4)
+        assert srv.stats()["shed"] == 1.0
+        evs = rb_events.history("request_shed")
+        assert evs and evs[-1]["reason"] == "queue_full"
+        # the accepted request still completes
+        while not srv.scheduler.done:
+            srv.step()
+        assert srv.stats()["completed"] == 1.0
+
+    def test_pool_watermark_sheds_under_pressure(self):
+        srv = _serving(pool_watermark=0.05)
+        srv.add_request(np.arange(8, dtype=np.int32), 32)
+        srv.step()                       # admitted: pool now holds blocks
+        assert srv.allocator.used_fraction > 0.05
+        with pytest.raises(AdmissionRejected, match="pool_pressure"):
+            srv.add_request(np.arange(8, dtype=np.int32), 4)
+
+    def test_unbounded_queue_corpus_both_directions(self):
+        """The seeded defect fires `queue-growth`; the watermarked twin
+        sheds (typed) and passes — both runnable from the CLI too
+        (analysis.lint --corpus / analysis.serving_lint --max-queue)."""
+        from deepspeed_tpu.analysis.corpus import run_corpus
+        from deepspeed_tpu.analysis.serving_lint import audit_admission
+        rep = run_corpus("serving-unbounded-queue")
+        assert not rep.ok
+        assert any(f.rule == "queue-growth" for f in rep.findings)
+        assert rep.meta["shed"] == 0
+        twin = audit_admission(max_queue=8)
+        assert twin.ok, [f.rule for f in twin.findings]
+        assert twin.meta["shed"] > 0                 # typed, not silent
+        assert max(twin.meta["queue_depths"]) <= 8   # bounded
+
+
+class TestDeadlines:
+    def test_total_deadline_cancels_mid_decode_and_frees_blocks(self):
+        rb_events.clear()
+        srv = _serving()
+        rid = srv.add_request(np.arange(9, dtype=np.int32), 64)
+        srv.step()                       # admits + generates a quantum
+        held = srv.allocator.used_blocks
+        assert held > 0
+        # the budget expires while the request is mid-decode (set after
+        # the first round so compile wall-time can't race the clock)
+        srv._requests[rid].deadline_ms = 1e-3
+        srv.step()                       # boundary sweep: past deadline
+        req = srv._requests[rid]
+        assert req.state == "cancelled"
+        assert req.cancel_reason == "total_deadline"
+        assert srv.allocator.used_blocks == 0    # blocks returned mid-decode
+        assert srv.scheduler.done
+        st = srv.stats()
+        assert st["deadline_misses"] == 1.0 and st["cancelled"] == 1.0
+        assert st["completed"] == 0.0
+        # partial output stays readable; the miss is a structured event
+        assert len(srv.cancelled) == 1 and len(req.output) >= 9
+        ev = rb_events.history("deadline_miss")[-1]
+        assert ev["rid"] == rid and ev["kind"] == "total"
+
+    def test_ttft_deadline_sheds_queued_request(self):
+        srv = _serving(max_seqs=1)
+        # slot taken by a long request; the queued one can never make TTFT
+        first = srv.add_request(np.arange(5, dtype=np.int32), 24)
+        queued = srv.add_request(np.arange(5, dtype=np.int32), 8,
+                                 ttft_deadline_ms=1e-3)
+        srv.step()              # round 1: `first` admitted and decoding
+        srv.step()              # boundary sweep sheds the queued request
+        q = srv._requests[queued]
+        assert q.state == "cancelled" and q.cancel_reason == "ttft_deadline"
+        assert not q.generated
+        # `first` got its first token in round 1: TTFT no longer applies
+        f = srv._requests[first]
+        assert f.first_token_t is not None
+        assert f.state in ("running", "finished")
+        while not srv.scheduler.done:
+            srv.step()
+        assert f.state == "finished"
+        st = srv.stats()
+        assert st["deadline_misses"] == 1.0 and st["completed"] == 1.0
+
+
+class TestFaultRecovery:
+    def test_dispatch_fault_recovers_bit_identical(self):
+        """An injected failed dispatch mid-serve: the engine preempts all,
+        rebuilds the pool, re-prefills from host cursors — outputs exactly
+        equal the fault-free run, recovery evented."""
+        model = make_model(_cfg())
+        params = model.init(jax.random.PRNGKey(0))
+        import jax as _jax
+        rng = np.random.default_rng(2)
+        reqs = [(rng.integers(0, 128, size=(n,)).astype(np.int32), k)
+                for n, k in ((7, 16), (21, 12))]
+
+        def fresh():
+            return _serving(model=model,
+                            params=_jax.device_get(params))
+
+        base = fresh().run(list(reqs))
+        rb_events.clear()
+        inj = rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "decode_dispatch", "at": 1},
+            {"kind": "pool_exhaust", "at": 3},
+        ], seed=0)))
+        srv = fresh()
+        outs = srv.run(list(reqs))
+        assert {f["kind"] for f in inj.fired} == {"decode_dispatch",
+                                                  "pool_exhaust"}
+        st = srv.stats()
+        assert st["recoveries"] >= 1 and st["recovery_ms"] > 0
+        assert rb_events.history("serving_recovered")
+        for i in base:
+            np.testing.assert_array_equal(base[i], outs[i],
+                                          err_msg=f"request {i}")
+
+    def test_round_failure_exhausts_retries_and_raises(self):
+        """A deterministic fault (times > retries) must surface, not spin:
+        the typed failure names the retry budget."""
+        rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "decode_dispatch", "at": 0, "times": 99},
+        ], seed=0)))
+        srv = _serving(round_retries=1)
+        srv.add_request(np.arange(5, dtype=np.int32), 4)
+        with pytest.raises(RuntimeError, match="recovery retries"):
+            srv.step()
+        assert srv.stats()["recoveries"] == 2.0   # 1 try + 1 retry
+
+
+class TestDrainResume:
+    def test_drain_resume_bit_identical(self, tmp_path):
+        """SIGTERM contract minus the signal: drain() checkpoints block
+        tables + host cursors + generated tokens through the integrity
+        chain; a FRESH engine resumes them and the merged outputs equal
+        the uninterrupted run byte for byte."""
+        import jax as _jax
+        model = make_model(_cfg())
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(4)
+        reqs = [(rng.integers(0, 128, size=(n,)).astype(np.int32), k)
+                for n, k in ((7, 12), (21, 8), (12, 10))]
+
+        def fresh():
+            return _serving(model=model, params=_jax.device_get(params))
+
+        base = fresh().run(list(reqs))
+
+        rb_events.clear()
+        srv = fresh()
+        for p, k in reqs:
+            srv.add_request(p, k)
+        srv.step()                        # partial progress
+        tag_dir = srv.drain(str(tmp_path))
+        from deepspeed_tpu.robustness import integrity
+        ok, reason = integrity.validate_tag(tag_dir)
+        assert ok, reason                 # manifest + COMMITTED, verified
+        with pytest.raises(AdmissionRejected, match="draining"):
+            srv.add_request(np.arange(3, dtype=np.int32), 4)
+
+        srv2 = fresh()
+        rids = srv2.resume(str(tmp_path))
+        assert rids                       # something was in flight
+        outs = {}
+        while not srv2.scheduler.done:
+            for r in srv2.step():
+                outs[r.rid] = r.output
+        for r in srv._finished:           # finished before the drain
+            outs.setdefault(r.rid, r.output)
+        assert set(outs) == set(base)
+        for i in base:
+            np.testing.assert_array_equal(base[i], outs[i],
+                                          err_msg=f"request {i}")
+        assert rb_events.history("serving_drained")
+        assert rb_events.history("serving_resumed")
+
+    def test_resume_refuses_torn_drain(self, tmp_path):
+        """A drain without its COMMITTED marker (crash mid-drain) must be
+        skipped by tag resolution, not half-loaded."""
+        srv = _serving()
+        srv.add_request(np.arange(5, dtype=np.int32), 8)
+        tag_dir = srv.drain(str(tmp_path))
+        import os
+        os.remove(os.path.join(tag_dir, "COMMITTED"))
+        srv2 = _serving()
+        with pytest.raises(FileNotFoundError, match="integrity-valid"):
+            srv2.resume(str(tmp_path))
+
+    def test_resume_refuses_smaller_engine(self, tmp_path):
+        """Resuming into an engine with a smaller context cap must refuse
+        loudly — past the block-table width the growth clamp would
+        silently corrupt the continuation."""
+        srv = _serving()                          # max_model_len 128
+        srv.add_request(np.arange(60, dtype=np.int32), 60)
+        srv.drain(str(tmp_path))
+        small = _serving(max_model_len=64)
+        with pytest.raises(ValueError, match="max_model_len"):
+            small.resume(str(tmp_path))

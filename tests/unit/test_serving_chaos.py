@@ -1,0 +1,196 @@
+"""Serving chaos soak: a mixed continuous-batching load under every
+serving-seam fault must end BIT-IDENTICAL to the fault-free run.
+
+The schedule exercises the four injected conditions the reliability tier
+exists for, in one soak:
+
+  * ``decode_dispatch`` (fail)  — a failed quantum dispatch: recovery
+    preempts every running request, rebuilds the pool, re-prefills from
+    host cursors and retries the round;
+  * ``pool_exhaust``            — a 2-round allocator exhaustion storm: the
+    scheduler queues/preempts through it, nothing OOMs, nothing is lost;
+  * ``backend_fault``           — a Pallas kernel failure mid-serve: the
+    engine degrades to the XLA gather backend (``backend_degraded``) and
+    keeps every sequence's tokens identical (the gather is the same math
+    the kernel-parity tests pin);
+  * ``decode_dispatch`` (hang)  — a hung dispatch: the round watchdog times
+    it out and the same recovery path heals it;
+  * ``preempt`` (round-keyed)   — a real SIGTERM: the engine drains through
+    the integrity chain and a RESTARTED engine resumes the in-flight
+    requests with byte-identical continuations.
+
+Shed and deadline-miss events ride along via two canary requests (outside
+the compared set), so the telemetry JSONL ends up carrying the full event
+schema. Slow tier: three engine builds on interpret-mode Pallas. Runs
+under tests/run_slow.sh with its own budget (SERVING_CHAOS_BUDGET).
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.scheduler import AdmissionRejected
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.faults import FaultInjector, FaultSchedule
+from deepspeed_tpu.robustness.preemption import Preempted, PreemptionHandler
+
+pytestmark = pytest.mark.slow
+
+N_REQUESTS = 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_robustness_state():
+    rb_faults.clear()
+    rb_events.clear()
+    yield
+    rb_faults.clear()
+    rb_events.clear()
+
+
+def _model():
+    # head_dim 64: paged-kernel eligible, so the soak can run FORCED pallas
+    # and the backend_fault degradation ladder (pallas -> XLA gather) is
+    # exercised for real (interpret mode on CPU)
+    return make_model(TransformerConfig(
+        vocab_size=128, hidden_size=256, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_seq_len=256, position_type="rotary",
+        activation="silu_glu", norm_type="rmsnorm", tie_embeddings=False,
+        dtype=jnp.float32, attention_impl="xla"))
+
+
+def _load():
+    rng = np.random.default_rng(7)
+    return [(rng.integers(0, 128, size=(int(n),)).astype(np.int32), int(k))
+            for n, k in zip(rng.integers(5, 40, N_REQUESTS),
+                            rng.integers(8, 15, N_REQUESTS))]
+
+
+def _serving(model, params, jsonl=None, **kw):
+    d = dict(max_seqs=4, block_size=16, max_model_len=128,
+             decode_quantum=2, prompt_bucket=16, num_blocks=20,
+             decode_backend="pallas", telemetry_jsonl=jsonl)
+    d.update(kw)
+    return deepspeed_tpu.init_serving(model, config={}, serving=d,
+                                      dtype=jnp.float32,
+                                      params=jax.device_get(params))
+
+
+class TestServingChaosSoak:
+    def test_soak_bit_identical_to_fault_free(self, tmp_path):
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        reqs = _load()
+
+        # ---- fault-free baseline (same forced-pallas config) ----------
+        srv = _serving(model, params)
+        base = srv.run(list(reqs))
+        assert len(base) == N_REQUESTS
+        del srv
+
+        # ---- chaos run ------------------------------------------------
+        # round-indexed schedule (see module docstring); the SIGTERM at
+        # round 16 drains mid-load and a fresh engine resumes
+        inj = rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "decode_dispatch", "at": 2},
+            {"kind": "pool_exhaust", "at": 5, "times": 2},
+            {"kind": "backend_fault", "at": 8},
+            {"kind": "decode_dispatch", "at": 12, "mode": "hang",
+             "hang_s": 2.5},
+            {"kind": "preempt", "round": 16},
+        ], seed=3)))
+        rb_events.clear()
+        jsonl = str(tmp_path / "tel" / "serving_events.jsonl")
+        drain_dir = str(tmp_path / "drain")
+        handler = PreemptionHandler().install()
+        outs, rounds, engines = {}, 0, []
+        try:
+            srv1 = _serving(model, params, jsonl=jsonl,
+                            dispatch_timeout_s=1.0)
+            engines.append(srv1)
+            srv1.attach_preemption(handler, drain_dir)
+            for p, k in reqs:
+                srv1.add_request(p, k)
+            resumed = False
+            srv_cur = srv1
+            while not srv_cur.scheduler.done:
+                try:
+                    for r in srv_cur.step():
+                        outs[r.rid] = r.output
+                    rounds += 1
+                except Preempted:
+                    assert not resumed, "preempted twice"
+                    resumed = True
+                    # the drained engine checkpointed through the
+                    # integrity chain; a FRESH engine resumes the work
+                    handler.reset()
+                    srv2 = _serving(model, params, jsonl=jsonl,
+                                    dispatch_timeout_s=1.0)
+                    engines.append(srv2)
+                    rids = srv2.resume(drain_dir)
+                    assert rids, "nothing was in flight at the drain"
+                    # canaries (outside the compared set): a shed and a
+                    # deadline miss, so those events reach the JSONL too
+                    srv2.scheduler.max_queue = 0
+                    with pytest.raises(AdmissionRejected):
+                        srv2.add_request(np.arange(4, dtype=np.int32), 4)
+                    srv2.scheduler.max_queue = None
+                    srv2.add_request(np.arange(4, dtype=np.int32), 4,
+                                     ttft_deadline_ms=1e-3)
+                    srv_cur = srv2
+            assert resumed, "the SIGTERM preemption never fired"
+        finally:
+            handler.restore()
+            rb_faults.clear()
+        for srv in engines:          # requests finished before the drain
+            for r in srv._finished:
+                outs.setdefault(r.rid, r.output)
+
+        # every scheduled fault actually fired
+        fired = {f["kind"] for f in inj.fired}
+        assert fired == {"decode_dispatch", "pool_exhaust", "backend_fault",
+                         "preempt"}, fired
+        modes = {f.get("mode") for f in inj.fired
+                 if f["kind"] == "decode_dispatch"}
+        assert modes == {"fail", "hang"}          # both dispatch shapes
+
+        # degradation happened mid-serve and was evented; recoveries ran;
+        # the soak is a REAL 40-round mixed load
+        assert srv1.decode_backend == "xla"       # pallas -> gather ladder
+        assert srv1.stats()["degraded"] == 1.0
+        st = [e.stats() for e in engines]
+        assert sum(s["recoveries"] for s in st) >= 3   # fail + hang + fault
+        assert rounds >= 40, rounds
+
+        # the canaries produced shed + deadline evidence without touching
+        # the compared set
+        assert srv_cur.stats()["shed"] == 1.0
+        assert srv_cur.stats()["deadline_misses"] == 1.0
+
+        # ---- the acceptance bar: BIT-IDENTICAL outputs ----------------
+        assert set(outs) >= set(base)
+        for rid in base:
+            np.testing.assert_array_equal(
+                base[rid], outs[rid],
+                err_msg=f"request {rid} diverged under chaos")
+
+        # ---- events visible in the telemetry JSONL --------------------
+        types = set()
+        for p in glob.glob(os.path.join(os.path.dirname(jsonl), "*")):
+            with open(p) as f:
+                for line in f:
+                    try:
+                        types.add(json.loads(line).get("type"))
+                    except ValueError:
+                        pass
+        assert {"fault_injected", "serving_recovered", "backend_degraded",
+                "serving_drained", "serving_resumed", "request_shed",
+                "deadline_miss"} <= types, types
