@@ -338,6 +338,11 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 print(f"bench: serving bench failed: {e}", file=sys.stderr)
             gc.collect()
             try:
+                result.update(_router_bench(size))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: router bench failed: {e}", file=sys.stderr)
+            gc.collect()
+            try:
                 result.update(_capacity_bench())
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: capacity bench failed: {e}", file=sys.stderr)
@@ -364,6 +369,15 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                                              small=True))
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: serving bench failed: {e}", file=sys.stderr)
+            # CPU smoke of the 2-replica router rung: tiny model, same
+            # router/registry/failover code path incl. the mid-run kill,
+            # so serve_failover_ms / serve_lost_requests can't rot on
+            # boxes without the relay
+            try:
+                result.update(_router_bench(size, n_requests=12, max_new=8,
+                                            small=True))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: router bench failed: {e}", file=sys.stderr)
         return result
     raise RuntimeError(f"every bench rung OOM'd; last error: {last_err}")
 
@@ -1224,6 +1238,127 @@ def _serving_bench(size: str, n_requests: int = 32,
     except Exception as e:  # noqa: BLE001 — evidence rung, not gate
         print(f"bench: faulted serving rung failed: {e}", file=sys.stderr)
     del srv
+    _gc.collect()
+    return out
+
+
+def _router_bench(size: str, n_requests: int = 24, max_new: int = 16,
+                  small: bool = False) -> dict:
+    """Multi-replica routing rung (ISSUE 11): a 2-replica mixed load with
+    a mid-run replica kill, served through the rendezvous-backed
+    ``ServingRouter``. Emits the failover unavailability window
+    (``serve_failover_ms`` = kill to last in-flight request re-placed on a
+    survivor), the spill rate (admissions that shed on their first-choice
+    replica and landed on a sibling instead), the lost-request count
+    (MUST be 0 — failover migrates the drained snapshot), and the
+    2-replica p99 TTFT next to the single-engine SLO rungs. The existing
+    single-engine rungs (incl. ``decode_floor_ok``) are untouched.
+
+    The registry clock is simulated (1 s per routing round) so heartbeat
+    staleness — the detection path — advances deterministically; the
+    failover window itself is real wall time."""
+    import collections
+    import gc as _gc
+    import shutil
+    import tempfile
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.router import RouterConfig, ServingRouter
+    from deepspeed_tpu.inference.scheduler import AdmissionRejected
+    from deepspeed_tpu.models import llama_config, make_model
+    from deepspeed_tpu.robustness import faults as rb_faults
+    from deepspeed_tpu.robustness.faults import FaultInjector, FaultSchedule
+
+    overrides = dict(vocab_size=2048, num_layers=2, hidden_size=128,
+                     num_heads=4, num_kv_heads=2,
+                     intermediate_size=384) if small else {}
+    cfg = llama_config(size, max_seq_len=4096, **overrides)
+    model = make_model(cfg, name=f"llama-{size}-router")
+    rng = np.random.default_rng(0)
+    serving_kw = (dict(max_seqs=4, block_size=16, max_model_len=128,
+                       decode_quantum=4, prompt_bucket=16, max_queue=6)
+                  if small else
+                  # per-replica pools sized like the serving rung's but
+                  # halved (two engines share the chip); tight queue
+                  # watermark so the overload burst actually spills
+                  dict(max_seqs=16, block_size=64, max_model_len=2048,
+                       decode_quantum=8, num_blocks=320, max_queue=8))
+    srv0 = deepspeed_tpu.init_serving(
+        model, config={"train_batch_size": 1}, serving=dict(serving_kw))
+    # the second replica shares the first's params — replicas replicate
+    # compute, not weights-at-rest
+    srv1 = deepspeed_tpu.init_serving(
+        model, config={"train_batch_size": 1}, serving=dict(serving_kw),
+        params=srv0.engine.params)
+    prompts = [16, 32, 48] if small else [64, 128, 256, 512]
+    reqs = [(rng.integers(0, cfg.vocab_size,
+                          size=(prompts[i % len(prompts)],),
+                          ).astype(np.int32), max_new)
+            for i in range(n_requests)]
+    # warm each replica's compiles (per-bucket prefill + quantum step)
+    # outside the timed window
+    for srv in (srv0, srv1):
+        srv.run([(rng.integers(0, cfg.vocab_size, size=(p,)
+                               ).astype(np.int32), 4) for p in prompts])
+        srv.reset_stats()
+    tmp = tempfile.mkdtemp(prefix="router_bench_")
+    t = [0.0]
+    rcfg = RouterConfig(store_dir=os.path.join(tmp, "store"),
+                        drain_dir=os.path.join(tmp, "drains"),
+                        dead_after_s=2.5, breaker_faults=2,
+                        breaker_probe_after=1, clock=lambda: t[0])
+    router = ServingRouter(rcfg)
+    router.register("r0", srv0)
+    router.register("r1", srv1)
+    prev = rb_faults.active()
+    # the kill lands right after the round-1 overload burst, while both
+    # replicas hold in-flight work — killing later risks an empty drain
+    # on fast rungs (nothing left to migrate = no failover evidence)
+    rb_faults.install(FaultInjector(FaultSchedule([
+        {"kind": "replica_kill", "at": 2, "replica": 1},
+    ], seed=0)))
+    pending = collections.deque(reqs)
+    arrive = max(2, n_requests // 8)
+    rounds = 0
+    t0 = time.perf_counter()
+    try:
+        while pending or not router.done:
+            # steady arrivals with one overload burst at round 1: the
+            # first-choice replica's queue watermark sheds the tail and
+            # the router spills it to the sibling (typed, counted)
+            feed = min(len(pending),
+                       max(arrive, 10) if rounds == 1 else arrive)
+            for _ in range(feed):
+                try:
+                    router.add_request(*pending[0])
+                except AdmissionRejected:
+                    break            # all saturated: retry next round
+                pending.popleft()
+            router.step()
+            t[0] += 1.0
+            rounds += 1
+            if rounds > 100000:
+                raise RuntimeError("router rung did not converge")
+    finally:
+        rb_faults.install(prev)
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.perf_counter() - t0
+    st = router.stats()
+    if st["lost_requests"]:
+        print(f"bench: ROUTER LOST REQUESTS: {st['lost_requests']} "
+              "(failover must migrate every in-flight request — see "
+              "ISSUE 11 acceptance)", file=sys.stderr)
+    out = {
+        "serve_failover_ms": st["failover_ms"],
+        "serve_router_spill_rate": st["spill_rate"],
+        "serve_lost_requests": int(st["lost_requests"]),
+        "serve_p99_ttft_ms_2replica": round(st.get("p99_ttft_ms", 0.0), 1),
+        "serve_router_migrated": int(st["migrated"]),
+        "serve_router_rounds": rounds,
+        "serve_router_completed": int(st["completed"]),
+        "serve_router_tok_per_sec": round(
+            (int(st["completed"]) * max_new) / dt, 1),
+    }
+    del router, srv0, srv1
     _gc.collect()
     return out
 

@@ -20,6 +20,7 @@ from deepspeed_tpu.config import Config
 from deepspeed_tpu.runtime.engine import Engine, initialize
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
 from deepspeed_tpu.inference.serving import ServingEngine, init_serving
+from deepspeed_tpu.inference.router import RouterConfig, ServingRouter
 from deepspeed_tpu import comm
 from deepspeed_tpu.utils import logging as _logging
 

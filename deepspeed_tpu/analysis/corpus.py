@@ -520,6 +520,21 @@ def serving_unbounded_queue(devices=None):
     return audit_admission(max_queue=None)
 
 
+def router_blackhole(devices=None):
+    """Routing audit: a multi-replica serving router with NO circuit
+    breaker, fed a steady arrival stream while one replica dies silently
+    mid-run. The dead replica's registry meta froze at low load, so the
+    breaker-less router keeps winning ties toward the corpse — its
+    attributed in-flight count grows monotonically and nothing completes.
+    ``inflight-growth`` must fire. The breaker-enabled twin (same load,
+    same kill, ``RouterConfig.breaker=True``) detects the stale heartbeat,
+    fails over from the drain snapshot, and passes — tests assert both
+    directions; the twin is also CLI-runnable
+    (``serving_lint --router --breaker``)."""
+    from deepspeed_tpu.analysis.serving_lint import audit_router
+    return audit_router(breaker=False)
+
+
 def exposed_collective_trace(devices=None):
     """Perf doctor gate: a TRACED step (not a compiled program) whose
     all-reduce runs with nothing scheduled under it — 8 ms of measured
@@ -544,6 +559,7 @@ CORPUS = {
     "stage3-replicated-opt": stage3_replicated_opt,
     "paged-cache-leak": paged_cache_leak,
     "serving-unbounded-queue": serving_unbounded_queue,
+    "router-blackhole": router_blackhole,
     "exposed-collective-trace": exposed_collective_trace,
     "serialized-backward": serialized_backward,
 }

@@ -1,4 +1,5 @@
-"""Serving admission audit: flag unbounded queue growth under exhaustion.
+"""Serving admission + routing audits: unbounded queues and router
+blackholes.
 
 The serving scheduler queues gracefully when the block pool is exhausted —
 which is exactly right for transient pressure and exactly wrong as the ONLY
@@ -24,9 +25,30 @@ Both directions are CLI-runnable::
 and the defect is seeded as the ``serving-unbounded-queue`` corpus entry
 (``python -m deepspeed_tpu.analysis.lint --corpus serving-unbounded-queue``)
 so the CI gate proves the rule still fires.
+
+Second rule (ISSUE 11): the **router blackhole**. A multi-replica router
+ranks replicas by their last-published registry meta. A replica that dies
+silently stops publishing — its meta FREEZES at whatever (low) load it
+last reported — and a router with no circuit breaker keeps winning the
+tie-break toward the corpse forever: every new request is assigned into
+the void, the dead replica's router-side in-flight count grows
+monotonically, and nothing ever completes. ``audit_router`` replays a
+deterministic 2-replica load with a mid-run silent kill through the REAL
+``ServingRouter`` over pure-host stub replicas (no jax) and fires an
+``inflight-growth`` finding when the dead replica's attributed in-flight
+count grew monotonically through the post-kill window with nothing
+migrated. The breaker-enabled twin detects the stale heartbeat, fails
+over from the drain snapshot, and passes. Both directions are
+CLI-runnable::
+
+    python -m deepspeed_tpu.analysis.serving_lint --router            # defect
+    python -m deepspeed_tpu.analysis.serving_lint --router --breaker  # twin
+
+and the defect is seeded as the ``router-blackhole`` corpus entry.
 """
 
 import argparse
+import dataclasses
 import json
 import sys
 from typing import Any, Dict, Optional
@@ -102,6 +124,217 @@ def audit_admission(max_queue: Optional[int] = None,
     return report
 
 
+# a dead replica carrying this many router-attributed in-flight requests
+# after the kill (vs a handful of slots) is a blackhole, not jitter
+INFLIGHT_GROWTH_BOUND = 8
+
+
+@dataclasses.dataclass
+class _StubFinished:
+    """Just enough of a finished Request for the router's bookkeeping."""
+    rid: int
+    submit_t: float
+    first_token_t: float
+
+
+class _StubReplica:
+    """Pure-host replica stand-in implementing the router's handle
+    protocol (``inference/router.ReplicaHandle``): admissions append to a
+    FIFO, each step "serves" up to ``service_rate`` of them, heartbeats
+    carry the same schema-versioned meta. ``die()`` models a supervised
+    kill: the replica drains its in-flight work through the REAL
+    integrity chain (the PR-10 SIGTERM contract) and then goes silent —
+    it still ACCEPTS dispatches (a blackholed backend's connections open;
+    nothing ever answers) but completes nothing and never heartbeats
+    again. Whether a router keeps feeding the corpse is purely the
+    router's health logic — which is what the audit measures."""
+
+    def __init__(self, name: str, store_dir: str, drain_root: str,
+                 capacity: int = 4, service_rate: int = 2, clock=None):
+        import os
+        from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+        self.name = name
+        self.rdzv = FileRendezvous(store_dir, name, clock=clock)
+        self.drain_dir = os.path.join(drain_root, name)
+        self.capacity = capacity
+        self.service_rate = service_rate
+        self._clock = clock or __import__("time").time
+        self.dead = False            # router-visible only AFTER failover
+        self.silent = False          # the actual death: no beats, no work
+        self.partitioned = False
+        self.mute_heartbeat = False
+        self.killed_t = None
+        self._q: list = []           # [(rid, submit_t)]
+        self.completed = 0
+
+    # -- handle protocol ------------------------------------------------
+    def meta(self) -> Dict[str, Any]:
+        return {"role": "replica", "queue_depth": len(self._q),
+                "running": 0, "capacity": self.capacity,
+                "pool_free": 1.0, "draining": False}
+
+    def publish(self) -> None:
+        if self.silent or self.mute_heartbeat:
+            return
+        self.rdzv.heartbeat(meta=self.meta())
+
+    def try_admit(self, prompt, max_new_tokens: int, rid: int,
+                  **_deadlines) -> int:
+        self._q.append((rid, self._clock()))
+        return rid
+
+    def step(self):
+        if self.partitioned:
+            from deepspeed_tpu.inference.router import ReplicaUnreachable
+            raise ReplicaUnreachable(
+                f"router partition: replica {self.name} unreachable")
+        if self.silent:
+            return []                # the blackhole: accepted, never served
+        now = self._clock()
+        out = []
+        for rid, sub in self._q[:self.service_rate]:
+            out.append(_StubFinished(rid=rid, submit_t=sub,
+                                     first_token_t=now))
+        del self._q[:len(out)]
+        self.completed += len(out)
+        try:
+            self.publish()
+        except OSError:
+            pass     # mirror ReplicaHandle.step: a store-write hiccup
+        return out   # must never drop the round's completed work
+
+    def accept_migration(self, recs, rng_counter=None, source=None):
+        rids = [int(r["rid"]) for r in recs]
+        now = self._clock()
+        self._q.extend((rid, now) for rid in rids)
+        return rids
+
+    def new_cancelled(self):
+        return []
+
+    @property
+    def done(self) -> bool:
+        return self.silent or not self._q
+
+    def inflight(self) -> int:
+        return len(self._q)
+
+    # -- the orchestrated death ------------------------------------------
+    def die(self) -> None:
+        """Supervised kill: drain the in-flight FIFO through the integrity
+        chain (state payload -> manifest -> COMMITTED last), then silence."""
+        import os
+        from deepspeed_tpu.robustness import integrity
+        tag_dir = os.path.join(self.drain_dir, f"drain_{self.name}")
+        os.makedirs(tag_dir, exist_ok=True)
+        state = {"version": 2, "source": self.name,
+                 "engine": {"max_model_len": 4096, "block_size": 16,
+                            "table_width": 256, "max_seqs": self.capacity},
+                 "requests": [{"rid": rid, "prompt": [1, 2, 3],
+                               "max_new_tokens": 8, "generated": []}
+                              for rid, _ in self._q]}
+        integrity.atomic_write(os.path.join(tag_dir, "state.json"),
+                               json.dumps(state, indent=1),
+                               what="stub drain state write")
+        integrity.write_manifest(tag_dir)
+        integrity.write_commit_marker(tag_dir)
+        self._q = []
+        self.silent = True
+
+
+def simulate_router(breaker: bool, rounds: int = 30,
+                    arrivals_per_round: int = 2, kill_round: int = 6,
+                    dead_after_s: float = 2.5) -> Dict[str, Any]:
+    """Deterministic 2-replica routing replay through the REAL
+    ``ServingRouter`` over stub replicas: replica ``r0`` is killed
+    (drain + silence) at ``kill_round``; arrivals keep coming. Returns the
+    per-round router-attributed in-flight trajectory of the dead replica
+    plus the router's counters. Clock is simulated (1s per round) so
+    heartbeat staleness — the only health signal — advances exactly one
+    second per round."""
+    import logging as _logging
+    import shutil
+    import tempfile
+    from deepspeed_tpu.inference.router import RouterConfig, ServingRouter
+    from deepspeed_tpu.inference.scheduler import AdmissionRejected
+    from deepspeed_tpu.utils.logging import logger as _logger
+
+    tmp = tempfile.mkdtemp(prefix="router_lint_")
+    t = [0.0]
+    # the replay emits a robustness event per routed decision and the
+    # repo logger writes to stdout — silence it for the audit window so
+    # `--json` output stays parseable (events still land in
+    # rb_events.history for anyone who wants the replay's trace)
+    prev_level = _logger.level
+    _logger.setLevel(_logging.ERROR)
+    try:
+        cfg = RouterConfig(
+            store_dir=f"{tmp}/store", drain_dir=f"{tmp}/drains",
+            dead_after_s=dead_after_s, breaker=breaker, breaker_faults=2,
+            breaker_probe_after=1, clock=lambda: t[0])
+        router = ServingRouter(cfg)
+        reps = [
+            _StubReplica("r0", cfg.store_dir, cfg.drain_dir,
+                         clock=cfg.clock),
+            _StubReplica("r1", cfg.store_dir, cfg.drain_dir,
+                         clock=cfg.clock)]
+        for rep in reps:
+            router.register_handle(rep)
+        prompt = np.arange(4, dtype=np.int32)
+        shed = 0
+        traj: list = []
+        for rnd in range(rounds):
+            if rnd == kill_round:
+                reps[0].die()
+            for _ in range(arrivals_per_round):
+                try:
+                    router.add_request(prompt, 8)
+                except AdmissionRejected:
+                    shed += 1
+            router.step()
+            t[0] += 1.0
+            traj.append(router.replica_inflight()["r0"])
+        st = router.stats()
+        return {"inflight_r0": traj, "kill_round": kill_round,
+                "rounds": rounds, "breaker": breaker, "shed": shed,
+                "completed": int(st["completed"]),
+                "migrated": int(st["migrated"]),
+                "lost": int(st["lost_requests"]),
+                "survivor_completed": reps[1].completed}
+    finally:
+        _logger.setLevel(prev_level)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def audit_router(breaker: bool = False, **sim_kwargs) -> Report:
+    """Run the blackhole replay and gate it: the dead replica's attributed
+    in-flight count growing monotonically through the post-kill window past
+    ``INFLIGHT_GROWTH_BOUND`` with nothing migrated = the
+    ``inflight-growth`` defect (a router assigning into a corpse)."""
+    sim = simulate_router(breaker=breaker, **sim_kwargs)
+    post = sim["inflight_r0"][sim["kill_round"]:]
+    monotone = all(b >= a for a, b in zip(post, post[1:]))
+    report = Report(meta={"analyzer": "serving-router", **sim})
+    if monotone and post and post[-1] >= INFLIGHT_GROWTH_BOUND \
+            and sim["migrated"] == 0:
+        report.extend([Finding(
+            rule="inflight-growth",
+            message=(f"router kept assigning to dead replica r0: its "
+                     f"attributed in-flight count grew monotonically to "
+                     f"{post[-1]} over the {len(post)} rounds after the "
+                     "kill with nothing migrated — enable the per-replica "
+                     "circuit breaker (RouterConfig.breaker) so a stale "
+                     "heartbeat opens the breaker and a confirmed-dead "
+                     "replica fails over to survivors instead of "
+                     "blackholing traffic"),
+            severity="error", program="serving_router",
+            ident="router-blackhole",
+            data={"final_inflight": post[-1],
+                  "post_kill_rounds": len(post),
+                  "migrated": sim["migrated"], "lost": sim["lost"]})])
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis.serving_lint",
@@ -114,14 +347,36 @@ def main(argv=None) -> int:
     p.add_argument("--pool-watermark", type=float, default=None,
                    help="held-pool-fraction watermark to audit")
     p.add_argument("--rounds", type=int, default=24)
+    p.add_argument("--router", action="store_true",
+                   help="run the router blackhole audit instead (2 stub "
+                        "replicas, mid-run silent kill; inflight-growth "
+                        "gate)")
+    p.add_argument("--breaker", action="store_true",
+                   help="router audit only: enable the circuit breaker "
+                        "(the passing twin; omit = the seeded defect)")
     p.add_argument("--json", action="store_true",
                    help="print the full report as JSON")
     args = p.parse_args(argv)
-    report = audit_admission(max_queue=args.max_queue,
-                             pool_watermark=args.pool_watermark,
-                             rounds=args.rounds)
+    if args.router:
+        report = audit_router(breaker=args.breaker,
+                              rounds=max(args.rounds, 16))
+    else:
+        report = audit_admission(max_queue=args.max_queue,
+                                 pool_watermark=args.pool_watermark,
+                                 rounds=args.rounds)
     if args.json:
         print(json.dumps(report.to_dict(), indent=1, default=str))
+    elif args.router:
+        sim = report.meta
+        print(f"serving_lint: dead-replica inflight "
+              f"{sim['inflight_r0'][-1]} after {sim['rounds']} rounds "
+              f"(kill @ {sim['kill_round']}), migrated {sim['migrated']}, "
+              f"lost {sim['lost']}, survivor completed "
+              f"{sim['survivor_completed']}")
+        for f in report.findings:
+            print(f"  {f.severity}: {f.rule}: {f.message}")
+        if report.ok:
+            print("serving_lint: OK (dead replica failed over)")
     else:
         sim = report.meta
         print(f"serving_lint: queue depth {sim['queue_depths'][-1]} after "

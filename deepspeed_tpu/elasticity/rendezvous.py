@@ -70,18 +70,32 @@ class FileRendezvous:
     def _hb_path(self, host: str) -> str:
         return os.path.join(self.store, f"hb_{host}.json")
 
-    def heartbeat(self):
-        """Atomic write (tmp + rename): a torn read must not kill a host."""
+    def heartbeat(self, meta: Optional[Dict[str, Any]] = None):
+        """Atomic write (tmp + rename): a torn read must not kill a host.
+
+        ``meta`` is an optional opaque payload the host wants its peers to
+        see next to its liveness (the serving router publishes queue depth
+        / capacity here). The payload carries ``schema`` so readers can
+        version-gate: hosts that predate the field wrote neither ``schema``
+        nor ``meta``, and readers treat both as absent — old and new hosts
+        interop over one store (pinned by a unit test)."""
         self._beats += 1
+        payload: Dict[str, Any] = {"host": self.host, "beats": self._beats,
+                                   "ts": self._clock(), "schema": 1}
+        if meta is not None:
+            payload["meta"] = dict(meta)
         tmp = self._hb_path(self.host) + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump({"host": self.host, "beats": self._beats,
-                       "ts": self._clock()}, f)
+            json.dump(payload, f)
         os.replace(tmp, self._hb_path(self.host))
 
-    def live_hosts(self) -> List[str]:
-        now = self._clock()
-        out = set()
+    def read_heartbeats(self) -> Dict[str, Dict[str, Any]]:
+        """Every readable heartbeat payload by host, with NO liveness
+        filter — the router's registry cache wants stale payloads too
+        (staleness there IS the health signal). Torn/partial heartbeat
+        files are skipped exactly like ``.tmp.`` temps: an unreadable
+        payload must never take the reader down or invent a host."""
+        out: Dict[str, Dict[str, Any]] = {}
         for fn in sorted(os.listdir(self.store)):
             # atomic-write temps (hb_<host>.json.tmp.<pid>) share the hb_
             # prefix: counting one would duplicate a host (wrong world size,
@@ -91,11 +105,21 @@ class FileRendezvous:
             try:
                 with open(os.path.join(self.store, fn)) as f:
                     hb = json.load(f)
-                if now - float(hb["ts"]) <= self.dead_after:
-                    out.add(hb["host"])
-            except (OSError, ValueError, KeyError):  # torn/partial write
+                float(hb["ts"])                    # required fields only:
+                out[hb["host"]] = hb               # schema/meta optional
+            except (OSError, ValueError, KeyError, TypeError):  # torn write
                 continue
-        return sorted(out)
+        return out
+
+    def live_host_info(self) -> Dict[str, Dict[str, Any]]:
+        """{host: payload} for every host whose heartbeat is fresh (within
+        ``dead_after_s``), meta included when the host published one."""
+        now = self._clock()
+        return {h: p for h, p in self.read_heartbeats().items()
+                if now - float(p["ts"]) <= self.dead_after}
+
+    def live_hosts(self) -> List[str]:
+        return sorted(self.live_host_info())
 
     # -- generations ---------------------------------------------------
     def _gen_path(self, n: int) -> str:
@@ -134,16 +158,23 @@ class FileRendezvous:
             return bool(live)
         return sorted(cur["hosts"]) != live
 
-    def propose_generation(self) -> Optional[Dict[str, Any]]:
-        """Leader-only: publish the next generation over the live set.
-        Returns the manifest (followers get it via wait_generation)."""
-        if not self.is_leader():
-            return None
-        live = self.live_hosts()
+    def publish_generation(self, hosts: List[str],
+                           coordinator: Optional[str] = None
+                           ) -> Dict[str, Any]:
+        """Publish the next generation manifest over an explicit host list.
+        Registry use (the serving router's replica membership): the
+        publisher needn't be a live heartbeating member — leadership is the
+        CALLER's contract. ``propose_generation`` is the leader-elected
+        wrapper the elastic agent uses. The next generation number comes
+        from ``current_generation`` — whose torn-newest-manifest fallback
+        guarantees a publisher behind a torn write continues the history
+        instead of republishing generation 0 over it."""
+        hosts = sorted(hosts)
         cur = self.current_generation()
         n = (cur["generation"] + 1) if cur else 0
-        manifest = {"generation": n, "hosts": live,
-                    "coordinator": f"{live[0]}:{self.port}",
+        manifest = {"generation": n, "hosts": hosts,
+                    "coordinator": coordinator or (
+                        f"{hosts[0]}:{self.port}" if hosts else None),
                     "ts": self._clock()}
         tmp = self._gen_path(n) + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
@@ -151,9 +182,16 @@ class FileRendezvous:
         os.replace(tmp, self._gen_path(n))
         self._seen_gen = n
         logger.info(f"rendezvous: generation {n} published — "
-                    f"{len(live)} host(s), coordinator "
+                    f"{len(hosts)} host(s), coordinator "
                     f"{manifest['coordinator']}")
         return manifest
+
+    def propose_generation(self) -> Optional[Dict[str, Any]]:
+        """Leader-only: publish the next generation over the live set.
+        Returns the manifest (followers get it via wait_generation)."""
+        if not self.is_leader():
+            return None
+        return self.publish_generation(self.live_hosts())
 
     def wait_generation(self, min_generation: int = 0,
                         timeout_s: float = 60.0,

@@ -6,5 +6,8 @@ from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
 from deepspeed_tpu.inference.scheduler import (AdmissionRejected, Request,
                                                RequestScheduler)
 from deepspeed_tpu.inference.serving import (DecodeDispatchHang,
+                                             ResumeIncompatible,
                                              ServingConfig, ServingEngine,
-                                             init_serving)
+                                             init_serving, load_drain_state)
+from deepspeed_tpu.inference.router import (ReplicaHandle, ReplicaUnreachable,
+                                            RouterConfig, ServingRouter)

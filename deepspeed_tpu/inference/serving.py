@@ -67,6 +67,45 @@ class DecodeDispatchHang(RuntimeError):
     fetch) never came back within ``dispatch_timeout_s``."""
 
 
+class ResumeIncompatible(ValueError):
+    """A drained request (or a whole foreign drain) cannot be restored on
+    THIS engine: the local block-table width / ``max_model_len`` is smaller
+    than the work needs. Typed so the router's migration path can try the
+    next survivor instead of corrupting — past the table width the growth
+    clamp would silently overwrite the last block (the PR-10 context-cap
+    analysis), which is exactly the corruption this refusal prevents.
+    Subclasses ``ValueError`` for the PR-10 same-engine resume contract."""
+
+
+def load_drain_state(save_dir: str, tag: Optional[str] = None
+                     ) -> Dict[str, Any]:
+    """Read a serving drain snapshot through the integrity chain.
+    ``tag=None`` resolves the newest tag under ``save_dir`` that passes
+    integrity validation — a torn drain is skipped, not loaded; an explicit
+    tag is validated and refused loudly when torn. Returns the state dict
+    with ``"tag"`` added. Shared by ``ServingEngine.resume`` (whole-drain
+    restore) and the router's failover path (which splits the requests
+    across survivors via ``accept_migration``)."""
+    import json
+    import os
+    from deepspeed_tpu.robustness import integrity
+
+    if tag is None:
+        tag = integrity.newest_valid_tag(save_dir)
+        if tag is None:
+            raise FileNotFoundError(
+                f"no integrity-valid serving drain tag under {save_dir}")
+    tag_dir = os.path.join(save_dir, tag)
+    ok, reason = integrity.validate_tag(tag_dir)
+    if not ok:
+        raise ValueError(
+            f"serving drain tag '{tag}' failed integrity: {reason}")
+    with open(os.path.join(tag_dir, "state.json")) as f:
+        state = json.load(f)
+    state["tag"] = tag
+    return state
+
+
 def measure_paged_backends(mcfg, k_pool, v_pool, *, max_seqs: int, MB: int,
                            block_size: int, num_blocks: int, dtype,
                            iters: int = 10, mesh=None):
@@ -731,18 +770,26 @@ class ServingEngine:
         return list(self._cancelled)
 
     def drain(self, save_dir: Optional[str] = None,
-              tag: str = "serving_drain") -> Optional[str]:
+              tag: str = "serving_drain",
+              source: Optional[str] = None) -> Optional[str]:
         """Stop admission and checkpoint every unfinished request — block
         tables + host cursors + generated tokens — through the integrity
         chain (state payload, then manifest, then the COMMITTED marker
         LAST, so a torn drain reads as torn). Returns the tag dir (None
-        when no save_dir: admission stops, nothing persists).
+        when no save_dir: admission stops, nothing persists). ``source``
+        names the draining replica in the state (the router namespaces
+        each replica's drains by tag AND directory; the name also rides
+        every ``request_migrated`` event a failover emits).
 
         Only the host cursors (prompt + generated + budget) drive
         ``resume`` — the restarted engine rebuilds device state by
         re-prefilling. The block table / slot / cached_rows snapshot is
         recorded for post-mortems (which slot held what at the drain),
-        not restored: a fresh pool has no use for the old physical ids."""
+        not restored: a fresh pool has no use for the old physical ids.
+        The drained engine's geometry (``max_model_len``, block size,
+        table width) is recorded too, so a FOREIGN engine resuming this
+        state can refuse a smaller pool loudly (``ResumeIncompatible``)
+        instead of corrupting past its table width."""
         import json
         import os
         from deepspeed_tpu.robustness import integrity
@@ -759,8 +806,15 @@ class ServingEngine:
         os.makedirs(tag_dir, exist_ok=True)
         integrity.invalidate(tag_dir)      # rewriting in place: torn-able
         state = {
-            "version": 1,
+            "version": 2,
             "rng_counter": self._rng_counter,
+            "source": source,
+            "engine": {
+                "max_model_len": self.max_model_len,
+                "block_size": self.config.block_size,
+                "table_width": self.MB,
+                "max_seqs": self.config.max_seqs,
+            },
             "requests": [{
                 "rid": req.rid,
                 "prompt": np.asarray(req.prompt).tolist(),
@@ -785,32 +839,21 @@ class ServingEngine:
         self._drain_events()
         return tag_dir
 
-    def resume(self, save_dir: str, tag: Optional[str] = None) -> List[int]:
-        """Re-enqueue the requests a drained engine checkpointed: each
-        resumes by re-prefilling prompt + generated, so its continuation
-        is byte-identical to the uninterrupted run (the chaos soak pins
-        this). ``tag=None`` resolves the newest tag that passes integrity
-        validation — a torn drain is skipped, not loaded."""
-        import json
-        import os
-        from deepspeed_tpu.robustness import integrity
-
-        if tag is None:
-            tag = integrity.newest_valid_tag(save_dir)
-            if tag is None:
-                raise FileNotFoundError(
-                    f"no integrity-valid serving drain tag under {save_dir}")
-        tag_dir = os.path.join(save_dir, tag)
-        ok, reason = integrity.validate_tag(tag_dir)
-        if not ok:
-            raise ValueError(
-                f"serving drain tag '{tag}' failed integrity: {reason}")
-        with open(os.path.join(tag_dir, "state.json")) as f:
-            state = json.load(f)
-        self._rng_counter = max(self._rng_counter,
-                                int(state.get("rng_counter", 0)))
-        rids: List[int] = []
-        for rec in state["requests"]:
+    def accept_migration(self, recs: List[Dict[str, Any]],
+                         rng_counter: Optional[int] = None,
+                         source: Optional[str] = None) -> List[int]:
+        """Restore drained request records (the ``state.json`` schema) onto
+        THIS engine — the remote-drain handoff the router's failover uses
+        to re-place a dead replica's in-flight work onto survivors. Each
+        record re-validates against the LOCAL geometry before anything is
+        enqueued (all-or-nothing: a failover must never half-land a batch):
+        a request whose context + budget exceeds this engine's block-table
+        reach raises the typed ``ResumeIncompatible`` — the caller tries
+        the next survivor. Admission watermarks are bypassed
+        (``scheduler.restore``): this work was already admitted once;
+        shedding it on migration would drop accepted requests."""
+        reqs: List[Request] = []
+        for rec in recs:
             req = Request(rid=int(rec["rid"]),
                           prompt=np.asarray(rec["prompt"], np.int32),
                           max_new_tokens=int(rec["max_new_tokens"]),
@@ -819,22 +862,73 @@ class ServingEngine:
                           preemptions=int(rec.get("preemptions", 0)),
                           ttft_deadline_ms=rec.get("ttft_deadline_ms"),
                           deadline_ms=rec.get("deadline_ms"))
-            # the add_request context-cap validation, re-applied: resuming
-            # into an engine with a SMALLER max_model_len must refuse
-            # loudly — past the block-table width the clamp would overwrite
-            # the last block and silently corrupt the continuation
+            # the add_request context-cap validation, re-applied per
+            # record: restoring into an engine with a SMALLER
+            # max_model_len must refuse loudly — past the block-table
+            # width the growth clamp would overwrite the last block and
+            # silently corrupt the continuation
             if req.prompt.size + req.max_new_tokens > self.max_model_len:
-                raise ValueError(
-                    f"resumed request {req.rid}: prompt ({req.prompt.size})"
-                    f" + max_new_tokens ({req.max_new_tokens}) exceeds this"
-                    f" engine's max_model_len {self.max_model_len} — "
-                    "resume into an engine at least as large as the "
-                    "drained one")
+                src = f" (drained by {source})" if source else ""
+                raise ResumeIncompatible(
+                    f"migrated request {req.rid}{src}: prompt "
+                    f"({req.prompt.size}) + max_new_tokens "
+                    f"({req.max_new_tokens}) exceeds this engine's "
+                    f"max_model_len {self.max_model_len} "
+                    f"(block-table width {self.MB} x "
+                    f"{self.config.block_size}-token blocks) — place it "
+                    "on an engine at least as large as the drained one")
+            reqs.append(req)
+        if rng_counter is not None:
+            self._rng_counter = max(self._rng_counter, int(rng_counter))
+        rids: List[int] = []
+        for req in reqs:
             self.scheduler.restore(req)
             self._requests[req.rid] = req
             rids.append(req.rid)
         if self._stats_t0 is None and rids:
             self._stats_t0 = time.perf_counter()
+        return rids
+
+    def resume(self, save_dir: str, tag: Optional[str] = None) -> List[int]:
+        """Re-enqueue the requests a drained engine checkpointed: each
+        resumes by re-prefilling prompt + generated, so its continuation
+        is byte-identical to the uninterrupted run (the chaos soak pins
+        this). ``tag=None`` resolves the newest tag that passes integrity
+        validation — a torn drain is skipped, not loaded.
+
+        Cross-replica: a whole-drain resume from a FOREIGN engine's
+        snapshot re-validates the drained geometry against the local one
+        — a smaller block-table width or ``max_model_len`` refuses with
+        the typed ``ResumeIncompatible`` even if every individual request
+        would fit (an operator restoring a replica wholesale wants the
+        original envelope back, not a silent downgrade whose next long
+        request corrupts). The router's per-request migration path
+        (``accept_migration``) applies the per-request check instead."""
+        state = load_drain_state(save_dir, tag)
+        tag = state["tag"]
+        eng = state.get("engine")
+        if eng is not None:        # version-1 drains predate the geometry
+            # compare capacity in TOKENS (table_width x block_size == the
+            # drained max_model_len): raw widths are block-size-relative,
+            # so a larger-capacity engine with bigger blocks must not be
+            # falsely refused
+            drained_cap = int(eng.get("max_model_len")
+                              or (int(eng.get("table_width", 0))
+                                  * int(eng.get("block_size", 0))))
+            if drained_cap > self.max_model_len:
+                src = state.get("source")
+                raise ResumeIncompatible(
+                    "drain tag "
+                    f"'{tag}'{f' (replica {src})' if src else ''} came "
+                    f"from an engine with max_model_len {drained_cap} "
+                    f"(table width {eng.get('table_width')} x "
+                    f"{eng.get('block_size')}-token blocks); this engine "
+                    f"caps at max_model_len {self.max_model_len} (width "
+                    f"{self.MB}) — resume into an engine at least as "
+                    "large, or migrate per-request via accept_migration")
+        rids = self.accept_migration(state["requests"],
+                                     rng_counter=state.get("rng_counter"),
+                                     source=state.get("source"))
         rb_events.emit("serving_resumed", requests=len(rids), tag=tag)
         self._drain_events()
         return rids

@@ -55,6 +55,27 @@ later index by one attempt):
                     kernel failure): the engine degrades to the XLA gather
                     backend mid-serve and logs ``backend_degraded``
 
+Router seams (ISSUE 11 — the multi-replica ``ServingRouter`` consults
+``router_seam()`` once per routing round; ``at`` counts 0-based router
+rounds, independent of the per-engine ``serving_round`` counter):
+
+  replica_kill    — SIGTERM-equivalent on one replica: its engine drains
+                    through the PR-10 integrity chain, its heartbeats stop,
+                    and the router must detect the loss and resume the
+                    drained requests on survivors (in-flight migration)
+  heartbeat_loss  — the replica stays alive and reachable but its
+                    heartbeats are suppressed for ``times`` rounds: the
+                    router's breaker must OPEN (``replica_degraded``) and,
+                    with no drain snapshot and no death evidence, must NOT
+                    migrate (fencing: never double-serve live work) —
+                    recovery closes via the half-open probe
+  router_partition — the replica is alive but unreachable from the router
+                    for ``times`` rounds (dispatches raise); the first
+                    partitioned round also writes a TORN newest generation
+                    manifest into the rendezvous store, so the registry's
+                    generation reads during the partition exercise the
+                    ``FileRendezvous.current_generation`` fallback
+
 Schedules are deterministic by construction: explicit entries fire at exact
 step/op indices, and the optional ``seed`` only feeds probabilistic rates
 through a private ``numpy`` Generator — same seed, same faults, every run.
@@ -76,7 +97,10 @@ _ERRNO_BY_NAME = {"EIO": _errno.EIO, "ENOSPC": _errno.ENOSPC,
 
 KINDS = ("device_fault", "step_fault", "io_error", "torn_save",
          "corrupt_payload", "preempt", "clock_skew",
-         "decode_dispatch", "pool_exhaust", "backend_fault")
+         "decode_dispatch", "pool_exhaust", "backend_fault",
+         "replica_kill", "heartbeat_loss", "router_partition")
+
+ROUTER_KINDS = ("replica_kill", "heartbeat_loss", "router_partition")
 
 
 class DispatchFault(RuntimeError):
@@ -116,6 +140,11 @@ class FaultSchedule:
                       watchdog must time it out)
       keep            pool_exhaust: free blocks left visible during the
                       storm (default 0 = total exhaustion)
+      replica         router kinds only (required): 0-based registration
+                      index of the target replica; `at` counts router
+                      rounds, `times` holds a heartbeat_loss /
+                      router_partition condition for that many rounds
+                      (replica_kill fires once — death is permanent)
       rate            instead of step/at: per-opportunity probability drawn
                       from the schedule seed (still deterministic)
     """
@@ -140,9 +169,18 @@ class FaultSchedule:
                                  "(0-based serving round-seam invocation)")
             if kind in ("io_error", "torn_save", "corrupt_payload",
                         "decode_dispatch", "pool_exhaust", "backend_fault") \
+                    + ROUTER_KINDS \
                     and "at" not in e and "rate" not in e:
                 raise ValueError(f"faults.entries[{i}] ({kind}): needs 'at' "
                                  "(0-based op index) or 'rate'")
+            if kind in ROUTER_KINDS:
+                # the router applies these to a specific replica; an entry
+                # without one would silently always hit replica 0 — make
+                # the target explicit so chaos schedules read unambiguously
+                if "replica" not in e:
+                    raise ValueError(f"faults.entries[{i}] ({kind}): needs "
+                                     "'replica' (0-based registration "
+                                     "index)")
             err = e.get("errno", "EIO")
             e["errno"] = _ERRNO_BY_NAME.get(err, err) if isinstance(err, str) \
                 else int(err)
@@ -308,6 +346,53 @@ class FaultInjector:
                     f"injected decode_dispatch failure (op {idx}) "
                     "(robustness.faults)")
 
+    # -- router seams (ServingRouter routing rounds) ---------------------
+    def router_round(self, store_dir: Optional[str] = None
+                     ) -> List[Dict[str, Any]]:
+        """Router round-boundary seam, called once per routing round. Returns
+        this round's scheduled router fault actions
+        ``[{"kind", "replica"}, ...]`` — the router applies them to its
+        handles (kill / mute heartbeat / partition for THIS round; a held
+        condition fires every round of its ``times`` window so the handle
+        needs no countdown state). The first ``router_partition`` round also
+        tears the newest rendezvous generation manifest (see module
+        docstring)."""
+        idx = self._count("router_round")
+        actions: List[Dict[str, Any]] = []
+        for e in self.schedule.entries:
+            if e["kind"] not in ROUTER_KINDS \
+                    or not self._matches_index(e, idx):
+                continue
+            if e["kind"] == "replica_kill":
+                if e.get("_done"):
+                    continue
+                e["_done"] = True
+            if e["kind"] == "router_partition" and store_dir \
+                    and not e.get("_torn"):
+                e["_torn"] = True
+                self._tear_newest_manifest(store_dir)
+            act = {"kind": e["kind"], "replica": int(e["replica"])}
+            self._fire(e, "router_round", round=idx, **act)
+            actions.append(act)
+        return actions
+
+    @staticmethod
+    def _tear_newest_manifest(store_dir: str) -> None:
+        """Write a TRUNCATED ``gen_<N+1>.json`` (a torn manifest write that
+        never finished, NOT a ``.tmp.`` temp) so every generation read during
+        the partition must fall back to the newest READABLE manifest — the
+        exact ``FileRendezvous.current_generation`` walk-back PR 6 pinned.
+        The next real publish heals it by replacing the same filename."""
+        try:
+            gens = sorted(fn for fn in os.listdir(store_dir)
+                          if fn.startswith("gen_") and ".tmp." not in fn
+                          and fn.endswith(".json"))
+            n = (int(gens[-1][len("gen_"):-len(".json")]) + 1) if gens else 0
+            with open(os.path.join(store_dir, f"gen_{n:08d}.json"), "w") as f:
+                f.write('{"generation": ')          # torn mid-write
+        except (OSError, ValueError):
+            pass            # an unwritable store is its own fault, not ours
+
     # -- clock seam (rendezvous) ---------------------------------------
     def make_clock(self, base=None):
         """Wrap a clock with scheduled skew: after `after` reads, add
@@ -406,3 +491,12 @@ def dispatch_seam() -> None:
     """ServingEngine decode-dispatch hook (inside the watchdog guard)."""
     if _ACTIVE is not None:
         _ACTIVE.decode_dispatch()
+
+
+def router_seam(store_dir: Optional[str] = None) -> List[Dict[str, Any]]:
+    """ServingRouter round-boundary hook: a no-op (empty action list) unless
+    an injector is installed. ``store_dir`` is the rendezvous store a
+    ``router_partition`` tears its manifest into."""
+    if _ACTIVE is not None:
+        return _ACTIVE.router_round(store_dir)
+    return []

@@ -18,6 +18,9 @@
 #   SERVING_CHAOS_BUDGET=600 tests/run_slow.sh serving_chaos  # serving soak:
 #       3 interpret-Pallas engine builds + a 40-round faulted load +
 #       drain/resume (ISSUE 10)
+#   ROUTER_CHAOS_BUDGET=600 tests/run_slow.sh router_chaos  # router soak:
+#       2-replica load under replica kills / partitions / spill storms,
+#       bit-identical to the fault-free single-replica run (ISSUE 11)
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -67,6 +70,12 @@ for m in "${modules[@]}"; do
         # x 20 fp16 steps (fused attention backward + chunked TP overlap,
         # ZeRO 1/3) — interpret-mode Pallas makes the fused pair the cost
         *test_perf_levers*) budget="${PERF_LEVERS_BUDGET:-420}" ;;
+        # ISSUE-11 router chaos soak: a 2-replica mixed load under
+        # replica kills + heartbeat-loss partitions + saturation storms,
+        # compared bit-for-bit against a fault-free single-replica run —
+        # three engine builds + 30+ routing rounds (matched before the
+        # *test_serving* glob, like SERVING_CHAOS_BUDGET)
+        *test_router_chaos*) budget="${ROUTER_CHAOS_BUDGET:-600}" ;;
         # ISSUE-10 serving chaos soak: three engine builds on interpret-
         # mode Pallas + a 40-round faulted load + drain/resume — budgeted
         # separately from the quick serving module (matched FIRST: the

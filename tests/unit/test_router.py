@@ -1,0 +1,657 @@
+"""Multi-replica serving router (ISSUE 11): registry, spill admission,
+circuit breaker, heartbeat-loss failover with in-flight migration.
+
+Most tests drive the REAL ``ServingRouter`` over pure-host stub replicas
+(the lint's ``_StubReplica`` — no jax, no devices) with a simulated clock,
+so breaker/failover state machines are pinned deterministically and
+cheaply. One engine-backed test proves the end-to-end kill -> drain ->
+detect -> migrate path produces outputs bit-identical to a fault-free
+single-replica run (the full-size version is the slow router chaos soak).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.serving_lint import (_StubReplica, audit_router,
+                                                 main as lint_main,
+                                                 simulate_router)
+from deepspeed_tpu.inference.router import (BREAKER_CLOSED, BREAKER_DEAD,
+                                            BREAKER_OPEN, RouterConfig,
+                                            ServingRouter)
+from deepspeed_tpu.inference.scheduler import AdmissionRejected
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.faults import FaultInjector, FaultSchedule
+
+
+@pytest.fixture(autouse=True)
+def _clean_robustness_state():
+    rb_faults.clear()
+    rb_events.clear()
+    yield
+    rb_faults.clear()
+    rb_events.clear()
+
+
+def _router(tmp_path, clock, breaker=True, dead_after_s=2.5, **kw):
+    cfg = RouterConfig(store_dir=str(tmp_path / "store"),
+                       drain_dir=str(tmp_path / "drains"),
+                       dead_after_s=dead_after_s, breaker=breaker,
+                       breaker_faults=2, breaker_probe_after=2,
+                       clock=clock, **kw)
+    return ServingRouter(cfg)
+
+
+def _stubs(router, n=2, **kw):
+    c = router.config
+    reps = [_StubReplica(f"r{i}", c.store_dir, c.drain_dir, clock=c.clock,
+                         **kw) for i in range(n)]
+    for rep in reps:
+        router.register_handle(rep)
+    return reps
+
+
+class _BoundedStub(_StubReplica):
+    """Stub with a queue watermark: sheds typed like a real ServingEngine
+    at its admission watermarks."""
+
+    def __init__(self, *a, max_queue=2, **kw):
+        super().__init__(*a, **kw)
+        self.max_queue = max_queue
+
+    def try_admit(self, prompt, max_new_tokens, rid, **kw):
+        if len(self._q) >= self.max_queue:
+            raise AdmissionRejected("queue_full", queue_len=len(self._q),
+                                    max_queue=self.max_queue)
+        return super().try_admit(prompt, max_new_tokens, rid, **kw)
+
+
+PROMPT = np.arange(4, dtype=np.int32)
+
+
+class TestHeartbeatMeta:
+    """Satellite: schema-versioned heartbeat meta + torn-file skipping
+    (the registry substrate the router routes on)."""
+
+    def _rdzv(self, tmp_path, host, t):
+        from deepspeed_tpu.elasticity import FileRendezvous
+        return FileRendezvous(str(tmp_path), host, dead_after_s=10.0,
+                              clock=lambda: t[0])
+
+    def test_meta_roundtrip_schema_versioned(self, tmp_path):
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        b = self._rdzv(tmp_path, "host-b", t)
+        a.heartbeat(meta={"queue_depth": 3, "capacity": 8})
+        b.heartbeat()                       # new host, no meta: also fine
+        info = b.live_host_info()
+        assert info["host-a"]["schema"] == 1
+        assert info["host-a"]["meta"] == {"queue_depth": 3, "capacity": 8}
+        assert "meta" not in info["host-b"]
+        assert sorted(info) == a.live_hosts()
+
+    def test_old_schema_hosts_interop(self, tmp_path):
+        """A pre-meta host wrote neither schema nor meta — new readers
+        must still count it live; old readers only ever looked at
+        host/ts, which new payloads still carry."""
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        a.heartbeat(meta={"queue_depth": 1})
+        # an old host's payload, written byte-for-byte as PR-6 did
+        with open(tmp_path / "hb_host-old.json", "w") as f:
+            json.dump({"host": "host-old", "beats": 4, "ts": t[0]}, f)
+        info = a.live_host_info()
+        assert sorted(info) == ["host-a", "host-old"]
+        assert info["host-old"].get("meta") is None
+        assert a.live_hosts() == ["host-a", "host-old"]
+
+    def test_torn_heartbeat_skipped_like_tmp_files(self, tmp_path):
+        """A torn/unreadable heartbeat payload is skipped exactly like a
+        ``.tmp.`` temp — it neither invents a host nor kills the reader."""
+        t = [100.0]
+        a = self._rdzv(tmp_path, "host-a", t)
+        a.heartbeat(meta={"queue_depth": 0})
+        with open(tmp_path / "hb_host-torn.json", "w") as f:
+            f.write('{"host": "host-torn", "beats": 2, "ts"')   # torn
+        with open(tmp_path / "hb_host-c.json.tmp.999", "w") as f:
+            json.dump({"host": "host-c", "beats": 1, "ts": t[0]}, f)
+        assert a.live_hosts() == ["host-a"]
+        assert sorted(a.read_heartbeats()) == ["host-a"]
+
+
+class TestSpillAdmission:
+    def test_spills_to_sibling_instead_of_shedding(self, tmp_path):
+        """A watermark shed on the least-loaded choice lands on the next
+        sibling (typed + evented), never surfaces to the caller."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        c = router.config
+        reps = [_BoundedStub(f"r{i}", c.store_dir, c.drain_dir, max_queue=2,
+                             clock=c.clock) for i in range(2)]
+        for rep in reps:
+            router.register_handle(rep)
+        for _ in range(4):               # r0 fills (2), then spills (2)
+            router.add_request(PROMPT, 8)
+        assert reps[0].inflight() == 2 and reps[1].inflight() == 2
+        st = router.stats()
+        assert st["spilled"] == 2.0 and st["shed"] == 0.0
+        assert rb_events.history("request_spilled")
+        assert st["spill_rate"] == 0.5
+
+    def test_all_saturated_is_a_typed_shed(self, tmp_path):
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        c = router.config
+        for i in range(2):
+            router.register_handle(
+                _BoundedStub(f"r{i}", c.store_dir, c.drain_dir, max_queue=1,
+                             clock=c.clock))
+        router.add_request(PROMPT, 8)
+        router.add_request(PROMPT, 8)
+        with pytest.raises(AdmissionRejected) as ei:
+            router.add_request(PROMPT, 8)
+        assert ei.value.reason == "all_replicas_saturated"
+        assert ei.value.detail["healthy"] == 2
+        st = router.stats()
+        assert st["shed"] == 1.0
+        assert any(e.get("reason") == "all_replicas_saturated"
+                   for e in rb_events.history("request_shed"))
+
+    def test_least_loaded_wins(self, tmp_path):
+        """Admission ranks by registry meta (queue+running over capacity):
+        a loaded replica loses to an idle one even when registered first.
+        The registry cache refreshes once per routing round (replicas
+        publish at round boundaries), so the load shows up after a step."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, r1 = _stubs(router, 2, service_rate=0)
+        router.add_request(PROMPT, 8)            # tie -> r0 (registration)
+        router.step()                            # boundary: meta republished
+        t[0] += 1.0
+        router.add_request(PROMPT, 8)            # r1 now least loaded
+        assert r0.inflight() == 1 and r1.inflight() == 1
+
+
+class TestCircuitBreaker:
+    def test_heartbeat_loss_opens_then_half_open_probe_recovers(
+            self, tmp_path):
+        """A live-but-silent replica degrades (breaker OPEN, no new
+        admissions) and recovers through the half-open probe once its
+        heartbeats return — never a migration (fencing: no death
+        evidence)."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, r1 = _stubs(router, 2)
+        rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "heartbeat_loss", "at": 1, "replica": 0, "times": 4},
+        ], seed=0)))
+        opened_round = closed_round = None
+        for rnd in range(12):
+            router.step()
+            t[0] += 1.0
+            state = router.breaker_state("r0")
+            if opened_round is None and state == BREAKER_OPEN:
+                opened_round = rnd
+                # OPEN replica takes no new admissions
+                router.add_request(PROMPT, 8)
+                assert r0.inflight() == 0 and r1.inflight() == 1
+            if opened_round is not None and closed_round is None \
+                    and state == BREAKER_CLOSED:
+                closed_round = rnd
+        assert opened_round is not None, "breaker never opened"
+        assert closed_round is not None, "breaker never closed again"
+        assert [e["reason"] for e in
+                rb_events.history("replica_degraded")] == ["heartbeat_loss"]
+        assert rb_events.history("replica_recovered")
+        # fencing: alive + silent is a partition, not a death
+        assert not rb_events.history("request_migrated")
+        assert router.stats()["failovers"] == 0.0
+
+    def test_partition_opens_on_dispatch_faults_and_manifest_fallback(
+            self, tmp_path):
+        """A router_partition raises on dispatch (consecutive faults open
+        the breaker) and tears the newest generation manifest — the
+        registry's generation reads survive via the torn-newest fallback
+        and the post-heal publish continues the history (never gen 0)."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        _stubs(router, 2)
+        gen_before = router.generation()["generation"]
+        rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "router_partition", "at": 1, "replica": 0, "times": 3},
+        ], seed=0)))
+        reps = list(router.replicas.values())
+        for rnd in range(10):
+            router.step()
+            if rnd == 1:
+                # mid-partition: r0 is known-unreachable this round — an
+                # admission must NOT be routed into the partition on its
+                # frozen low-load meta (it lands on r1 instead)
+                router.add_request(PROMPT, 8)
+                assert reps[0].inflight() == 0
+                assert reps[1].inflight() >= 1
+            t[0] += 1.0
+        degraded = rb_events.history("replica_degraded")
+        assert [e["reason"] for e in degraded] == ["dispatch_faults"]
+        assert rb_events.history("replica_recovered")
+        assert router.breaker_state("r0") == BREAKER_CLOSED
+        # the torn gen_<N+1>.json exists on disk, yet generation reads
+        # fell back and the history is monotone past it
+        store = router.config.store_dir
+        torn = [fn for fn in os.listdir(store) if fn.startswith("gen_")
+                and not _readable_json(os.path.join(store, fn))]
+        assert torn, "the partition never tore a manifest"
+        cur = router.generation()
+        assert cur is not None and cur["generation"] >= gen_before
+        # a post-heal membership publish continues the chain
+        router._publish_generation()
+        assert router.generation()["generation"] > gen_before
+
+    def test_fault_schedule_validates_router_kinds(self):
+        with pytest.raises(ValueError, match="'at'"):
+            FaultSchedule([{"kind": "replica_kill", "replica": 1}])
+        with pytest.raises(ValueError, match="'replica'"):
+            FaultSchedule([{"kind": "heartbeat_loss", "at": 2}])
+        ok = FaultSchedule([{"kind": "router_partition", "at": 0,
+                             "replica": 0, "times": 2}])
+        assert ok.entries[0]["times"] == 2
+
+
+def _readable_json(path):
+    try:
+        with open(path) as f:
+            json.load(f)
+        return True
+    except ValueError:
+        return False
+
+
+class TestFailover:
+    def test_drained_kill_migrates_snapshot_to_survivor(self, tmp_path):
+        """Supervised kill: drain snapshot through the integrity chain,
+        heartbeat-loss detection, per-request migration onto the
+        survivor; nothing lost, membership generation re-published."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, r1 = _stubs(router, 2, service_rate=0)
+        for _ in range(3):
+            router.add_request(PROMPT, 8)       # all tie-break onto r0
+        r0.publish()
+        assert router.replica_inflight() == {"r0": 3, "r1": 0}
+        r0.die()                                # drain + silence
+        for _ in range(5):
+            router.step()
+            t[0] += 1.0
+        st = router.stats()
+        assert st["failovers"] == 1.0 and st["migrated"] == 3.0
+        assert st["lost_requests"] == 0.0 and st["resubmitted"] == 0.0
+        assert router.replica_inflight() == {"r0": 0, "r1": 3}
+        assert router.breaker_state("r0") == BREAKER_DEAD
+        migrated = rb_events.history("request_migrated")
+        assert len(migrated) == 3
+        assert all(e["src"] == "r0" and e["dst"] == "r1"
+                   and e["origin"] == "drain" for e in migrated)
+        assert rb_events.history("replica_failover")
+        # the dead replica left the membership manifest
+        assert router.generation()["hosts"] == ["r1"]
+        # and admissions never consider it again
+        router.add_request(PROMPT, 8)
+        assert router.replica_inflight()["r1"] == 4
+
+    def test_preexisting_snapshot_is_not_death_evidence(self, tmp_path):
+        """Fencing regression: a drain snapshot left over from a previous
+        incarnation (present BEFORE registration) must not convert a
+        transient heartbeat blip into a false failover — the live
+        replica's work would be double-served."""
+        from deepspeed_tpu.robustness import integrity
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        c = router.config
+        # a previous incident's committed drain, already on disk
+        old = os.path.join(c.drain_dir, "r0", "drain_r0")
+        os.makedirs(old)
+        integrity.atomic_write(os.path.join(old, "state.json"),
+                               json.dumps({"version": 2, "requests": [
+                                   {"rid": 999, "prompt": [1, 2],
+                                    "max_new_tokens": 4,
+                                    "generated": []}]}),
+                               what="stale drain")
+        integrity.write_manifest(old)
+        integrity.write_commit_marker(old)
+        router.register_handle(_StubReplica("r0", c.store_dir, c.drain_dir,
+                                            clock=c.clock))
+        router.register_handle(_StubReplica("r1", c.store_dir, c.drain_dir,
+                                            clock=c.clock))
+        router.add_request(PROMPT, 8)
+        # heartbeat blip on the LIVE replica: breaker opens, then heals —
+        # the stale snapshot must never trigger a failover
+        rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "heartbeat_loss", "at": 1, "replica": 0, "times": 4},
+        ], seed=0)))
+        for _ in range(12):
+            router.step()
+            t[0] += 1.0
+        st = router.stats()
+        assert st["failovers"] == 0.0 and st["migrated"] == 0.0, st
+        assert not rb_events.history("request_migrated")
+        assert router.breaker_state("r0") == BREAKER_CLOSED
+        assert st["completed"] == 1.0     # the live replica kept serving
+
+    def test_failover_consumes_the_snapshot(self, tmp_path):
+        """A migrated snapshot is invalidated (COMMITTED dropped, payload
+        kept for post-mortems): it can never be resumed or count as death
+        evidence twice."""
+        from deepspeed_tpu.robustness import integrity
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, r1 = _stubs(router, 2, service_rate=0)
+        router.add_request(PROMPT, 8)
+        r0.publish()
+        r0.die()
+        for _ in range(5):
+            router.step()
+            t[0] += 1.0
+        assert router.stats()["failovers"] == 1.0
+        tag_dir = os.path.join(r0.drain_dir, "drain_r0")
+        assert not integrity.is_committed(tag_dir)        # consumed
+        assert os.path.exists(os.path.join(tag_dir, "state.json"))
+
+    def test_lost_requests_survive_as_committed_residue(self, tmp_path):
+        """When no survivor can hold a drained request, the failover must
+        NOT destroy its only durable copy: the snapshot is rewritten to
+        hold exactly the lost records, still integrity-committed, so an
+        operator with a large-enough engine can resume them later — while
+        this router treats the residue as consumed evidence (no
+        re-failover loop)."""
+        from deepspeed_tpu.inference.serving import (ResumeIncompatible,
+                                                     load_drain_state)
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        c = router.config
+
+        class _SmallStub(_StubReplica):
+            def accept_migration(self, recs, rng_counter=None,
+                                 source=None):
+                if any(int(r["rid"]) == 1 for r in recs):
+                    raise ResumeIncompatible("request 1 exceeds this "
+                                             "engine's max_model_len")
+                return super().accept_migration(recs, rng_counter,
+                                                source)
+
+        r0 = _StubReplica("r0", c.store_dir, c.drain_dir, clock=c.clock,
+                          service_rate=0)
+        r1 = _SmallStub("r1", c.store_dir, c.drain_dir, clock=c.clock)
+        router.register_handle(r0)
+        router.register_handle(r1)
+        router.add_request(PROMPT, 8)          # rid 0: fits the survivor
+        router.add_request(PROMPT, 8)          # rid 1: too big for it
+        r0.publish()
+        r0.die()
+        for _ in range(8):
+            router.step()
+            t[0] += 1.0
+        st = router.stats()
+        assert st["failovers"] == 1.0          # exactly one episode
+        assert st["migrated"] == 1.0 and st["lost_requests"] == 1.0
+        residue = load_drain_state(os.path.join(c.drain_dir, "r0"))
+        assert residue.get("failover_residue") is True
+        assert [r["rid"] for r in residue["requests"]] == [1]
+        # the residue keeps the ORIGINAL drained geometry: a later
+        # whole-drain resume still hits the v2 envelope check
+        assert residue["engine"]["max_model_len"] == 4096
+
+    def test_corrupt_snapshot_falls_back_to_resubmit(self, tmp_path):
+        """A snapshot that passes the shallow evidence check but fails the
+        deep checksum (size-preserving bitrot — the corrupt_payload
+        class) must NOT wedge the failover: the router falls back to
+        resubmitting its own admission records, nothing is stranded, and
+        the bad tag becomes consumed evidence."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, r1 = _stubs(router, 2, service_rate=0)
+        for _ in range(2):
+            router.add_request(PROMPT, 8)
+        r0.publish()
+        r0.die()
+        # size-preserving corruption of the drained state
+        state_path = os.path.join(r0.drain_dir, "drain_r0", "state.json")
+        raw = bytearray(open(state_path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(state_path, "wb") as f:
+            f.write(bytes(raw))
+        for _ in range(6):
+            router.step()
+            t[0] += 1.0
+        st = router.stats()
+        assert st["failovers"] == 1.0, st
+        assert st["migrated"] == 2.0 and st["resubmitted"] == 2.0, st
+        assert st["lost_requests"] == 0.0
+        assert router.replica_inflight() == {"r0": 0, "r1": 2}
+        assert rb_events.history("drain_snapshot_invalid")
+        assert all(e["origin"] == "resubmit"
+                   for e in rb_events.history("request_migrated"))
+
+    def test_too_long_request_spills_to_larger_replica(self, tmp_path):
+        """Heterogeneous geometry: a request that exceeds the least-loaded
+        replica's context cap spills (typed) to a sibling that can hold
+        it; one no replica can EVER hold sheds permanently ("too_long"),
+        never crashes the caller or spins run() forever."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        c = router.config
+
+        class _CappedStub(_StubReplica):
+            def __init__(self, *a, max_model_len=64, **kw):
+                super().__init__(*a, **kw)
+                self.max_model_len = max_model_len
+
+            def try_admit(self, prompt, max_new_tokens, rid, **kw):
+                if len(prompt) + max_new_tokens > self.max_model_len:
+                    raise AdmissionRejected(
+                        "too_long", replica=self.name,
+                        max_model_len=self.max_model_len)
+                return super().try_admit(prompt, max_new_tokens, rid,
+                                         **kw)
+
+        small = _CappedStub("r0", c.store_dir, c.drain_dir, clock=c.clock,
+                            max_model_len=32)
+        big = _CappedStub("r1", c.store_dir, c.drain_dir, clock=c.clock,
+                          max_model_len=128)
+        router.register_handle(small)
+        router.register_handle(big)
+        rid = router.add_request(np.arange(20, dtype=np.int32), 30)
+        assert router._placement[rid] == "r1"     # spilled, not crashed
+        assert router.stats()["spilled"] == 1.0
+        with pytest.raises(AdmissionRejected) as ei:
+            router.add_request(np.arange(120, dtype=np.int32), 30)
+        assert ei.value.reason == "too_long"      # permanent, typed
+
+    def test_heartbeat_write_failure_does_not_drop_finished_work(
+            self, tmp_path):
+        """A transient store-write failure publishing the heartbeat must
+        not discard the round's completed requests — the missed beat just
+        ages the heartbeat (the health signal), the work surfaces."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, = _stubs(router, 1)
+        rid = router.add_request(PROMPT, 8)
+
+        def failing_heartbeat(meta=None):
+            raise OSError("injected EIO writing hb_r0.json")
+        r0.rdzv.heartbeat = failing_heartbeat
+        finished = []
+        for _ in range(4):
+            finished += router.step()
+            t[0] += 1.0
+        assert any(f.rid == rid for f in finished), \
+            "completed work was dropped with the failed heartbeat"
+        assert router.replica_inflight()["r0"] == 0
+
+    def test_engine_handle_types_the_context_cap_refusal(self, tmp_path):
+        """The engine-backed ReplicaHandle pre-checks the context cap and
+        raises the TYPED AdmissionRejected — ServingEngine.add_request
+        alone raises an untyped ValueError (a caller bug when talking to
+        one engine; a routing signal under a heterogeneous router)."""
+        import jax.numpy as jnp
+        import deepspeed_tpu
+        from deepspeed_tpu.inference.router import ReplicaHandle
+        from deepspeed_tpu.models import TransformerConfig, make_model
+        model = make_model(TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            num_kv_heads=2, max_seq_len=32, position_type="rotary",
+            activation="silu_glu", norm_type="rmsnorm",
+            tie_embeddings=False, dtype=jnp.float32,
+            attention_impl="xla"))
+        srv = deepspeed_tpu.init_serving(model, config={}, serving=dict(
+            max_seqs=1, block_size=16, max_model_len=32,
+            prompt_bucket=16, decode_backend="xla"), dtype=jnp.float32)
+        h = ReplicaHandle("rx", srv, str(tmp_path / "store"),
+                          str(tmp_path / "drains"))
+        with pytest.raises(AdmissionRejected) as ei:
+            h.try_admit(np.arange(30, dtype=np.int32), 30, rid=99)
+        assert ei.value.reason == "too_long"
+        assert ei.value.detail["max_model_len"] == 32
+        # and the engine-backed step() guards the heartbeat publish: a
+        # store-write failure must not drop the round's finished work
+        h.try_admit(np.arange(6, dtype=np.int32), 3, rid=0)
+
+        def failing_heartbeat(meta=None):
+            raise OSError("injected EIO")
+        h.rdzv.heartbeat = failing_heartbeat
+        finished = []
+        for _ in range(8):
+            finished += h.step()
+            if finished:
+                break
+        assert [r.rid for r in finished] == [0]
+
+    def test_silent_death_without_snapshot_resubmits_from_records(
+            self, tmp_path):
+        """Hard crash (no drain): once death is confirmed, the router
+        resubmits its own admission records from scratch — full
+        regeneration, zero lost requests."""
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0])
+        r0, r1 = _stubs(router, 2, service_rate=0)
+        for _ in range(2):
+            router.add_request(PROMPT, 8)
+        r0.silent = True                        # crash: no drain written
+        r0.dead = True                          # confirmed out-of-band
+        for _ in range(5):
+            router.step()
+            t[0] += 1.0
+        st = router.stats()
+        assert st["migrated"] == 2.0 and st["resubmitted"] == 2.0
+        assert st["lost_requests"] == 0.0
+        assert router.replica_inflight() == {"r0": 0, "r1": 2}
+        assert all(e["origin"] == "resubmit"
+                   for e in rb_events.history("request_migrated"))
+
+
+class TestRouterBlackholeCorpus:
+    def test_defect_fires_inflight_growth(self):
+        report = audit_router(breaker=False)
+        assert not report.ok
+        assert [f.rule for f in report.findings] == ["inflight-growth"]
+        sim = report.meta
+        post = sim["inflight_r0"][sim["kill_round"]:]
+        assert all(b >= a for a, b in zip(post, post[1:]))
+        assert sim["survivor_completed"] == 0   # every request blackholed
+
+    def test_breaker_twin_fails_over_and_passes(self):
+        report = audit_router(breaker=True)
+        assert report.ok, [f.rule for f in report.findings]
+        assert report.meta["migrated"] > 0
+        assert report.meta["lost"] == 0
+        # the survivor served the migrated work AND the later arrivals
+        assert report.meta["survivor_completed"] > 0
+
+    def test_corpus_entry_registered(self):
+        from deepspeed_tpu.analysis.corpus import run_corpus
+        assert not run_corpus("router-blackhole").ok
+
+    def test_cli_both_directions(self, capsys):
+        assert lint_main(["--router"]) == 1
+        assert "inflight-growth" in capsys.readouterr().out
+        assert lint_main(["--router", "--breaker"]) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_simulation_is_deterministic(self):
+        a = simulate_router(breaker=False, rounds=16)
+        b = simulate_router(breaker=False, rounds=16)
+        assert a["inflight_r0"] == b["inflight_r0"]
+
+
+class TestEngineBackedFailover:
+    def test_kill_failover_bit_identical_to_single_replica(self, tmp_path):
+        """End-to-end on real ServingEngines: a replica_kill mid-load
+        drains through the integrity chain, the router detects the
+        heartbeat loss and migrates the snapshot onto the survivor, and
+        every output is bit-identical to a fault-free single-replica run
+        (the slow router chaos soak scales this to 30+ rounds with
+        partitions and spill storms)."""
+        import jax
+        import jax.numpy as jnp
+        import deepspeed_tpu
+        from deepspeed_tpu.models import TransformerConfig, make_model
+
+        model = make_model(TransformerConfig(
+            vocab_size=128, hidden_size=64, num_layers=1, num_heads=4,
+            num_kv_heads=2, max_seq_len=64, position_type="rotary",
+            activation="silu_glu", norm_type="rmsnorm",
+            tie_embeddings=False, dtype=jnp.float32,
+            attention_impl="xla"))
+        params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+
+        def serving(**kw):
+            d = dict(max_seqs=2, block_size=16, max_model_len=64,
+                     decode_quantum=2, prompt_bucket=16,
+                     decode_backend="xla", max_queue=4)
+            d.update(kw)
+            return deepspeed_tpu.init_serving(
+                model, config={}, serving=d, dtype=jnp.float32,
+                params=params)
+
+        rng = np.random.default_rng(3)
+        reqs = [(rng.integers(0, 128, size=(int(n),)).astype(np.int32),
+                 int(k))
+                for n, k in zip(rng.integers(4, 16, 6),
+                                rng.integers(4, 8, 6))]
+        base = serving(max_seqs=4, max_queue=None).run(list(reqs))
+
+        t = [0.0]
+        router = _router(tmp_path, clock=lambda: t[0], dead_after_s=2.0)
+        router.register("r0", serving())
+        router.register("r1", serving())
+        # replica 0 holds the work (admission ties break toward it), so
+        # killing IT guarantees a non-empty drain snapshot to migrate
+        rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "replica_kill", "at": 2, "replica": 0},
+        ], seed=0)))
+        import collections
+        pending = collections.deque(reqs)
+        outs, rounds = {}, 0
+        while pending or not router.done:
+            while pending:
+                p, k = pending[0]
+                try:
+                    router.add_request(p, k)
+                except AdmissionRejected:
+                    break
+                pending.popleft()
+            for r in router.step():
+                outs[r.rid] = r.output
+            t[0] += 1.0
+            rounds += 1
+            assert rounds < 200, "router test did not converge"
+        st = router.stats()
+        assert st["lost_requests"] == 0.0
+        assert st["failovers"] == 1.0 and st["migrated"] >= 1.0
+        assert set(outs) == set(base)
+        for rid in base:
+            np.testing.assert_array_equal(
+                base[rid], outs[rid],
+                err_msg=f"request {rid} diverged across replicas")

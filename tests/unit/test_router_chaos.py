@@ -1,0 +1,228 @@
+"""Router chaos soak (ISSUE 11): a 2-replica mixed continuous-batching
+load under every router-level fault must complete every admitted request
+with outputs BIT-IDENTICAL to a fault-free SINGLE-replica run.
+
+The schedule exercises the three router fault kinds in one soak, plus a
+saturation spill storm driven through the serving-level ``pool_exhaust``
+seam:
+
+  * ``heartbeat_loss``   — replica r0 goes silent for 4 rounds while alive:
+    the breaker OPENs (``replica_degraded``), nothing migrates (fencing —
+    no death evidence), and the half-open probe closes it again
+    (``replica_recovered``) once heartbeats return;
+  * ``pool_exhaust`` storm + arrival burst — both replicas' pools squeeze
+    while arrivals keep coming: the first-choice replica's queue watermark
+    sheds and the router SPILLS to the sibling (``request_spilled``)
+    instead of surfacing ``AdmissionRejected``;
+  * ``router_partition`` — r0 alive but unreachable for 3 rounds:
+    consecutive dispatch faults OPEN the breaker, the injector tears the
+    newest rendezvous generation manifest (the registry's generation reads
+    survive via the ``current_generation`` torn-newest fallback), in-flight
+    work stalls and continues after the heal (``replica_recovered``);
+  * ``replica_kill``     — r1 SIGTERM-drains through the integrity chain
+    mid-decode; the router detects the heartbeat loss, resumes the drained
+    snapshot onto r0 (``request_migrated`` per request, cross-engine
+    re-prefill determinism), and ``serve_lost_requests == 0``.
+
+Slow tier: three engine builds + a 30+ round routed load. Runs under
+tests/run_slow.sh with its own budget (ROUTER_CHAOS_BUDGET).
+"""
+
+import collections
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.router import RouterConfig, ServingRouter
+from deepspeed_tpu.inference.scheduler import AdmissionRejected
+from deepspeed_tpu.models import TransformerConfig, make_model
+from deepspeed_tpu.robustness import events as rb_events
+from deepspeed_tpu.robustness import faults as rb_faults
+from deepspeed_tpu.robustness.faults import FaultInjector, FaultSchedule
+
+pytestmark = pytest.mark.slow
+
+N_REQUESTS = 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_robustness_state():
+    rb_faults.clear()
+    rb_events.clear()
+    yield
+    rb_faults.clear()
+    rb_events.clear()
+
+
+def _readable_json(path):
+    try:
+        with open(path) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _model():
+    return make_model(TransformerConfig(
+        vocab_size=128, hidden_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_seq_len=256, position_type="rotary",
+        activation="silu_glu", norm_type="rmsnorm", tie_embeddings=False,
+        dtype=jnp.float32, attention_impl="xla"))
+
+
+def _load():
+    rng = np.random.default_rng(11)
+    return [(rng.integers(0, 128, size=(int(n),)).astype(np.int32), int(k))
+            for n, k in zip(rng.integers(5, 40, N_REQUESTS),
+                            rng.integers(8, 15, N_REQUESTS))]
+
+
+def _serving(model, params, **kw):
+    d = dict(max_seqs=3, block_size=16, max_model_len=128,
+             decode_quantum=2, prompt_bucket=16, num_blocks=20,
+             decode_backend="xla", max_queue=4)
+    d.update(kw)
+    return deepspeed_tpu.init_serving(model, config={}, serving=d,
+                                      dtype=jnp.float32, params=params)
+
+
+# arrival plan (router round -> submissions): steady ramp, a burst INTO
+# the exhaustion storm (spill evidence), and a late tail so the kill at
+# round 22 finds in-flight work on both replicas
+FEED = {**{r: 2 for r in range(9)},          # rounds 0-8: 18
+        10: 3, 11: 3,                        # storm burst: 6
+        17: 2, 18: 2, 19: 2, 20: 2}          # late tail: 8
+
+
+class TestRouterChaosSoak:
+    def test_soak_bit_identical_to_single_replica(self, tmp_path):
+        model = _model()
+        params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+        reqs = _load()
+
+        # ---- fault-free SINGLE-replica baseline -----------------------
+        base = _serving(model, params, max_seqs=8, num_blocks=72,
+                        max_queue=None).run(list(reqs))
+        assert len(base) == N_REQUESTS
+
+        # ---- routed chaos run -----------------------------------------
+        rb_events.clear()
+        jsonl = str(tmp_path / "tel" / "router_events.jsonl")
+        t = [0.0]
+        router = ServingRouter(RouterConfig(
+            store_dir=str(tmp_path / "store"),
+            drain_dir=str(tmp_path / "drains"),
+            dead_after_s=2.5, breaker_faults=2, breaker_probe_after=2,
+            clock=lambda: t[0], telemetry_jsonl=jsonl))
+        # replicas carry NO jsonl sink: the router owns the one drain of
+        # the process-wide event queue
+        router.register("r0", _serving(model, params))
+        router.register("r1", _serving(model, params))
+        gen0 = router.generation()["generation"]
+        inj = rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "heartbeat_loss", "at": 4, "replica": 0, "times": 4},
+            # serving_round indices: 2 per router round while both live —
+            # 20..23 squeezes BOTH replicas' pools over rounds 10-11
+            {"kind": "pool_exhaust", "at": 20, "times": 4, "keep": 0},
+            {"kind": "router_partition", "at": 16, "replica": 0,
+             "times": 3},
+            {"kind": "replica_kill", "at": 22, "replica": 1},
+        ], seed=5)))
+
+        pending = collections.deque(reqs)
+        outs, rounds, retry_shed = {}, 0, 0
+        torn_mid = fallback_mid = None
+        while pending or not router.done:
+            feed = FEED.get(rounds, 2 if rounds > 20 else 0)
+            for _ in range(min(feed, len(pending))):
+                p, k = pending[0]
+                try:
+                    router.add_request(p, k)
+                except AdmissionRejected:
+                    retry_shed += 1
+                    break            # all saturated: retry next round
+                pending.popleft()
+            for r in router.step():
+                outs[r.rid] = r.output
+            if rounds == 17:
+                # mid-partition: the injector tore the NEWEST generation
+                # manifest; generation reads must fall back to the newest
+                # readable one, not return None (which would let a later
+                # publish erase the history with generation 0)
+                store = router.config.store_dir
+                gens = sorted(fn for fn in os.listdir(store)
+                              if fn.startswith("gen_")
+                              and ".tmp." not in fn)
+                torn_mid = not _readable_json(
+                    os.path.join(store, gens[-1]))
+                fallback_mid = router.generation()
+            t[0] += 1.0
+            rounds += 1
+            assert rounds < 2000, "soak did not converge"
+        rb_faults.clear()
+
+        # every scheduled fault actually fired
+        fired = {f["kind"] for f in inj.fired}
+        assert fired == {"heartbeat_loss", "pool_exhaust",
+                         "router_partition", "replica_kill"}, fired
+
+        # ---- the acceptance bar ---------------------------------------
+        st = router.stats()
+        assert rounds >= 30, rounds
+        assert st["lost_requests"] == 0.0, st
+        assert st["failovers"] == 1.0 and st["migrated"] >= 1.0, st
+        assert st["spilled"] >= 1.0, st          # the storm spilled
+        assert st["completed"] == float(N_REQUESTS), st
+
+        # every admitted request completed, BIT-IDENTICAL to the
+        # fault-free single-replica run
+        assert set(outs) == set(base)
+        for rid in base:
+            np.testing.assert_array_equal(
+                base[rid], outs[rid],
+                err_msg=f"request {rid} diverged under router chaos")
+
+        # breaker episodes: heartbeat loss AND partition each degraded
+        # and recovered; the kill degraded terminally
+        degraded = rb_events.history("replica_degraded")
+        assert {e["reason"] for e in degraded} >= {"heartbeat_loss",
+                                                   "dispatch_faults"}
+        recovered = rb_events.history("replica_recovered")
+        assert len(recovered) >= 2, recovered
+        migrated = rb_events.history("request_migrated")
+        assert migrated and all(e["src"] == "r1" and e["dst"] == "r0"
+                                for e in migrated)
+        # fencing: the heartbeat_loss episode migrated nothing (every
+        # migration came from the kill's drain snapshot)
+        assert all(e["origin"] == "drain" for e in migrated)
+
+        # the partition tore the NEWEST generation manifest mid-run; the
+        # registry's reads fell back to the previous readable one (never
+        # None), the failover's later publish healed the torn filename
+        # by replacing it, and the membership history stayed monotone
+        assert torn_mid is True, "the partition never tore a manifest"
+        assert fallback_mid is not None
+        assert fallback_mid["generation"] == gen0
+        cur = router.generation()
+        assert cur["generation"] > gen0          # failover re-published
+        assert cur["hosts"] == ["r0"]            # r1 left the membership
+
+        # ---- events visible in the telemetry JSONL --------------------
+        types = set()
+        for p in glob.glob(os.path.join(os.path.dirname(jsonl), "*")):
+            with open(p) as f:
+                for line in f:
+                    try:
+                        types.add(json.loads(line).get("type"))
+                    except ValueError:
+                        pass
+        assert {"fault_injected", "replica_degraded", "replica_recovered",
+                "request_migrated", "replica_failover", "request_spilled",
+                "serving_drained"} <= types, types

@@ -749,10 +749,88 @@ class TestDrainResume:
     def test_resume_refuses_smaller_engine(self, tmp_path):
         """Resuming into an engine with a smaller context cap must refuse
         loudly — past the block-table width the growth clamp would
-        silently corrupt the continuation."""
+        silently corrupt the continuation. Cross-replica (ISSUE 11): the
+        refusal is TYPED (ResumeIncompatible) and fires on the drained
+        engine's recorded geometry, so a whole-drain resume onto a
+        smaller pool refuses even before any individual request is
+        checked."""
+        from deepspeed_tpu.inference.serving import ResumeIncompatible
         srv = _serving()                          # max_model_len 128
         srv.add_request(np.arange(60, dtype=np.int32), 60)
         srv.drain(str(tmp_path))
         small = _serving(max_model_len=64)
-        with pytest.raises(ValueError, match="max_model_len"):
+        with pytest.raises(ResumeIncompatible, match="max_model_len"):
             small.resume(str(tmp_path))
+        # the typed error names the block-table geometry both sides
+        with pytest.raises(ValueError, match="table width"):
+            small.resume(str(tmp_path))
+
+    def test_cross_replica_resume_larger_engine_ok(self, tmp_path):
+        """The other direction: a foreign drain resumed onto a LARGER
+        engine continues byte-identically (re-prefill determinism across
+        engines — the router's failover bar)."""
+        import jax as _jax
+        model = make_model(_cfg())
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(9)
+        reqs = [(rng.integers(0, 128, size=(n,)).astype(np.int32), k)
+                for n, k in ((6, 10), (18, 8))]
+        small_kw = dict(max_model_len=64, max_seqs=2)
+        base = _serving(model=model, params=_jax.device_get(params),
+                        **small_kw).run(list(reqs))
+
+        srv = _serving(model=model, params=_jax.device_get(params),
+                       **small_kw)
+        for p, k in reqs:
+            srv.add_request(p, k)
+        srv.step()                        # partial progress
+        srv.drain(str(tmp_path), source="r-small")
+        big = _serving(model=model, params=_jax.device_get(params),
+                       max_model_len=128, max_seqs=4)
+        rids = big.resume(str(tmp_path))
+        assert rids
+        outs = {}
+        while not big.scheduler.done:
+            for r in big.step():
+                outs[r.rid] = r.output
+        for r in srv._finished:
+            outs.setdefault(r.rid, r.output)
+        assert set(outs) == set(base)
+        for i in base:
+            np.testing.assert_array_equal(base[i], outs[i],
+                                          err_msg=f"request {i}")
+
+    def test_cross_block_size_resume_compares_tokens_not_widths(
+            self, tmp_path):
+        """Geometry check is in TOKENS: a strictly larger engine with
+        BIGGER blocks (hence a numerically smaller table width) must not
+        be falsely refused."""
+        srv = _serving()                    # 128 tokens / 16-token blocks
+        srv.add_request(np.arange(20, dtype=np.int32), 8)
+        srv.drain(str(tmp_path))
+        # 256-token cap via 64-token blocks: table width 4 < 8, capacity 2x
+        big = _serving(max_model_len=256, block_size=64, prompt_bucket=64)
+        assert big.resume(str(tmp_path))    # restores, no refusal
+
+    def test_accept_migration_per_request_check(self, tmp_path):
+        """The router's per-request migration path: records that FIT a
+        smaller survivor restore fine; the one that can't raises the
+        typed ResumeIncompatible (the router then tries the next
+        survivor), and the refusal is all-or-nothing for its batch."""
+        from deepspeed_tpu.inference.serving import (ResumeIncompatible,
+                                                     load_drain_state)
+        srv = _serving()                          # max_model_len 128
+        srv.add_request(np.arange(8, dtype=np.int32), 8)      # fits 64
+        srv.add_request(np.arange(50, dtype=np.int32), 40)    # needs 90
+        srv.drain(str(tmp_path), source="r-big")
+        state = load_drain_state(str(tmp_path))
+        assert state["source"] == "r-big"
+        assert state["engine"]["max_model_len"] == 128
+        small = _serving(max_model_len=64)
+        fits = [r for r in state["requests"] if r["rid"] == 0]
+        too_big = [r for r in state["requests"] if r["rid"] == 1]
+        assert small.accept_migration(fits, source="r-big") == [0]
+        with pytest.raises(ResumeIncompatible, match="max_model_len"):
+            small.accept_migration(too_big, source="r-big")
+        # all-or-nothing: the failed batch enqueued nothing
+        assert small.scheduler.num_waiting == 1
