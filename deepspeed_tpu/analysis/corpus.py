@@ -535,6 +535,20 @@ def router_blackhole(devices=None):
     return audit_router(breaker=False)
 
 
+def prefix_refcount_leak(devices=None):
+    """Prefix-sharing audit: a copy-on-write fork path that never
+    decrements shared-block refcounts under a churned shared-prefix load.
+    The LRU cache keeps evicting stale entries, but evicted blocks hold
+    stuck references and never rejoin the free list — the held-block
+    count grows monotonically until the pool exhausts. ``pool-growth``
+    must fire. The correctly-decrementing twin (same churn, fork drops
+    its pin and finish frees every mapped block) stays bounded at the
+    cache cap and passes — tests assert both directions; the twin is
+    also CLI-runnable (``serving_lint --prefix --correct``)."""
+    from deepspeed_tpu.analysis.serving_lint import audit_prefix
+    return audit_prefix(correct=False)
+
+
 def exposed_collective_trace(devices=None):
     """Perf doctor gate: a TRACED step (not a compiled program) whose
     all-reduce runs with nothing scheduled under it — 8 ms of measured
@@ -560,6 +574,7 @@ CORPUS = {
     "paged-cache-leak": paged_cache_leak,
     "serving-unbounded-queue": serving_unbounded_queue,
     "router-blackhole": router_blackhole,
+    "prefix-refcount-leak": prefix_refcount_leak,
     "exposed-collective-trace": exposed_collective_trace,
     "serialized-backward": serialized_backward,
 }

@@ -45,6 +45,25 @@ CLI-runnable::
     python -m deepspeed_tpu.analysis.serving_lint --router --breaker  # twin
 
 and the defect is seeded as the ``router-blackhole`` corpus entry.
+
+Third rule (ISSUE 12): the **prefix-refcount leak**. Copy-on-write prefix
+sharing lives and dies by its refcounts: every fork must decrement the
+shared block it replaced, and every finishing consumer must decrement the
+full blocks it mapped. A fork path that forgets either leaves stuck
+references — the LRU cache eventually evicts those blocks (dropping ITS
+reference), but they never reach refcount 0, never rejoin the free list,
+and the pool's held-block count grows monotonically under steady
+prefix-churning traffic until admission starves. ``audit_prefix`` replays
+that churn through the REAL ``BlockAllocator`` + ``PrefixCache`` (pure
+host) with the fork's decrements toggleable and fires a ``pool-growth``
+finding when the held count grew monotonically past the bound; the
+correctly-decrementing twin stays bounded at the cache cap and passes.
+Both directions are CLI-runnable::
+
+    python -m deepspeed_tpu.analysis.serving_lint --prefix            # defect
+    python -m deepspeed_tpu.analysis.serving_lint --prefix --correct  # twin
+
+and the defect is seeded as the ``prefix-refcount-leak`` corpus entry.
 """
 
 import argparse
@@ -335,6 +354,108 @@ def audit_router(breaker: bool = False, **sim_kwargs) -> Report:
     return report
 
 
+# a pool holding this many more blocks than the steady-state working set
+# (cache cap + one in-flight request) after a churned prefix load is a
+# refcount leak, not retention
+POOL_GROWTH_BOUND = 12
+
+
+def simulate_prefix(correct: bool, rounds: int = 16, num_blocks: int = 96,
+                    block_size: int = 16, cache_blocks: int = 4,
+                    prefix_blocks: int = 2) -> Dict[str, Any]:
+    """Deterministic prefix-churn replay through the REAL allocator +
+    prefix cache: every round a donor prefills a FRESH shared prefix and
+    publishes it, then a consumer matches it (full blocks + the partial
+    boundary), copy-on-write forks the boundary, decodes a little and
+    finishes. ``correct=False`` models the seeded defect — the CoW fork
+    path never decrements: neither the pin on the boundary block it
+    replaced nor, at finish, the shared full blocks it mapped. The LRU cap
+    keeps evicting stale entries either way; with the leak, evicted
+    blocks hold stuck references and never rejoin the free list. Returns
+    the per-round held-block trajectory."""
+    from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
+                                                  BlockPoolExhausted,
+                                                  blocks_for)
+    from deepspeed_tpu.inference.prefix_cache import PrefixCache
+
+    alloc = BlockAllocator(num_blocks)
+    cache = PrefixCache(alloc, block_size, max_blocks=cache_blocks)
+    bs = block_size
+    held = []
+    exhausted_at = None
+    for rnd in range(rounds):
+        # a fresh shared prefix each round (the churn that drives LRU
+        # eviction): full blocks + a half-filled boundary
+        prompt = np.arange(rnd * 1000, rnd * 1000 + prefix_blocks * bs
+                           + bs // 2, dtype=np.int32) % 30000
+        try:
+            donor = alloc.alloc(blocks_for(prompt.size, bs))
+        except BlockPoolExhausted:
+            exhausted_at = rnd
+            break
+        cache.insert_full(prompt, donor, prompt.size)
+        cache.donate_boundary(prompt, donor, prompt.size)
+        alloc.free(donor)
+        # consumer: same prefix + a unique tail, served through the cache
+        tail = np.arange(8, dtype=np.int32) + 40000 + rnd
+        ctx = np.concatenate([prompt, tail])
+        m = cache.match(ctx)
+        cache.acquire(m)                       # refs on full + boundary pin
+        try:
+            fresh = alloc.alloc(blocks_for(ctx.size + 4, bs)
+                                - len(m.blocks))
+        except BlockPoolExhausted:
+            exhausted_at = rnd
+            break
+        table = list(m.blocks) + fresh
+        if m.partial_block is not None:
+            # the fork: fresh[0] replaces the shared boundary block...
+            if correct:
+                alloc.free([m.partial_block])  # ...and drops the pin
+        if correct:
+            alloc.free(table)                  # finish: every ref dropped
+        else:
+            # the seeded defect: only the request's OWN fresh blocks are
+            # freed — the shared blocks' refcounts never decrement
+            alloc.free(fresh)
+        held.append(alloc.used_blocks)
+    return {"held_blocks": held, "rounds": rounds,
+            "exhausted_at": exhausted_at, "correct": correct,
+            "cache_blocks": cache_blocks, "num_blocks": num_blocks}
+
+
+def audit_prefix(correct: bool = False, **sim_kwargs) -> Report:
+    """Run the prefix-churn replay and gate it: monotone held-block
+    growth past ``POOL_GROWTH_BOUND`` (or outright pool exhaustion) =
+    the ``pool-growth`` defect (a CoW fork path leaking refcounts)."""
+    sim = simulate_prefix(correct=correct, **sim_kwargs)
+    held = sim["held_blocks"]
+    monotone = all(b >= a for a, b in zip(held, held[1:]))
+    report = Report(meta={"analyzer": "serving-prefix", **sim})
+    grew = held and monotone and held[-1] >= POOL_GROWTH_BOUND
+    if grew or sim["exhausted_at"] is not None:
+        report.extend([Finding(
+            rule="pool-growth",
+            message=("copy-on-write prefix sharing leaked block "
+                     f"references: held blocks grew monotonically to "
+                     f"{held[-1] if held else 'exhaustion'} over "
+                     f"{len(held)} churned rounds"
+                     + (f" (pool exhausted at round "
+                        f"{sim['exhausted_at']})"
+                        if sim["exhausted_at"] is not None else "")
+                     + " — every fork must decrement the shared block it "
+                     "replaced and every finishing request must "
+                     "decrement the prefix blocks it mapped "
+                     "(BlockAllocator.free), or evicted cache entries "
+                     "can never return their blocks to the free list"),
+            severity="error", program="serving_prefix",
+            ident="prefix-refcount-leak",
+            data={"final_held": held[-1] if held else None,
+                  "rounds": len(held),
+                  "exhausted_at": sim["exhausted_at"]})])
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis.serving_lint",
@@ -354,10 +475,20 @@ def main(argv=None) -> int:
     p.add_argument("--breaker", action="store_true",
                    help="router audit only: enable the circuit breaker "
                         "(the passing twin; omit = the seeded defect)")
+    p.add_argument("--prefix", action="store_true",
+                   help="run the CoW prefix-refcount audit instead "
+                        "(churned shared-prefix load; pool-growth gate)")
+    p.add_argument("--correct", action="store_true",
+                   help="prefix audit only: the correctly-decrementing "
+                        "fork path (the passing twin; omit = the seeded "
+                        "defect)")
     p.add_argument("--json", action="store_true",
                    help="print the full report as JSON")
     args = p.parse_args(argv)
-    if args.router:
+    if args.prefix:
+        report = audit_prefix(correct=args.correct,
+                              rounds=max(args.rounds, 16))
+    elif args.router:
         report = audit_router(breaker=args.breaker,
                               rounds=max(args.rounds, 16))
     else:
@@ -366,6 +497,17 @@ def main(argv=None) -> int:
                                  rounds=args.rounds)
     if args.json:
         print(json.dumps(report.to_dict(), indent=1, default=str))
+    elif args.prefix:
+        sim = report.meta
+        held = sim["held_blocks"]
+        print(f"serving_lint: held blocks {held[-1] if held else 0} after "
+              f"{len(held)} churned prefix rounds"
+              + (f", pool EXHAUSTED at round {sim['exhausted_at']}"
+                 if sim["exhausted_at"] is not None else ""))
+        for f in report.findings:
+            print(f"  {f.severity}: {f.rule}: {f.message}")
+        if report.ok:
+            print("serving_lint: OK (refcounts balanced, pool bounded)")
     elif args.router:
         sim = report.meta
         print(f"serving_lint: dead-replica inflight "

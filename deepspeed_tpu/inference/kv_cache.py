@@ -48,9 +48,20 @@ class InvalidBlock(ValueError):
 
 class BlockAllocator:
     """Free-list allocator over ``num_blocks`` pool blocks (block 0
-    reserved). O(1) alloc/free; double-free, trash-block-free and
-    out-of-range ids raise — an accounting bug here silently corrupts
+    reserved), with PER-BLOCK REFCOUNTS so the prefix cache can map one
+    physical block into many requests' tables (copy-on-write sharing,
+    ISSUE 12). ``alloc`` hands out blocks at refcount 1; ``share``
+    increments; ``free`` DECREMENTS and only returns a block to the free
+    list when its count reaches 0 — so a request releasing its table
+    never yanks a block other readers still map. O(1) alloc/free;
+    decrementing past 0 (the old double free), freeing the trash block
+    and out-of-range ids raise — an accounting bug here silently corrupts
     another request's cache.
+
+    A block with ``refcount(b) > 1`` has other readers: it must NEVER be
+    written in place. Writers fork first (allocate a fresh block, copy
+    the rows, swap the table entry, decrement the shared block) — the
+    scheduler/engine own that barrier; the allocator owns the counts.
 
     ``set_reserve(n)`` hides n free blocks from ``can_alloc``/``alloc``
     without touching ownership: the fault injector's ``pool_exhaust``
@@ -65,7 +76,7 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # LIFO: recently freed (cache-warm) blocks are reused first
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._held = [False] * num_blocks
+        self._ref = [0] * num_blocks
         self._reserve = 0
 
     @property
@@ -99,19 +110,44 @@ class BlockAllocator:
                 + ")")
         out = [self._free.pop() for _ in range(n)]
         for b in out:
-            self._held[b] = True
+            self._ref[b] = 1
         return out
 
+    def refcount(self, block: int) -> int:
+        """Readers mapping this block (0 = free). ``> 1`` means shared:
+        writing it in place would corrupt another reader — fork first."""
+        if not 0 <= block < self.num_blocks:
+            raise InvalidBlock(block, self.num_blocks)
+        return self._ref[block]
+
+    def share(self, blocks: List[int], owner: Optional[int] = None) -> None:
+        """Add one reference to each (already-held) block — the prefix
+        cache mapping a cached block into another request's table. Sharing
+        a free block is the same accounting bug as double-freeing one."""
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise InvalidBlock(b, self.num_blocks, owner=owner)
+            if b == 0:
+                raise ValueError("sharing the reserved trash block 0")
+            if self._ref[b] <= 0:
+                raise ValueError(f"sharing free block {b} (nothing holds "
+                                 "it — stale prefix-cache entry?)")
+            self._ref[b] += 1
+
     def free(self, blocks: List[int], owner: Optional[int] = None) -> None:
+        """Drop one reference per block; a block returns to the free list
+        only when its LAST reference drops (shared prefix blocks survive
+        any single request's eviction)."""
         for b in blocks:
             if not 0 <= b < self.num_blocks:
                 raise InvalidBlock(b, self.num_blocks, owner=owner)
             if b == 0:
                 raise ValueError("freeing the reserved trash block 0")
-            if not self._held[b]:
+            if self._ref[b] <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._held[b] = False
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:

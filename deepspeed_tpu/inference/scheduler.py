@@ -41,7 +41,7 @@ vLLM default): cheap at serving contexts and needs zero extra pool state.
 import collections
 import dataclasses
 import time
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -96,6 +96,24 @@ class Request:
     # fresher tenant is running)
     admission_seq: Optional[int] = None
     cancel_reason: Optional[str] = None
+    # --- latency tier (ISSUE 12) --------------------------------------
+    # prefill phase: False from admission until the LAST prefill chunk's
+    # sampled token commits (chunked prefill spreads the prompt across
+    # rounds under the token budget; a mid-prefill request never decodes)
+    prefill_done: bool = False
+    # rows served from the prefix cache at (this) admission — the hit-rate
+    # stat, and how far the first prefill chunk may skip
+    prefix_rows: int = 0
+    # copy-on-write fork, armed at admission when the match reached into a
+    # donor's partially-filled boundary block: cow_src is the SHARED block
+    # (cache-pinned until the fork copies it), cow_dst the fresh block at
+    # the same table index the copy lands in — the engine dispatches the
+    # device copy before the request's first write and drops the pin
+    # (forks are counted once, on the engine: stats()["cow_forks"])
+    cow_src: Optional[int] = None
+    cow_dst: Optional[int] = None
+    # wall time the request last received tokens at the host (ITL stats)
+    last_token_t: Optional[float] = None
 
     @property
     def context(self) -> np.ndarray:
@@ -139,12 +157,17 @@ class RequestScheduler:
                  prompt_blocks: Callable[[int], int],
                  max_blocks_per_seq: Optional[int] = None,
                  max_queue: Optional[int] = None,
-                 pool_watermark: Optional[float] = None):
+                 pool_watermark: Optional[float] = None,
+                 prefix_cache=None):
         self.allocator = allocator
         self.max_seqs = max_seqs
         self.block_size = block_size
         self.quantum = quantum
         self.prompt_blocks = prompt_blocks
+        # optional CoW prefix cache (inference/prefix_cache.PrefixCache):
+        # admissions map cached prefix blocks by reference, finishes
+        # publish their blocks, allocation pressure evicts LRU entries
+        self.prefix_cache = prefix_cache
         # block-table width: growth clamps here — a sequence at its context
         # cap whose budget ran out mid-quantum writes its (discarded)
         # overshoot rows into its own last block, never past the table
@@ -162,6 +185,19 @@ class RequestScheduler:
 
     # ---- request lifecycle -------------------------------------------
 
+    def _effective_used_fraction(self) -> float:
+        """Held-pool fraction for the admission watermark, EXCLUDING
+        blocks held only by the prefix cache: those are one LRU eviction
+        from free (``_can_alloc`` reclaims them before any queue or
+        preemption), so a warm cache must never shed arrivals as
+        pool_pressure — a cache hit is a latency win, a full cache never
+        an admission loss."""
+        used = self.allocator.used_blocks
+        if self.prefix_cache is not None:
+            used -= self.prefix_cache.reclaimable_blocks
+        usable = self.allocator.num_blocks - 1
+        return used / usable if usable else 1.0
+
     def submit(self, prompt, max_new_tokens: int,
                rid: Optional[int] = None,
                ttft_deadline_ms: Optional[float] = None,
@@ -170,12 +206,17 @@ class RequestScheduler:
             raise AdmissionRejected("queue_full",
                                     queue_len=len(self.waiting),
                                     max_queue=self.max_queue)
+        # fast path: the effective fraction only SUBTRACTS from the raw
+        # one, so below the raw watermark there is nothing to compute —
+        # the O(cache-entries) reclaimable scan runs only under apparent
+        # pressure, never on the ordinary admission hot path
         if self.pool_watermark is not None \
                 and self.allocator.used_fraction >= self.pool_watermark:
-            raise AdmissionRejected(
-                "pool_pressure",
-                pool_used=round(self.allocator.used_fraction, 3),
-                pool_watermark=self.pool_watermark)
+            eff = self._effective_used_fraction()
+            if eff >= self.pool_watermark:
+                raise AdmissionRejected(
+                    "pool_pressure", pool_used=round(eff, 3),
+                    pool_watermark=self.pool_watermark)
         req = Request(rid=self._next_rid if rid is None else rid,
                       prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=int(max_new_tokens),
@@ -197,16 +238,45 @@ class RequestScheduler:
         req.slot = None
         req.block_ids = []
         req.admission_seq = None
+        req.prefill_done = False
+        req.prefix_rows = 0
+        req.cow_src = req.cow_dst = None
+        req.last_token_t = None
         self._next_rid = max(self._next_rid, req.rid) + 1
         self.waiting.append(req)
 
+    def _release_cow(self, req: Request) -> None:
+        """Drop an un-forked request's pin on its shared boundary block
+        (the engine normally releases it when the fork copy dispatches;
+        this covers eviction/recovery between admission and the fork)."""
+        if req.cow_src is not None:
+            self.allocator.free([req.cow_src], owner=req.rid)
+            req.cow_src = req.cow_dst = None
+
+    def _publish(self, req: Request) -> None:
+        """Offer a leaving request's KV to the prefix cache: full blocks
+        indexed (immutable, shared by reference), the partial boundary
+        block donated (the owner will never append again — a future
+        consumer copy-on-write forks it). Rows past the real context
+        (quantum overshoot / rejected speculation) are never published."""
+        if self.prefix_cache is None or not req.block_ids:
+            return
+        ctx = req.context
+        valid = min(req.cached_rows, ctx.size)
+        self.prefix_cache.insert_full(ctx, req.block_ids, valid)
+        self.prefix_cache.donate_boundary(ctx, req.block_ids, valid)
+
     def finish(self, req: Request) -> None:
-        """Evict a completed sequence: slot and blocks return to the pool."""
+        """Evict a completed sequence: its prefix publishes to the cache,
+        then slot and blocks return to the pool (shared blocks decrement —
+        the cache's references keep them alive)."""
         assert req.state == "running", req.state
         req.state = "finished"
         req.finish_t = time.perf_counter()
         self.running.remove(req)
         self._free_slots.append(req.slot)
+        self._release_cow(req)
+        self._publish(req)
         if req.block_ids:
             self.allocator.free(req.block_ids, owner=req.rid)
         req.block_ids = []
@@ -220,6 +290,8 @@ class RequestScheduler:
         if req.state == "running":
             self.running.remove(req)
             self._free_slots.append(req.slot)
+            self._release_cow(req)
+            self._publish(req)
             if req.block_ids:
                 self.allocator.free(req.block_ids, owner=req.rid)
             req.block_ids = []
@@ -259,7 +331,10 @@ class RequestScheduler:
         req.state = "waiting"
         req.preemptions += 1
         req.cached_rows = 0                    # resumes by re-prefilling
+        req.prefill_done = False
+        req.prefix_rows = 0
         self._free_slots.append(req.slot)
+        self._release_cow(req)
         self.allocator.free(req.block_ids, owner=req.rid)
         req.block_ids = []
         req.slot = None
@@ -277,22 +352,44 @@ class RequestScheduler:
             n += 1
         return n
 
+    def _can_alloc(self, n: int) -> bool:
+        """can_alloc with cache pressure: when the free list is short, ask
+        the prefix cache to evict LRU entries first — cached prefixes are
+        best-effort free space, never a reason to queue or preempt."""
+        if self.allocator.can_alloc(n):
+            return True
+        if self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.allocator.free_blocks)
+        return self.allocator.can_alloc(n)
+
     def _grow(self, req: Request, target_len: int) -> bool:
         want = min(blocks_for(target_len, self.block_size),
                    self.max_blocks_per_seq)
         need = want - len(req.block_ids)
         if need <= 0:
             return True
-        if not self.allocator.can_alloc(need):
+        if not self._can_alloc(need):
             return False
         req.block_ids.extend(self.allocator.alloc(need))
         return True
 
-    def schedule(self) -> Dict[str, List[Request]]:
+    def schedule(self, token_budget: Optional[int] = None) -> Dict[str, Any]:
         """One step-boundary decision. Returns {"admitted": [...],
-        "preempted": [...]}; admitted requests have slot + prompt blocks
-        assigned (the engine must prefill them), running requests are
-        guaranteed block coverage for the next quantum."""
+        "preempted": [...], "prefill": [(req, start, n), ...]}; admitted
+        requests have slot + prompt blocks assigned (and any cached prefix
+        mapped — ``cached_rows`` starts at the shared rows), running
+        requests are guaranteed block coverage for the next quantum.
+
+        ``prefill`` spans are what the engine must compute this round.
+        With ``token_budget=None`` each request still prefilling gets its
+        whole remaining prompt in one span (the pre-budget behavior). With
+        a budget, spans are sliced so one round's prefill work — SHARED
+        with the decode quantum's ``quantum * n_decoding`` token
+        reservation — never exceeds the budget: a 4k-prompt admission
+        spreads across rounds instead of stalling every running request's
+        inter-token latency. Progress guarantee: when nothing is decoding,
+        the oldest prefilling request always gets at least one block-worth
+        of tokens, so a budget below the block size cannot wedge."""
         preempted: List[Request] = []
         # 1. growth for the already-running, oldest EFFECTIVE admission
         #    first (aging order, not list order — a resumed request
@@ -312,21 +409,63 @@ class RequestScheduler:
                         preempted.append(req)
                     break
                 preempted.append(victim)
-        # 2. FIFO admission while a slot AND blocks are free
+        # 2. FIFO admission while a slot AND blocks are free. With a
+        #    prefix cache, the prompt's cached full blocks are mapped by
+        #    REFERENCE (refcount++), a matched partial boundary block arms
+        #    the copy-on-write fork, and only the uncovered tail allocates
+        #    fresh blocks.
         admitted: List[Request] = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
-            ctx = len(req.context)
+            ctx_arr = req.context
+            ctx = len(ctx_arr)
             # the request holds its padded prompt bucket's blocks plus the
             # first quantum's growth, whichever covers more — position-
             # ordered (block_ids[i] covers rows [i*bs, (i+1)*bs))
             need = min(max(self.prompt_blocks(ctx),
                            blocks_for(ctx + self.quantum, self.block_size)),
                        self.max_blocks_per_seq)
-            if not self.allocator.can_alloc(need):
+            m = (self.prefix_cache.match(ctx_arr)
+                 if self.prefix_cache is not None else None)
+            if m is not None and len(m.blocks) > max(0, need - 1):
+                # never map more shared blocks than the table needs minus
+                # one fresh write target (match caps at ctx-1 rows, so
+                # this only trims pathological max_blocks_per_seq clamps)
+                m.blocks = m.blocks[:max(0, need - 1)]
+                m.rows = len(m.blocks) * self.block_size
+                m.partial_block, m.partial_rows = None, 0
+            shared = list(m.blocks) if m is not None else []
+            # take the match's references BEFORE any eviction/allocation:
+            # _can_alloc may LRU-evict the matched entries themselves, and
+            # without our refs their blocks would hit the free list and
+            # could be handed right back as this request's fresh write
+            # targets (silent KV aliasing). Pinned, eviction only drops
+            # the INDEX entries; the rows stay ours.
+            if m is not None:
+                self.prefix_cache.acquire(m, owner=req.rid)
+            if not self._can_alloc(need - len(shared)):
+                if m is not None:               # un-acquire: back to the
+                    if shared:                  # cache(-only) refs
+                        self.allocator.free(shared, owner=req.rid)
+                    if m.partial_block is not None:
+                        self.allocator.free([m.partial_block],
+                                            owner=req.rid)
                 break                           # graceful queuing, no OOM
             self.waiting.popleft()
-            req.block_ids = self.allocator.alloc(need)
+            fresh = self.allocator.alloc(need - len(shared))
+            if m is not None:
+                self.prefix_cache.record_lookup(m)   # per-ADMISSION stats
+                req.prefix_rows = m.total_rows
+                req.cached_rows = m.total_rows
+                if m.partial_block is not None:
+                    # the boundary block stays the DONOR's: the table gets
+                    # the fresh block at that index and the engine copies
+                    # src -> dst (the fork) before the request's first
+                    # write, then drops the src pin acquire() took
+                    req.cow_src = m.partial_block
+                    req.cow_dst = fresh[0]
+            req.block_ids = shared + fresh
+            req.prefill_done = False
             req.slot = self._free_slots.pop()
             req.state = "running"
             if req.admission_seq is None:      # aging: resumed requests
@@ -334,7 +473,41 @@ class RequestScheduler:
                 self._next_seq += 1
             self.running.append(req)
             admitted.append(req)
-        return {"admitted": admitted, "preempted": preempted}
+        return {"admitted": admitted, "preempted": preempted,
+                "prefill": self._prefill_spans(token_budget)}
+
+    def _prefill_spans(self, token_budget: Optional[int]
+                       ) -> List[Tuple[Request, int, int]]:
+        """Slice this round's prefill work. Every running request with
+        ``prefill_done=False`` needs rows ``[cached_rows, len(context))``
+        computed; the budget (minus the decode quantum's reservation) is
+        handed out oldest-effective-admission first in block-size
+        granules, so long prompts chunk across rounds."""
+        todo = [r for r in sorted(self.running, key=self._effective_seq)
+                if r.state == "running" and not r.prefill_done]
+        spans: List[Tuple[Request, int, int]] = []
+        if token_budget is None:
+            for req in todo:
+                rem = len(req.context) - req.cached_rows
+                if rem > 0:
+                    spans.append((req, req.cached_rows, rem))
+            return spans
+        n_decoding = sum(1 for r in self.running
+                         if r.state == "running" and r.prefill_done)
+        budget = max(0, token_budget - self.quantum * n_decoding)
+        for req in todo:
+            rem = len(req.context) - req.cached_rows
+            if rem <= 0:
+                continue
+            take = min(rem, (budget // self.block_size) * self.block_size)
+            if take <= 0:
+                if n_decoding == 0 and not spans:
+                    take = min(rem, self.block_size)   # progress guarantee
+                else:
+                    break
+            spans.append((req, req.cached_rows, take))
+            budget -= take
+        return spans
 
     # ---- introspection -----------------------------------------------
 

@@ -45,6 +45,18 @@ integrity chain (manifest + COMMITTED marker) and a restarted engine
 ``resume()``s them with byte-identical continuations. Every
 shed/deadline/degrade/recovery decision is a structured robustness event,
 drained into the telemetry JSONL at round boundaries.
+
+Latency frontier (ISSUE 12 — see README "Latency frontier"): a
+**copy-on-write prefix cache** (``enable_prefix_cache``) maps cached
+prompt blocks into new requests' tables by reference and forks the
+partially-filled boundary block on first write; **token-budget chunked
+prefill** (``prefill_token_budget``) slices long-prompt admissions
+across rounds so running requests' inter-token latency stays flat; and
+**speculative decoding** (``spec_tokens``) verifies K drafted tokens in
+one ``decode_span_paged`` pass with greedy output parity. All three are
+default-off and compose with the reliability tier: recoveries clear the
+cache with the pool they rebuild, drains serialize mid-chunk prefills,
+and resume/migration re-prefills THROUGH the cache.
 """
 
 import dataclasses
@@ -194,6 +206,29 @@ class ServingConfig:
     # robustness/telemetry events drain into this JSONL at round
     # boundaries (same record schema as the training engine's sink)
     telemetry_jsonl: Optional[str] = None
+    # --- latency frontier (ISSUE 12; all default off = PR-10 behavior) ---
+    # copy-on-write prefix cache: finished prefills publish their blocks
+    # under chained content hashes, admissions map matching prefix blocks
+    # by REFERENCE (BlockAllocator refcounts) and fork the partially-
+    # filled boundary block on first write. Cached blocks evict LRU under
+    # pool pressure — a hit is a latency win, a miss never an admission
+    # loss.
+    enable_prefix_cache: bool = False
+    prefix_cache_blocks: Optional[int] = None   # cache-held block cap
+    # chunked prefill: per-round token budget SHARED between prefill
+    # chunks and the decode quantum's `decode_quantum * n_decoding`
+    # reservation — long prompts slice across rounds instead of stalling
+    # running requests' inter-token latency. None = whole-prompt prefill
+    # at admission (the PR-9 behavior).
+    prefill_token_budget: Optional[int] = None
+    # speculative decoding: K proposed tokens verified per round in one
+    # decode_span_paged pass (0 = off). Greedy-only (temperature 0.0):
+    # the accept rule keeps output token-identical to K=0. Proposer
+    # defaults to self-drafting n-gram lookup; spec_proposer is the draft
+    # hook — any (context ids, k) -> <= k proposed ids callable.
+    spec_tokens: int = 0
+    spec_ngram: int = 3
+    spec_proposer: Optional[Any] = None
 
 
 class ServingEngine:
@@ -259,12 +294,48 @@ class ServingEngine:
         if c.pool_watermark is not None and not 0 < c.pool_watermark <= 1:
             raise ValueError(f"pool_watermark={c.pool_watermark}: a held-"
                              "pool fraction in (0, 1]")
+        # --- latency-frontier validation (ISSUE 12) --------------------
+        if c.spec_tokens < 0:
+            raise ValueError(f"spec_tokens={c.spec_tokens}: >= 0 "
+                             "(0 disables speculation)")
+        if c.prefill_token_budget is not None and c.prefill_token_budget < 1:
+            raise ValueError(
+                f"prefill_token_budget={c.prefill_token_budget}: a "
+                "positive per-round token budget (None disables chunking)")
+        latency_armed = (c.enable_prefix_cache or c.spec_tokens > 0
+                         or c.prefill_token_budget is not None)
+        if latency_armed and model.decode_span_paged is None:
+            raise ValueError(
+                "prefix cache / chunked prefill / speculative decoding "
+                "need the span protocol (models/transformer make_model "
+                "decode_span_paged) — this model doesn't provide it")
+        if c.spec_tokens > 0 and c.temperature:
+            raise ValueError(
+                f"spec_tokens={c.spec_tokens} with temperature="
+                f"{c.temperature}: speculation is greedy-only (the accept "
+                "rule's output-parity argument needs argmax sampling; the "
+                "stochastic accept/reject rule is future work)")
         self.allocator = BlockAllocator(num_blocks)
+        self._prefix_cache = None
+        if c.enable_prefix_cache:
+            from deepspeed_tpu.inference.prefix_cache import PrefixCache
+            self._prefix_cache = PrefixCache(
+                self.allocator, c.block_size,
+                max_blocks=c.prefix_cache_blocks)
+        # the scheduler's per-round row guarantee must cover a verify
+        # step's K+1 writes as well as the plain quantum's
+        self._sched_quantum = max(c.decode_quantum,
+                                  c.spec_tokens + 1 if c.spec_tokens else 1)
         self.scheduler = RequestScheduler(
-            self.allocator, c.max_seqs, c.block_size, c.decode_quantum,
+            self.allocator, c.max_seqs, c.block_size, self._sched_quantum,
             prompt_blocks=lambda n: self._pad_prompt(n) // c.block_size,
             max_blocks_per_seq=self.MB, max_queue=c.max_queue,
-            pool_watermark=c.pool_watermark)
+            pool_watermark=c.pool_watermark,
+            prefix_cache=self._prefix_cache)
+        self._proposer = None
+        if c.spec_tokens > 0:
+            from deepspeed_tpu.inference.spec_decode import make_proposer
+            self._proposer = make_proposer(c.spec_proposer, c.spec_ngram)
 
         # device state -------------------------------------------------
         axes = (model.paged_cache_axes()
@@ -291,9 +362,22 @@ class ServingEngine:
         self._finished: List[Request] = []
         self._cancelled: List[Request] = []
         self._prefill_fns: Dict[int, Any] = {}
+        self._chunk_fns: Dict[int, Any] = {}
         self._quantum_step = None
+        self._spec_step = None
+        # one tiny program copies a block in place for the CoW fork — its
+        # shape is the pool's, so it compiles once
+        self._copy_block_fn = jax.jit(
+            lambda pools, src, dst: jax.tree.map(
+                lambda a: a.at[:, dst].set(a[:, src]), pools),
+            donate_argnums=(0,))
         self._rng_counter = 0
         self._stats_t0: Optional[float] = None
+        # latency-frontier counters (reset_stats windows)
+        self._itl_ms: List[float] = []
+        self._lat = {"spec_steps": 0, "spec_proposed": 0,
+                     "spec_accepted": 0, "prefill_chunks": 0,
+                     "prefill_chunk_tokens": 0, "cow_forks": 0}
         # reliability bookkeeping ---------------------------------------
         self._counters = {"shed": 0, "deadline_misses": 0, "degraded": 0,
                           "recoveries": 0, "recovery_ms": 0.0}
@@ -442,6 +526,50 @@ class ServingEngine:
             self._quantum_step = jax.jit(step, donate_argnums=(1, 4))
         return self._quantum_step
 
+    def _get_spec_step(self):
+        """The speculation verify step: ONE decode_span_paged pass scores
+        the pending token plus the K proposals for every slot, the greedy
+        accept rule runs in-graph (no extra host sync), and the per-slot
+        cursor advances by exactly the accepted prefix + the model's own
+        correction token — rows written for rejected proposals stay in
+        place, masked by the rolled-back length until overwritten."""
+        if self._spec_step is None:
+            import jax
+            import jax.numpy as jnp
+            from deepspeed_tpu.inference.spec_decode import greedy_accept_len
+
+            def step(params, pools, tok_mat, tables, seq_lens, active, key):
+                logits, pools = self.model.decode_span_paged(
+                    params, tok_mat, pools, tables, seq_lens, active=active)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                acc = greedy_accept_len(nxt, tok_mat[:, 1:])      # [S]
+                pend = jnp.take_along_axis(nxt, acc[:, None],
+                                           axis=1)[:, 0]
+                pend = jnp.where(active, pend, tok_mat[:, 0])
+                new_lens = seq_lens + jnp.where(
+                    active, acc + 1, 0).astype(jnp.int32)
+                return pools, nxt, acc, pend, new_lens
+
+            self._spec_step = jax.jit(step, donate_argnums=(1,))
+        return self._spec_step
+
+    def _proposals_device(self):
+        """Host-side drafting: one proposal row per decoding slot (the
+        n-gram lookup or the draft hook), padded to K with zeros (pads
+        verify as ordinary wrong guesses). Returns a [S, K] device array;
+        the pending-token column is concatenated on device so the round
+        still has exactly one host sync."""
+        import jax.numpy as jnp
+        c = self.config
+        props = np.zeros((c.max_seqs, c.spec_tokens), np.int32)
+        for req in self.scheduler.running:
+            if not req.prefill_done:
+                continue
+            got = np.asarray(self._proposer(req.context, c.spec_tokens),
+                             np.int32).reshape(-1)[:c.spec_tokens]
+            props[req.slot, :got.size] = got
+        return jnp.asarray(props)
+
     def _next_key(self):
         import jax
         self._rng_counter += 1
@@ -508,7 +636,92 @@ class ServingEngine:
                                    jnp.int32(ctx.size), self._next_key())
         self._tokens = self._tokens.at[req.slot].set(first[0])
         req.cached_rows = ctx.size
+        req.prefill_done = True
         req._first_dev = first                 # fetched at round boundary
+        self._publish_prefill(req, ctx)
+
+    def _publish_prefill(self, req: Request, ctx) -> None:
+        """Index a prefill's FULL blocks in the prefix cache as soon as
+        they are dispatched — they are immutable from here on (appends
+        only write past them), so concurrent same-prefix tenants share
+        them while this request still runs. Device ordering is free: the
+        pool array threads through every dispatch, so a consumer's read
+        depends on this write. The partial boundary block waits for
+        ``finish`` (scheduler._publish) — its owner still appends."""
+        if self._prefix_cache is not None:
+            self._prefix_cache.insert_full(ctx, req.block_ids,
+                                           req.cached_rows)
+
+    def _dispatch_fork(self, req: Request):
+        """Copy-on-write fork (dispatch, no sync): the shared boundary
+        block a prefix-cache match reached into is copied to the fresh
+        block the scheduler put at the same table index, then the match's
+        pin on the shared block is dropped. Runs BEFORE any of the
+        request's own writes — full shared blocks stay referenced, the
+        partial one is never written in place."""
+        src, dst = req.cow_src, req.cow_dst
+        with self.engine.mesh:
+            self.pools = self._copy_block_fn(self.pools, np.int32(src),
+                                             np.int32(dst))
+        self.allocator.free([src], owner=req.rid)
+        req.cow_src = req.cow_dst = None
+        self._lat["cow_forks"] += 1    # the one fork counter (stats())
+
+    def _pad_chunk(self, n: int) -> int:
+        bs = self.config.block_size
+        return -(-n // bs) * bs
+
+    def _get_chunk_fn(self, C: int):
+        """One compile per chunk width C: a [1, C] span appended behind
+        ``start`` rows already in the slot's blocks (prefix-cache hit or
+        an earlier chunk), pad rows routed to the trash block, plus the
+        sampled token at the last REAL position (used only by the final
+        chunk — mid-prompt chunks discard it)."""
+        fn = self._chunk_fns.get(C)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def chunk(params, ids, pools, table, start, n, key):
+                logits, pools = self.model.decode_span_paged(
+                    params, ids, pools, table,
+                    jnp.reshape(start, (1,)), n_rows=jnp.reshape(n, (1,)))
+                last = jax.lax.dynamic_index_in_dim(logits[0], n - 1, 0,
+                                                    keepdims=False)
+                return self._sample(last[None], key), pools
+
+            fn = jax.jit(chunk, donate_argnums=(2,))
+            self._chunk_fns[C] = fn
+        return fn
+
+    def _dispatch_chunk(self, req: Request, start: int, n: int):
+        """Dispatch (no sync) one prefill chunk: rows ``[start, start+n)``
+        of the request's context computed against the rows already in its
+        blocks. The final chunk samples the request's first token and
+        flips it into the decoding set (same pending-token protocol as the
+        whole-prompt prefill)."""
+        import jax.numpy as jnp
+        ctx = req.context
+        final = start + n == ctx.size
+        C = self._pad_chunk(n)
+        buf = np.zeros((1, C), np.int32)
+        buf[0, :n] = ctx[start:start + n]
+        tab = np.zeros((1, self.MB), np.int32)
+        tab[0, :len(req.block_ids)] = req.block_ids
+        fn = self._get_chunk_fn(C)
+        with self.engine.mesh:
+            first, self.pools = fn(self.engine.params, jnp.asarray(buf),
+                                   self.pools, jnp.asarray(tab),
+                                   jnp.int32(start), jnp.int32(n),
+                                   self._next_key())
+        req.cached_rows = start + n
+        self._lat["prefill_chunks"] += 1
+        self._lat["prefill_chunk_tokens"] += n
+        self._publish_prefill(req, ctx)        # full blocks so far
+        if final:
+            self._tokens = self._tokens.at[req.slot].set(first[0])
+            req.prefill_done = True
+            req._first_dev = first             # fetched at round boundary
 
     def _tables_device(self):
         import jax.numpy as jnp
@@ -518,7 +731,9 @@ class ServingEngine:
         for req in self.scheduler.running:
             ids[req.slot, :len(req.block_ids)] = req.block_ids
             lens[req.slot] = req.cached_rows
-            act[req.slot] = True
+            # a mid-prefill request (chunked prompt still landing) holds
+            # its slot but must not decode yet
+            act[req.slot] = req.prefill_done
         return jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(act)
 
     def step(self) -> List[Request]:
@@ -575,14 +790,34 @@ class ServingEngine:
             self.allocator.set_reserve(
                 max(0, self.allocator.free_blocks - int(keep)))
         try:
-            decisions = self.scheduler.schedule()
+            decisions = self.scheduler.schedule(
+                token_budget=self.config.prefill_token_budget)
             for req in decisions["admitted"]:
-                self._dispatch_prefill(req)
+                if req.cow_src is not None:
+                    # the copy-on-write fork runs BEFORE any of the
+                    # request's own dispatches can write the boundary block
+                    self._dispatch_fork(req)
+            for req, start, n in decisions["prefill"]:
+                if start == 0 and n == len(req.context):
+                    # whole prompt in one go: the PR-9 program (and its
+                    # warm compiles) — chunking/prefix hits take the span
+                    self._dispatch_prefill(req)
+                else:
+                    self._dispatch_chunk(req, start, n)
             if not self.scheduler.running:
                 return []
 
             tables, seq_lens, active = self._tables_device()
-            step_fn = self._get_quantum_step()
+            spec = (self.config.spec_tokens > 0
+                    and any(r.prefill_done for r in self.scheduler.running))
+            decode = any(r.prefill_done for r in self.scheduler.running)
+            step_fn = self._get_spec_step() if spec \
+                else (self._get_quantum_step() if decode else None)
+            tok_mat = None
+            if spec:
+                props = self._proposals_device()
+                tok_mat = jnp.concatenate([self._tokens[:, None], props],
+                                          axis=1)
             # keys precomputed so the watchdogged closure touches NO engine
             # state: an abandoned (hung) round thread finishing late can
             # only drop its local result, never clobber recovered state
@@ -593,6 +828,7 @@ class ServingEngine:
                        if getattr(req, "_first_dev", None) is not None]
             pools, tokens = self.pools, self._tokens
             params, mesh = self.engine.params, self.engine.mesh
+            S = self.config.max_seqs
             epoch = self._epoch
 
             def quantum_and_fetch():
@@ -603,30 +839,58 @@ class ServingEngine:
                     return None     # abandoned by a recovery: bail before
                 p, t, lens = pools, tokens, seq_lens   # touching the device
                 outs = []
+                spec_dev = None
                 with mesh:
-                    for k in keys:
-                        if self._epoch != epoch:
-                            return None
-                        p, t, lens = step_fn(params, p, t, tables, lens,
-                                             active, k)
-                        outs.append(t)
-                # the ONE sync of the round: K x [S] sampled tokens AND
-                # every pending prefill token ride a single device_get
-                toks, firsts = jax.device_get(
-                    (jnp.stack(outs), [f for _, f in pending]))
-                return p, t, toks, firsts
+                    if spec:
+                        # ONE verify step per round: pending + K proposals
+                        # scored in a single span pass
+                        p, nxt, acc, t, lens = step_fn(
+                            params, p, tok_mat, tables, lens, active,
+                            keys[0])
+                        spec_dev = (nxt, acc)
+                    elif decode:
+                        for k in keys:
+                            if self._epoch != epoch:
+                                return None
+                            p, t, lens = step_fn(params, p, t, tables, lens,
+                                                 active, k)
+                            outs.append(t)
+                # the ONE sync of the round: the sampled tokens (quantum
+                # steps or the verify step's accept verdict) AND every
+                # pending prefill/chunk token ride a single device_get
+                toks, firsts, spec_host = jax.device_get(
+                    (jnp.stack(outs) if outs
+                     else jnp.zeros((0, S), jnp.int32),
+                     [f for _, f in pending], spec_dev))
+                return p, t, toks, firsts, spec_host
 
             out = self._with_watchdog(quantum_and_fetch,
                                       armed=self._quantum_warm)
             if out is None:         # only reachable through a stale epoch
                 raise DecodeDispatchHang("round abandoned by recovery")
-            p, t, toks, firsts = out
-            self._quantum_warm = True
+            p, t, toks, firsts, spec_host = out
+            if decode:
+                self._quantum_warm = True
             self.pools, self._tokens = p, t
         finally:
             if keep is not None:
                 self.allocator.set_reserve(0)
+        if spec_host is not None:
+            return self._commit_spec(spec_host, pending, firsts)
         return self._commit_round(np.asarray(toks), pending, firsts)
+
+    def _note_tokens(self, req: Request, m: int, now: float) -> None:
+        """Inter-token-latency bookkeeping: a commit burst of ``m`` tokens
+        arriving ``gap`` after the request's previous tokens records m
+        samples of gap/m (the per-token delivery latency a streaming
+        client averages over the burst). The first token is TTFT's, not
+        ITL's — it only starts the clock."""
+        if m <= 0:
+            return
+        if req.last_token_t is not None:
+            per_tok = (now - req.last_token_t) * 1e3 / m
+            self._itl_ms.extend([per_tok] * m)
+        req.last_token_t = now
 
     def _commit_round(self, toks, pending, firsts) -> List[Request]:
         first_tok = {req.rid: int(np.asarray(f)[0])
@@ -636,18 +900,70 @@ class ServingEngine:
         eos = self.config.eos_token_id
         for req in list(self.scheduler.running):
             slot = req.slot
+            got = 0
             if req.rid in first_tok:
                 # prefill's pending token: its KV row was written by the
                 # quantum's step 0, so it is part of the sequence now
                 self._append(req, first_tok[req.rid], eos)
                 req._first_dev = None
+                got += 1
                 if req.first_token_t is None:
                     req.first_token_t = now
+            if not req.prefill_done:
+                # chunked prompt still landing: the quantum skipped this
+                # slot (inactive), nothing to absorb
+                self._note_tokens(req, got, now)
+                continue
             for i in range(toks.shape[0]):
                 if self._done(req):
                     break
                 self._append(req, int(toks[i, slot]), eos)
+                got += 1
             req.cached_rows += toks.shape[0]
+            self._note_tokens(req, got, now)
+            if self._done(req):
+                self.scheduler.finish(req)
+                self._finished.append(req)
+                finished.append(req)
+        return finished
+
+    def _commit_spec(self, spec_host, pending, firsts) -> List[Request]:
+        """Commit a verify round: each decoding slot gains its accepted
+        proposal prefix plus the model's correction/bonus token (1..K+1
+        tokens — the emitted stream is the target model's own argmaxes,
+        so output is token-identical to the unspeculated run). The cursor
+        advanced by accepted+1 on device; rejected rows sit beyond it,
+        stale until overwritten — shared blocks untouched."""
+        nxt, acc = spec_host
+        first_tok = {req.rid: int(np.asarray(f)[0])
+                     for (req, _), f in zip(pending, firsts)}
+        now = time.perf_counter()
+        finished: List[Request] = []
+        eos = self.config.eos_token_id
+        K = self.config.spec_tokens
+        for req in list(self.scheduler.running):
+            slot = req.slot
+            got = 0
+            if req.rid in first_tok:
+                self._append(req, first_tok[req.rid], eos)
+                req._first_dev = None
+                got += 1
+                if req.first_token_t is None:
+                    req.first_token_t = now
+            if not req.prefill_done:
+                self._note_tokens(req, got, now)
+                continue
+            a = int(acc[slot])
+            for i in range(a + 1):
+                if self._done(req):
+                    break
+                self._append(req, int(nxt[slot, i]), eos)
+                got += 1
+            req.cached_rows += a + 1
+            self._lat["spec_steps"] += 1
+            self._lat["spec_proposed"] += K
+            self._lat["spec_accepted"] += a
+            self._note_tokens(req, got, now)
             if self._done(req):
                 self.scheduler.finish(req)
                 self._finished.append(req)
@@ -692,6 +1008,12 @@ class ServingEngine:
         n = self.scheduler.preempt_all()
         for req in self._requests.values():
             req._first_dev = None
+            if req.cow_src is not None:     # un-forked admission caught
+                self.scheduler._release_cow(req)   # mid-round by the fault
+        if self._prefix_cache is not None:
+            # cached rows die with the pool being rebuilt below; drop the
+            # cache's references so the fresh pool starts fully free
+            self._prefix_cache.clear()
         self._tokens = jnp.zeros((self.config.max_seqs,), jnp.int32)
         with self.engine.mesh:
             self.pools = self._init_pools_fn()
@@ -983,6 +1305,12 @@ class ServingEngine:
         self._stats_t0 = None
         self._counters = {"shed": 0, "deadline_misses": 0, "degraded": 0,
                           "recoveries": 0, "recovery_ms": 0.0}
+        self._itl_ms = []
+        self._lat = {"spec_steps": 0, "spec_proposed": 0,
+                     "spec_accepted": 0, "prefill_chunks": 0,
+                     "prefill_chunk_tokens": 0, "cow_forks": 0}
+        if self._prefix_cache is not None:
+            self._prefix_cache.reset_stats()
 
     def stats(self) -> Dict[str, float]:
         """TTFT p50/p99 (ms) + aggregate generated-token throughput across
@@ -991,7 +1319,15 @@ class ServingEngine:
         cancelled / degraded / recoveries / recovery_ms). TTFT is measured
         at the first round boundary where the request's first token reached
         the host (includes the quantum it landed in — the honest,
-        observable number)."""
+        observable number).
+
+        Latency-frontier additions (ISSUE 12): ``p50/p99_itl_ms``
+        (inter-token delivery latency, sampled per commit burst as
+        gap/tokens — the chunked-prefill win's metric), the speculation
+        counters (``spec_steps/proposed/accepted`` + ``spec_accept_rate``),
+        the chunking counters (``prefill_chunks/chunk_tokens``),
+        ``cow_forks``, and — with the cache armed — the ``prefix_*``
+        counters incl. ``prefix_hit_rate`` and ``prefix_held_blocks``."""
         done = [r for r in self._finished if r.first_token_t is not None]
         out: Dict[str, float] = {
             "completed": float(len(self._finished)),
@@ -1008,6 +1344,22 @@ class ServingEngine:
                                for r in done])
             out["p50_ttft_ms"] = float(np.percentile(ttft, 50))
             out["p99_ttft_ms"] = float(np.percentile(ttft, 99))
+        if self._itl_ms:
+            itl = np.asarray(self._itl_ms)
+            out["p50_itl_ms"] = float(np.percentile(itl, 50))
+            out["p99_itl_ms"] = float(np.percentile(itl, 99))
+        out.update({k: float(v) for k, v in self._lat.items()})
+        if self._lat["spec_proposed"]:
+            out["spec_accept_rate"] = float(round(
+                self._lat["spec_accepted"] / self._lat["spec_proposed"], 4))
+        if self._prefix_cache is not None:
+            cs = self._prefix_cache.stats
+            out.update({f"prefix_{k}": float(v) for k, v in cs.items()})
+            if cs["lookups"]:
+                out["prefix_hit_rate"] = float(round(
+                    cs["hits"] / cs["lookups"], 4))
+            out["prefix_held_blocks"] = float(
+                self._prefix_cache.held_blocks)
         if self._finished and self._stats_t0 is not None:
             total = sum(len(r.generated) for r in self._finished)
             span = max(r.finish_t for r in self._finished) - self._stats_t0

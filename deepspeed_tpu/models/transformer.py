@@ -750,10 +750,21 @@ def _paged_attention(q, pool_k, pool_v, tables, index, cfg: TransformerConfig,
     masking, which is what makes paged-vs-contiguous decode bit-for-bit
     comparable in tests. The backend is chosen by a measured micro-bench at
     serving-engine init, not a config flag.
+
+    Multi-token queries (q [S, T, Nq, D] with T > 1 — the speculation
+    verify / chunked-prefill span path, ``decode_span_paged``) route to
+    ``_paged_span_attention``: per-position ``_decode_attention`` with the
+    span itself as the kv suffix, so every position's math is the
+    single-token chain bit for bit (the Pallas kernel is single-token
+    only and is never selected for spans).
     """
     S = q.shape[0]
     NB, Nkv, bs, D = pool_k.shape
     MB = tables.shape[1]
+    if q.shape[1] > 1:
+        return _paged_span_attention(q, pool_k, pool_v, tables, index, cfg,
+                                     kv_row, kv_scale=kv_scale,
+                                     window=window)
     use_pallas = (backend == "pallas" and kv_scale is None
                   and window is None and q.dtype != jnp.float16
                   and (cfg is None or (cfg.position_type != "alibi"
@@ -774,6 +785,122 @@ def _paged_attention(q, pool_k, pool_v, tables, index, cfg: TransformerConfig,
                    .reshape(S, Nkv, MB * bs) for s in (ks, vs))
     return _decode_attention(q, view(pool_k), view(pool_v), index, cfg,
                              kv_row=kv_row, kv_scale=sc, window=window)
+
+
+def _paged_span_attention(q, pool_k, pool_v, tables, prior_lens,
+                          cfg: TransformerConfig, kv_row, kv_scale=None,
+                          window=None):
+    """T-token attention for a span appended at each slot's cursor.
+
+    q: [S, T, Nq, D]; kv_row: the span's fresh (k, v) [S, Nkv, T, D];
+    prior_lens: [S] rows already in the pool. Position ``prior + t``
+    attends the pool prefix [0, prior), the earlier span rows [0, t) and
+    itself. Serves both chunked prefill (T = chunk) and the speculation
+    verify step (T = K + 1).
+
+    BATCHED over the T positions (one pool einsum + one intra-span einsum
+    per layer, not T sequential passes — a chunk must cost like a prefill,
+    not like T decode steps, or chunking could never beat the monolithic
+    prefill it replaces): scores over the gathered pool view with the
+    per-slot prefix mask, scores over the span itself with the causal
+    ``u <= t`` mask, ONE softmax over their concatenation. Masked slots
+    contribute exact zeros, so each position's visible logits are exactly
+    the single-token chain's values — span-computed rows/logits match
+    stepping the same tokens one at a time to reduction-order rounding
+    (greedy argmax equality is what the K=0/K>0 and warm/cold parity
+    tests pin; bit-exactness of the float logits is NOT promised, the
+    softmax width differs). int8 pools: the pool read runs the same
+    quantized-MXU path as ``_decode_attention``; the span's own fresh
+    rows are read as floats where sequential steps would re-read them
+    quantized — same relaxation as the contiguous int8 cache's re-prefill
+    path, and the reason the int8 parity tests carry a weaker bar.
+    """
+    S, T = q.shape[0], q.shape[1]
+    NB, Nkv, bs, D = pool_k.shape
+    MB = tables.shape[1]
+    Nq = q.shape[2]
+    rep = Nq // Nkv
+    chunk_k, chunk_v = kv_row                    # [S, Nkv, T, D]
+    sm = (cfg.attn_scale if cfg is not None and cfg.attn_scale is not None
+          else 1.0 / math.sqrt(D))
+
+    def view(pool):
+        g = jnp.take(pool, tables, axis=0)       # [S, MB, Nkv, bs, D]
+        return g.transpose(0, 2, 1, 3, 4).reshape(S, Nkv, MB * bs, D)
+
+    vk, vv = view(pool_k), view(pool_v)
+    Tp = vk.shape[2]
+    qg = q.transpose(0, 2, 1, 3).reshape(S, Nkv, rep, T, D)
+    pos = prior_lens[:, None] + jnp.arange(T)[None, :]       # [S, T] abs
+    if kv_scale is not None:
+        # int8 pool, int8 math — the _decode_attention recipe batched
+        # over T: quantize each query row, contract on the int8 MXU, fold
+        # q/k scales into the scores
+        ks, vs = kv_scale
+        ksg = jnp.take(ks, tables, axis=0).transpose(0, 2, 1, 3) \
+            .reshape(S, Nkv, Tp)
+        vsg = jnp.take(vs, tables, axis=0).transpose(0, 2, 1, 3) \
+            .reshape(S, Nkv, Tp)
+        q32 = qg.astype(jnp.float32)
+        qs_ = jnp.maximum(jnp.max(jnp.abs(q32), axis=-1) / 127.0, 1e-8)
+        qi = jnp.clip(jnp.round(q32 / qs_[..., None]), -127, 127
+                      ).astype(jnp.int8)
+        sp = jnp.einsum("bgrtd,bgsd->bgrts", qi, vk,
+                        preferred_element_type=jnp.int32
+                        ).astype(jnp.float32)
+        sp = sp * qs_[..., None] * ksg[:, :, None, None, :]
+    else:
+        sp = jnp.einsum("bgrtd,bgsd->bgrts", qg, vk).astype(jnp.float32)
+    sp = sp * sm
+    if cfg is not None and cfg.position_type == "alibi":
+        rel = (jnp.arange(Tp)[None, None, :]
+               - pos[:, :, None]).astype(jnp.float32)      # [S, T, Tp]
+        slopes = alibi_slopes(Nq).reshape(Nkv, rep)
+        sp = sp + slopes[None, :, :, None, None] * rel[:, None, None]
+    keep = jnp.arange(Tp)[None, None, :] < prior_lens[:, None, None]
+    if window is not None:
+        w = jnp.asarray(window, jnp.int32)
+        keep = keep & ((w <= 0)
+                       | (pos[:, :, None] - jnp.arange(Tp)[None, None, :]
+                          < w))
+    sp = jnp.where(keep[:, None, None], sp, -1e30)
+
+    # intra-span scores: query t sees span rows u <= t (earlier rows +
+    # itself — the scan arrangement's suffix and self terms in one block)
+    sq = jnp.einsum("bgrtd,bgud->bgrtu", qg,
+                    chunk_k.astype(qg.dtype)).astype(jnp.float32) * sm
+    if cfg is not None and cfg.position_type == "alibi":
+        rel_c = (jnp.arange(T)[None, :] - jnp.arange(T)[:, None]
+                 ).astype(jnp.float32)                     # u - t
+        slopes = alibi_slopes(Nq).reshape(Nkv, rep)
+        sq = sq + slopes[None, :, :, None, None] * rel_c[None, None, None]
+    causal = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]   # [t, u]
+    if window is not None:
+        w = jnp.asarray(window, jnp.int32)
+        causal = causal & ((w <= 0)
+                           | (jnp.arange(T)[:, None]
+                              - jnp.arange(T)[None, :] < w))
+    sq = jnp.where(causal[None, None, None], sq, -1e30)
+
+    probs = jax.nn.softmax(jnp.concatenate([sp, sq], axis=-1), axis=-1)
+    pp, pc = probs[..., :Tp], probs[..., Tp:]
+    if kv_scale is not None:
+        # fold the per-position V scale into the probs, requantize, keep
+        # the contraction on the int8 MXU (the _decode_pv recipe)
+        pv = pp * vsg[:, :, None, None, :]
+        ps = jnp.maximum(jnp.max(pv, axis=-1) / 127.0, 1e-20)
+        pvi = jnp.clip(jnp.round(pv / ps[..., None]), 0, 127
+                       ).astype(jnp.int8)
+        acc = jnp.einsum("bgrts,bgsd->bgrtd", pvi, vv,
+                         preferred_element_type=jnp.int32
+                         ).astype(jnp.float32)
+        out = (acc * ps[..., None]).astype(q.dtype)
+    else:
+        out = jnp.einsum("bgrts,bgsd->bgrtd", pp.astype(q.dtype), vv)
+    out = out + jnp.einsum("bgrtu,bgud->bgrtd", pc.astype(q.dtype),
+                           chunk_v.astype(q.dtype))
+    # [S, Nkv, rep, T, D] -> [S, T, Nq, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(S, T, Nq, D)
 
 
 def _decode_pv(probs, cv, kv_scale, dtype):
@@ -1895,6 +2022,124 @@ def decode_step_paged(params: Params, tokens, cfg: TransformerConfig,
     return logits[:, 0, :], new_pools
 
 
+def decode_span_paged(params: Params, tokens, cfg: TransformerConfig,
+                      pools: Params, block_tables, seq_lens, active=None,
+                      n_rows=None, backend: str = "xla"
+                      ) -> Tuple[jnp.ndarray, Params]:
+    """T consecutive tokens per slot in ONE pass — the latency-frontier
+    program (ISSUE 12): the speculation verify step scores K+1 proposed
+    tokens with one weight read, and a prefill chunk appends a prompt
+    slice behind rows already in the pool (a prefix-cache hit or an
+    earlier chunk).
+
+    tokens: [S, T] int32 occupying positions ``seq_lens .. seq_lens+T-1``;
+    returns (logits [S, T, V], pools) with each written token's K/V row
+    scattered at its position. ``n_rows``: [S] rows actually WRITTEN per
+    slot (default T) — a bucketed chunk's pad tokens beyond ``n_rows``
+    compute garbage but land in the trash block, so padding can never
+    overwrite live rows or run off the block table. Inactive slots behave
+    as in ``decode_step_paged`` (lockstep compute, trash writes, host
+    discards). The caller owns cursor roll-back: rows past an accepted
+    speculation prefix stay in place, masked by ``seq_lens`` until
+    overwritten — shared (refcounted) blocks are never touched because
+    the scheduler's copy-on-write fork runs before any span dispatch.
+
+    With T == 1 this is arithmetically ``decode_step_paged``; the engine
+    still dispatches the single-token program for K=0 so "speculation
+    off" is the identical compiled artifact, not merely equal math.
+    """
+    S, T = tokens.shape
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    if active is None:
+        active = jnp.ones((S,), jnp.bool_)
+    if n_rows is None:
+        n_rows = jnp.full((S,), T, jnp.int32)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)            # [S, T, H]
+    positions = seq_lens[:, None] + jnp.arange(T)[None, :]       # [S, T]
+    if cfg.position_type == "learned":
+        x = x + params["pos_embed"][positions].astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"), cfg)
+    int8_kv = cfg.kv_cache_bits == 8
+    bs = pools["k"].shape[3]
+    MB = block_tables.shape[1]
+
+    def at_layer(tree, i):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tree)
+
+    wins = (jnp.asarray(cfg.attn_windows, jnp.int32)
+            if cfg.attn_windows else None)
+
+    def body(x_c, i):
+        layer_p = at_layer(params["layers"], i)
+        pk = lax.dynamic_index_in_dim(pools["k"], i, 0, keepdims=False)
+        pv = lax.dynamic_index_in_dim(pools["v"], i, 0, keepdims=False)
+        sc = ((lax.dynamic_index_in_dim(pools["k_scale"], i, 0,
+                                        keepdims=False),
+               lax.dynamic_index_in_dim(pools["v_scale"], i, 0,
+                                        keepdims=False))
+              if int8_kv else None)
+        c = (pk, pv, seq_lens, None, sc)
+        if cfg.offload_params:
+            layer_p = _fetch_layer(layer_p, cfg)
+        y, _, (k_row, v_row) = transformer_layer(
+            x_c, layer_p, cfg, positions=positions, deterministic=True,
+            cache=c, return_kv=False, paged=(block_tables, backend),
+            attn_window=None if wins is None else wins[i])
+        return y, (k_row, v_row)                 # rows: [S, nkv, T, hd]
+
+    x, (k_rows, v_rows) = lax.scan(body, x, jnp.arange(cfg.num_layers))
+    # one [S*T]-row scatter writes every (slot, position) pair's fresh row
+    # across all layers; pad/inactive rows route to the trash block 0
+    # (duplicate trash writes are unordered and never read). Positions at
+    # or past the table's row capacity ALSO go to trash: a verify step
+    # within K tokens of a request's context cap would otherwise wrap its
+    # clipped block index back INTO the slot's last block and clobber
+    # valid history (such tokens are never committed — the budget check
+    # finishes the request first — but their rows must not land).
+    write = active[:, None] & (jnp.arange(T)[None, :] < n_rows[:, None]) \
+        & (positions < MB * bs)
+    blk = jnp.take_along_axis(block_tables,
+                              jnp.clip(positions // bs, 0, MB - 1), axis=1)
+    blk = jnp.where(write, blk, 0).reshape(-1)
+    off = jnp.where(write, positions % bs, 0).reshape(-1)
+
+    def flat(a, dtype=None):                     # [L,S,nkv,T,hd]->[S*T,...]
+        a = jnp.transpose(a, (1, 3, 0, 2, 4))
+        if dtype is not None:
+            a = a.astype(dtype)
+        return a.reshape((S * T,) + a.shape[2:])
+
+    if int8_kv:
+        kq, ks_ = _quant_kv(k_rows)              # scales [L, S, nkv, T]
+        vq, vs_ = _quant_kv(v_rows)
+
+        def flat_s(s):                           # [L,S,nkv,T] -> [S*T,...]
+            return jnp.transpose(s, (1, 3, 0, 2)).reshape(S * T, -1,
+                                                          s.shape[2])
+
+        new_pools = {
+            "k": pools["k"].at[:, blk, :, off, :].set(flat(kq)),
+            "v": pools["v"].at[:, blk, :, off, :].set(flat(vq)),
+            "k_scale": pools["k_scale"].at[:, blk, :, off].set(flat_s(ks_)),
+            "v_scale": pools["v_scale"].at[:, blk, :, off].set(flat_s(vs_)),
+        }
+    else:
+        new_pools = {
+            "k": pools["k"].at[:, blk, :, off, :].set(
+                flat(k_rows, pools["k"].dtype)),
+            "v": pools["v"].at[:, blk, :, off, :].set(
+                flat(v_rows, pools["v"].dtype)),
+        }
+    if cfg.final_norm:
+        x = _norm(x, params["final_norm_scale"],
+                  params.get("final_norm_bias"), cfg)
+    return lm_head_logits(x, params), new_pools
+
+
 def prefill_paged(params: Params, input_ids, cfg: TransformerConfig,
                   pools: Params, block_ids, length: Optional[int] = None
                   ) -> Tuple[jnp.ndarray, Params]:
@@ -2042,6 +2287,14 @@ class ModelSpec:
                                                 Params]]] = None
     decode_step_paged: Optional[Callable[..., Tuple[jnp.ndarray,
                                                     Params]]] = None
+    # latency-frontier span protocol (ISSUE 12): decode_span_paged(params,
+    # tokens [S, T], pools, block_tables, seq_lens, active, n_rows,
+    # backend) -> (logits [S, T, V], pools) — one pass over T consecutive
+    # tokens per slot (speculation verify / chunked prefill). None ->
+    # ServingEngine refuses spec decoding, chunked prefill and prefix
+    # caching at config time.
+    decode_span_paged: Optional[Callable[..., Tuple[jnp.ndarray,
+                                                    Params]]] = None
     paged_cache_axes: Optional[Callable[[], Params]] = None
 
     def flops_per_token(self) -> float:
@@ -2086,6 +2339,10 @@ def make_model(cfg: TransformerConfig, name: str = "transformer") -> ModelSpec:
         decode_step_paged=lambda params, tokens, pools, block_tables,
             seq_lens, **kw:
             decode_step_paged(params, tokens, cfg, pools, block_tables,
+                              seq_lens, **kw),
+        decode_span_paged=lambda params, tokens, pools, block_tables,
+            seq_lens, **kw:
+            decode_span_paged(params, tokens, cfg, pools, block_tables,
                               seq_lens, **kw),
         paged_cache_axes=lambda: paged_cache_logical_axes(cfg),
     )

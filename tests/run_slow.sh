@@ -21,6 +21,9 @@
 #   ROUTER_CHAOS_BUDGET=600 tests/run_slow.sh router_chaos  # router soak:
 #       2-replica load under replica kills / partitions / spill storms,
 #       bit-identical to the fault-free single-replica run (ISSUE 11)
+#   LATENCY_BUDGET=420 tests/run_slow.sh prefix_cache spec_decode  # the
+#       latency-frontier parity runs: warm-vs-cold prefix cache and
+#       spec-on-vs-off over full serving loads, bf16 + int8 (ISSUE 12)
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -70,6 +73,11 @@ for m in "${modules[@]}"; do
         # x 20 fp16 steps (fused attention backward + chunked TP overlap,
         # ZeRO 1/3) — interpret-mode Pallas makes the fused pair the cost
         *test_perf_levers*) budget="${PERF_LEVERS_BUDGET:-420}" ;;
+        # ISSUE-12 latency frontier: engine-parity runs (warm-vs-cold
+        # prefix cache, spec K>0 vs off, int8 variants) — each builds 2+
+        # serving engines and decodes full loads, budgeted together
+        *test_prefix_cache*|*test_spec_decode*)
+            budget="${LATENCY_BUDGET:-420}" ;;
         # ISSUE-11 router chaos soak: a 2-replica mixed load under
         # replica kills + heartbeat-loss partitions + saturation storms,
         # compared bit-for-bit against a fault-free single-replica run —

@@ -1,0 +1,507 @@
+"""Copy-on-write prefix cache: refcounted blocks, chained-hash matching,
+fork-on-first-write, and the latency stats that land the win (ISSUE 12).
+
+Load-bearing contracts pinned here:
+
+  - ``BlockAllocator`` refcounts: ``share`` increments, ``free``
+    DECREMENTS and only releases at zero; the PR-9/10 guards survive
+    (double free, trash block, typed out-of-range ``InvalidBlock``);
+  - the cache maps full blocks by reference and partial boundary blocks
+    through a copy-on-write fork (``cow_src``/``cow_dst`` at admission,
+    copied before the consumer's first write);
+  - a warm (cache-hit) request produces EXACTLY the cold-prefill greedy
+    output — sharing is a latency lever, never a quality lever;
+  - eviction under pool pressure: a full cache never blocks admission;
+  - ``stats()`` now reports inter-token-latency percentiles
+    (p50/p99_itl_ms) and the prefix/fork counters, and ``reset_stats``
+    clears them;
+  - the ``prefix-refcount-leak`` corpus entry fires on the seeded defect
+    and passes on the correctly-decrementing twin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator, InvalidBlock,
+                                              blocks_for)
+from deepspeed_tpu.inference.prefix_cache import PrefixCache
+from deepspeed_tpu.inference.scheduler import RequestScheduler
+from deepspeed_tpu.models import TransformerConfig, make_model
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, max_seq_len=256, position_type="rotary",
+                activation="silu_glu", norm_type="rmsnorm",
+                tie_embeddings=False, dtype=jnp.float32,
+                attention_impl="xla")
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Allocator refcounts (pure host)
+# ---------------------------------------------------------------------------
+
+class TestRefcounts:
+    def test_share_then_free_decrements(self):
+        a = BlockAllocator(8)
+        got = a.alloc(2)
+        a.share(got)
+        assert all(a.refcount(b) == 2 for b in got)
+        a.free(got)                       # one reader drops
+        assert all(a.refcount(b) == 1 for b in got)
+        assert a.used_blocks == 2         # still held by the other reader
+        a.free(got)                       # last reader drops
+        assert a.used_blocks == 0
+        assert all(a.refcount(b) == 0 for b in got)
+
+    def test_guards_survive_refcounting(self):
+        a = BlockAllocator(8)
+        got = a.alloc(1)
+        a.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(got)
+        with pytest.raises(ValueError, match="trash"):
+            a.free([0])
+        with pytest.raises(ValueError, match="trash"):
+            a.share([0])
+        with pytest.raises(ValueError, match="sharing free block"):
+            a.share(got)                  # stale-entry accounting bug
+        with pytest.raises(InvalidBlock):
+            a.free([99], owner=7)
+        with pytest.raises(InvalidBlock):
+            a.share([-3])
+
+    def test_shared_block_not_reallocated(self):
+        a = BlockAllocator(4)
+        got = a.alloc(3)
+        a.share([got[0]])
+        a.free(got)                       # got[0] still referenced
+        assert a.free_blocks == 2
+        out = a.alloc(2)
+        assert got[0] not in out
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache (pure host)
+# ---------------------------------------------------------------------------
+
+class TestPrefixCache:
+    def test_full_block_chain_match_caps_at_len_minus_one(self):
+        a = BlockAllocator(32)
+        c = PrefixCache(a, block_size=4)
+        toks = np.arange(12, dtype=np.int32)
+        blocks = a.alloc(3)
+        c.insert_full(toks, blocks, rows=12)
+        # identical 12-token prompt: only 2 full blocks match (cap 11 rows
+        # leaves the last token to prefill — the first output token needs
+        # a forward pass)
+        m = c.match(toks)
+        assert m.blocks == blocks[:2] and m.rows == 8
+        assert m.partial_block is None
+        # a diverging second block breaks the chain after block 0
+        other = toks.copy()
+        other[5] = 99
+        m2 = c.match(other)
+        assert m2.blocks == blocks[:1] and m2.rows == 4
+
+    def test_partial_boundary_donation_and_match(self):
+        a = BlockAllocator(32)
+        c = PrefixCache(a, block_size=4)
+        toks = np.arange(10, dtype=np.int32)       # 2 full + 2 rows
+        blocks = a.alloc(3)
+        c.insert_full(toks, blocks, rows=10)
+        c.donate_boundary(toks, blocks, rows=10)
+        assert a.refcount(blocks[2]) == 2          # cache took its ref
+        # a prompt extending the donor's stream: both full blocks AND the
+        # donated rows of the boundary block match
+        ext = np.concatenate([toks, np.asarray([50, 51], np.int32)])
+        m = c.match(ext)
+        assert m.rows == 8 and m.partial_block == blocks[2]
+        assert m.partial_rows == 2 and m.total_rows == 10
+        # a prompt diverging INSIDE the boundary block trusts only the
+        # rows that compare equal
+        div = ext.copy()
+        div[9] = 77
+        m2 = c.match(div)
+        assert m2.partial_rows == 1
+
+    def test_eviction_cascades_and_unblocks_admission(self):
+        a = BlockAllocator(8)
+        c = PrefixCache(a, block_size=4)
+        toks = np.arange(12, dtype=np.int32)
+        blocks = a.alloc(3)
+        c.insert_full(toks, blocks, rows=12)
+        a.free(blocks)                             # only cache refs remain
+        assert a.free_blocks == 4
+        freed = c.evict(2)
+        assert freed >= 2 and a.free_blocks >= 6
+        # the child chain entries went with their parents: nothing matches
+        assert c.match(toks).rows == 0
+
+    def test_max_blocks_cap(self):
+        a = BlockAllocator(32)
+        c = PrefixCache(a, block_size=4, max_blocks=2)
+        t1 = np.arange(12, dtype=np.int32)
+        b1 = a.alloc(3)
+        c.insert_full(t1, b1, rows=12)
+        assert c.held_blocks <= 2
+        t2 = 50 + np.arange(12, dtype=np.int32)
+        b2 = a.alloc(3)
+        c.insert_full(t2, b2, rows=12)
+        assert c.held_blocks <= 2                  # LRU made room
+
+    def test_cap_under_running_consumers_drops_only_lru(self):
+        """Regression: the cap counts HELD references — when running
+        requests still map the cached blocks (nothing reclaimable),
+        making room for one insert must drop only the LRU entry, not
+        flush the whole index chasing reclaimed-block counts."""
+        a = BlockAllocator(32)
+        c = PrefixCache(a, block_size=4, max_blocks=2)
+        older = a.alloc(1)
+        c.insert_full(np.arange(4, dtype=np.int32), older, rows=4)
+        newer = a.alloc(1)
+        c.insert_full(50 + np.arange(4, dtype=np.int32), newer, rows=4)
+        assert c.held_blocks == 2
+        # both still mapped by their "running" owners: refcount 2 each,
+        # so eviction reclaims nothing to the free list
+        third = a.alloc(1)
+        c.insert_full(90 + np.arange(4, dtype=np.int32), third, rows=4)
+        assert c.held_blocks == 2
+        # the NEWER chain survived; only the LRU entry was dropped
+        assert c.match(np.asarray([50, 51, 52, 53, 99], np.int32)).rows == 4
+        assert c.match(np.asarray([0, 1, 2, 3, 99], np.int32)).rows == 0
+
+    def test_clear_releases_everything(self):
+        a = BlockAllocator(16)
+        c = PrefixCache(a, block_size=4)
+        toks = np.arange(12, dtype=np.int32)
+        blocks = a.alloc(3)
+        c.insert_full(toks, blocks, rows=12)
+        c.donate_boundary(np.arange(10, dtype=np.int32), blocks, rows=10)
+        a.free(blocks)
+        c.clear()
+        assert a.used_blocks == 0
+
+
+# ---------------------------------------------------------------------------
+# Scheduler admission: shared mapping + the CoW fork contract
+# ---------------------------------------------------------------------------
+
+class TestSchedulerSharing:
+    def _sched(self, num_blocks=32, bs=4, max_seqs=4):
+        alloc = BlockAllocator(num_blocks)
+        cache = PrefixCache(alloc, bs)
+        sched = RequestScheduler(
+            alloc, max_seqs, bs, quantum=4,
+            prompt_blocks=lambda n: blocks_for(max(n, bs), bs),
+            max_blocks_per_seq=8, prefix_cache=cache)
+        return alloc, cache, sched
+
+    def test_admission_maps_shared_blocks_and_arms_fork(self):
+        alloc, cache, sched = self._sched()
+        donor_toks = np.arange(10, dtype=np.int32)
+        donor = sched.submit(donor_toks, 4)
+        sched.schedule()
+        donor.cached_rows = 10
+        sched.finish(donor)                        # publishes full+boundary
+        consumer = sched.submit(
+            np.concatenate([donor_toks, [60, 61, 62]]).astype(np.int32), 4)
+        out = sched.schedule()
+        assert out["admitted"] == [consumer]
+        assert consumer.prefix_rows == 10          # 8 full + 2 boundary
+        assert consumer.cached_rows == 10
+        # full blocks are the DONOR's physical blocks, shared by reference
+        assert consumer.block_ids[:2] == donor.block_ids[:2] \
+            if donor.block_ids else True
+        shared = consumer.block_ids[:2]
+        assert all(alloc.refcount(b) >= 2 for b in shared)
+        # the boundary block is NOT in the table — a fresh fork target is,
+        # and the shared source is pinned until the engine copies it
+        assert consumer.cow_src is not None
+        assert consumer.cow_dst == consumer.block_ids[2]
+        assert consumer.cow_src != consumer.cow_dst
+        assert alloc.refcount(consumer.cow_src) >= 2
+
+    def test_finish_decrements_shared_not_releases(self):
+        alloc, cache, sched = self._sched()
+        donor_toks = np.arange(8, dtype=np.int32)  # exactly 2 full blocks
+        donor = sched.submit(donor_toks, 4)
+        sched.schedule()
+        donor.cached_rows = 8
+        sched.finish(donor)
+        held0 = alloc.used_blocks
+        consumer = sched.submit(
+            np.concatenate([donor_toks, [9, 10]]).astype(np.int32), 4)
+        sched.schedule()
+        consumer.cached_rows = 10
+        sched._release_cow(consumer)               # engine-side fork elided
+        sched.finish(consumer)
+        # consumer's refs dropped; the cache's survive, plus the
+        # consumer's own finish DONATED its 2-row boundary block — pool
+        # ends at the cached working set, nothing double-freed or leaked
+        assert alloc.used_blocks == held0 + 1
+        assert alloc.used_blocks == cache.held_blocks
+
+    def test_watermark_ignores_reclaimable_cache_blocks(self):
+        """Regression: blocks held ONLY by the cache are one eviction
+        from free — the pool_pressure watermark must not shed arrivals on
+        an effectively empty pool (a full cache is never an admission
+        loss)."""
+        from deepspeed_tpu.inference.scheduler import AdmissionRejected
+        alloc = BlockAllocator(17)
+        cache = PrefixCache(alloc, 4)
+        sched = RequestScheduler(
+            alloc, 4, 4, quantum=4,
+            prompt_blocks=lambda n: blocks_for(max(n, 4), 4),
+            max_blocks_per_seq=8, pool_watermark=0.9, prefix_cache=cache)
+        blocks = alloc.alloc(15)                   # 15/16 "used"...
+        cache.insert_full(np.arange(60, dtype=np.int32), blocks, rows=60)
+        alloc.free(blocks)                         # ...but all reclaimable
+        assert alloc.used_fraction > 0.9
+        req = sched.submit(np.arange(4, dtype=np.int32), 4)   # must NOT shed
+        assert sched.schedule()["admitted"] == [req]
+        # a genuinely-held pool still sheds
+        sched2 = RequestScheduler(
+            alloc, 4, 4, quantum=4,
+            prompt_blocks=lambda n: blocks_for(max(n, 4), 4),
+            pool_watermark=0.1, prefix_cache=cache)
+        alloc.alloc(2)                             # real (request) usage
+        with pytest.raises(AdmissionRejected, match="pool_pressure"):
+            sched2.submit(np.arange(4, dtype=np.int32), 4)
+
+    def test_blocked_admission_does_not_inflate_hit_stats(self):
+        """Regression: a head-of-queue request re-matches every round its
+        admission is blocked; hit stats must count per ADMISSION, not per
+        retry."""
+        alloc, cache, sched = self._sched(num_blocks=16, bs=4)
+        donor = sched.submit(np.arange(16, dtype=np.int32), 4)
+        sched.schedule()
+        donor.cached_rows = 16
+        sched.finish(donor)
+        # block the pool so the matching consumer cannot admit
+        hog = alloc.alloc(alloc.free_blocks)
+        sched.submit(np.concatenate([np.arange(16), [99, 98]])
+                     .astype(np.int32), 4)
+        for _ in range(5):
+            assert sched.schedule()["admitted"] == []
+        # only the donor's own (miss) admission is on the books — the 5
+        # blocked retries counted nothing
+        assert cache.stats["lookups"] == 1 and cache.stats["hits"] == 0
+        alloc.free(hog)
+        out = sched.schedule()
+        assert len(out["admitted"]) == 1
+        # exactly one more lookup for the one real admission (a MISS here:
+        # the blocked rounds' pressure-eviction correctly spent the cached
+        # chain trying to make room — index entries drop even while the
+        # match pins the blocks)
+        assert cache.stats["lookups"] == 2 and cache.stats["hits"] == 0
+
+    def test_cache_pressure_evicts_instead_of_queueing(self):
+        alloc, cache, sched = self._sched(num_blocks=8)
+        toks = np.arange(12, dtype=np.int32)
+        donor = sched.submit(toks, 4)
+        sched.schedule()
+        donor.cached_rows = 12
+        sched.finish(donor)                        # cache holds ~3 blocks
+        # an UNRELATED prompt needing more than the uncached remainder:
+        # admission must evict cache entries, not queue
+        req = sched.submit(200 + np.arange(16, dtype=np.int32), 4)
+        out = sched.schedule()
+        assert out["admitted"] == [req]
+
+    def test_matched_blocks_survive_admission_eviction(self):
+        """Regression: admission takes its references on the matched
+        blocks BEFORE pressure-eviction runs — otherwise evicting the
+        matched (LRU-tail) entries would free those blocks and the LIFO
+        allocator could hand them back as the SAME request's fresh write
+        targets (KV aliasing), or acquire() would trip the typed
+        'sharing free block' guard and fail the round."""
+        alloc, cache, sched = self._sched(num_blocks=11, bs=4)
+        d1 = sched.submit(np.arange(16, dtype=np.int32), 4)      # older
+        sched.schedule()
+        d1.cached_rows = 16
+        sched.finish(d1)                           # 4 blocks cached (LRU)
+        d2 = sched.submit(200 + np.arange(16, dtype=np.int32), 4)
+        sched.schedule()
+        d2.cached_rows = 16
+        sched.finish(d2)                           # 4 more (recent)
+        assert alloc.used_blocks == 8 and alloc.free_blocks == 2
+        # consumer matches d1's chain (4 shared), needs 3 fresh > 2 free:
+        # eviction MUST fire, and d1's chain is the LRU tail it reaches
+        consumer = sched.submit(
+            np.concatenate([np.arange(16), 100 + np.arange(8)])
+            .astype(np.int32), 4)
+        out = sched.schedule()
+        assert out["admitted"] == [consumer]
+        assert consumer.prefix_rows == 16          # the match survived
+        ids = consumer.block_ids
+        # no physical block appears twice in the table (the aliasing bug)
+        assert len(ids) == len(set(ids)), ids
+        assert all(alloc.refcount(b) >= 1 for b in ids)
+
+
+# ---------------------------------------------------------------------------
+# Engine end-to-end: warm == cold, stats, reset
+# ---------------------------------------------------------------------------
+
+def _serving(model, params, **serving):
+    defaults = dict(max_seqs=2, block_size=16, max_model_len=128,
+                    decode_quantum=4, prompt_bucket=16)
+    defaults.update(serving)
+    return deepspeed_tpu.init_serving(model, config={}, serving=defaults,
+                                      dtype=jnp.float32,
+                                      params=jax.device_get(params))
+
+
+def _shared_load(rng, n=6, prefix=50, tail=5):
+    shared = rng.integers(0, 128, size=(prefix,)).astype(np.int32)
+    return [(np.concatenate([shared, rng.integers(0, 128, size=(tail,))
+                             .astype(np.int32)]), 8) for _ in range(n)]
+
+
+def test_warm_equals_cold_and_forks_fire():
+    """The acceptance contract: an 80%-shared-prefix load served through
+    the CoW cache produces EXACTLY the cold-prefill outputs, with real
+    hits and real boundary forks on the books."""
+    model = make_model(_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    reqs = _shared_load(np.random.default_rng(3))
+    cold = _serving(model, params).run(list(reqs))
+    warm_srv = _serving(model, params, enable_prefix_cache=True)
+    warm = warm_srv.run(list(reqs))
+    for rid in cold:
+        np.testing.assert_array_equal(cold[rid], warm[rid],
+                                      err_msg=f"request {rid} diverged")
+    st = warm_srv.stats()
+    assert st["prefix_hits"] >= 3          # later tenants rode the cache
+    assert st["prefix_hit_rows"] >= 3 * 48
+    assert st["cow_forks"] >= 1            # boundary blocks were copied
+    assert st["prefix_hit_rate"] > 0
+    # every block is either free or held by the cache — no leaked refs
+    assert warm_srv.allocator.used_blocks == warm_srv._prefix_cache \
+        .held_blocks
+
+
+def test_full_blocks_shared_while_donor_still_running():
+    """Full prompt blocks publish at PREFILL time, not at finish: a
+    consumer admitted while the donor is still decoding maps them by
+    reference (the agent-fleet burst case — N tenants, one system
+    prompt, all in flight together)."""
+    model = make_model(_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, 128, size=(40,)).astype(np.int32)
+    srv = _serving(model, params, enable_prefix_cache=True)
+    donor = srv.add_request(shared, 60)        # long budget: stays running
+    srv.step()                                 # donor prefills + decodes
+    assert not srv.scheduler.done
+    consumer = srv.add_request(
+        np.concatenate([shared, rng.integers(0, 128, size=(4,))
+                        .astype(np.int32)]), 4)
+    while srv._requests[consumer].state not in ("finished", "cancelled"):
+        srv.step()
+    assert srv._requests[consumer].prefix_rows >= 32   # rode the donor
+    assert srv._requests[donor].state == "running"     # who never finished
+    while not srv.scheduler.done:
+        srv.step()
+
+
+def test_itl_stats_reported_and_reset():
+    """Satellite 1: stats() gains p50/p99_itl_ms; reset_stats() clears
+    the window (with the latency counters and the cache stats)."""
+    model = make_model(_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    srv = _serving(model, params, enable_prefix_cache=True)
+    rng = np.random.default_rng(0)
+    srv.run([(rng.integers(0, 128, size=(12,)).astype(np.int32), 10),
+             (rng.integers(0, 128, size=(20,)).astype(np.int32), 10)])
+    st = srv.stats()
+    assert st["p50_itl_ms"] > 0 and st["p99_itl_ms"] >= st["p50_itl_ms"]
+    assert "prefix_lookups" in st and "cow_forks" in st
+    srv.reset_stats()
+    st2 = srv.stats()
+    assert "p50_itl_ms" not in st2 and "p99_itl_ms" not in st2
+    assert st2["completed"] == 0 and st2["prefix_lookups"] == 0
+    assert st2["cow_forks"] == 0 and st2["prefill_chunks"] == 0
+
+
+def test_preempted_consumer_resumes_warm_and_exact():
+    """Preemption with shared tables in play: an oversubscribed pool
+    preempts mid-load, resumes re-prefill THROUGH the cache, and every
+    output still equals the cold run (the chaos-soak contract, quick)."""
+    model = make_model(_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    reqs = _shared_load(np.random.default_rng(5), n=5, prefix=40, tail=7)
+    cold = _serving(model, params).run(list(reqs))
+    warm_srv = _serving(model, params, enable_prefix_cache=True,
+                        num_blocks=12)       # below full residency
+    warm = warm_srv.run(list(reqs))
+    for rid in cold:
+        np.testing.assert_array_equal(cold[rid], warm[rid],
+                                      err_msg=f"request {rid} diverged")
+
+
+@pytest.mark.slow
+def test_warm_equals_cold_bf16():
+    model = make_model(_cfg(dtype=jnp.bfloat16))
+    params = model.init(jax.random.PRNGKey(0))
+    reqs = _shared_load(np.random.default_rng(11))
+    cold = deepspeed_tpu.init_serving(
+        model, config={}, serving=dict(max_seqs=2, block_size=16,
+                                       max_model_len=128, decode_quantum=4,
+                                       prompt_bucket=16),
+        params=jax.device_get(params)).run(list(reqs))
+    warm = deepspeed_tpu.init_serving(
+        model, config={}, serving=dict(max_seqs=2, block_size=16,
+                                       max_model_len=128, decode_quantum=4,
+                                       prompt_bucket=16,
+                                       enable_prefix_cache=True),
+        params=jax.device_get(params)).run(list(reqs))
+    for rid in cold:
+        np.testing.assert_array_equal(cold[rid], warm[rid],
+                                      err_msg=f"request {rid} diverged")
+
+
+@pytest.mark.slow
+def test_warm_vs_cold_int8_kv():
+    """int8-KV pools: the warm path reads the shared prefix through the
+    SAME quantized blocks the donor wrote, but its residual rows are
+    span-computed (float suffix reads) where the cold path prefilled —
+    the same relaxation as the contiguous int8 cache's re-prefill (see
+    test_serving_int8_kv_pool): prompt+first tokens exact, near-total
+    agreement."""
+    model = make_model(_cfg())
+    reqs = _shared_load(np.random.default_rng(13), n=4)
+    serving = dict(max_seqs=2, block_size=16, max_model_len=128,
+                   decode_quantum=4, prompt_bucket=16)
+    cold = deepspeed_tpu.init_serving(
+        model, config={"kv_cache_bits": 8}, serving=serving,
+        dtype=jnp.float32).run(list(reqs))
+    srv = deepspeed_tpu.init_serving(
+        model, config={"kv_cache_bits": 8},
+        serving=dict(serving, enable_prefix_cache=True), dtype=jnp.float32)
+    warm = srv.run(list(reqs))
+    assert srv.pools["k"].dtype == jnp.int8
+    for i, (p, _) in enumerate(reqs):
+        got, ref = warm[i], cold[i]
+        assert (got[:p.size + 4] == ref[:p.size + 4]).all(), (got, ref)
+        assert (got == ref).mean() > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Corpus: both directions
+# ---------------------------------------------------------------------------
+
+def test_prefix_refcount_leak_corpus_both_directions():
+    from deepspeed_tpu.analysis.corpus import run_corpus
+    from deepspeed_tpu.analysis.serving_lint import audit_prefix
+    bad = run_corpus("prefix-refcount-leak")
+    assert not bad.ok
+    assert any(f.rule == "pool-growth" for f in bad.findings)
+    good = audit_prefix(correct=True)
+    assert good.ok, [f.message for f in good.findings]

@@ -23,6 +23,16 @@ Shed and deadline-miss events ride along via two canary requests (outside
 the compared set), so the telemetry JSONL ends up carrying the full event
 schema. Slow tier: three engine builds on interpret-mode Pallas. Runs
 under tests/run_slow.sh with its own budget (SERVING_CHAOS_BUDGET).
+
+ISSUE 12 extends the soak with the latency tier ARMED: the same fault
+schedule runs with the copy-on-write prefix cache, token-budget chunked
+prefill and speculative decoding all on, over a load where most prompts
+share a prefix — so recoveries rebuild pools with refcounted tables in
+play (the cache's references are cleared with the pool), the SIGTERM
+drain serializes mid-chunk prefills and preemption re-prefills re-match
+the cache on resume. The acceptance bar is the same and stricter: outputs
+bit-identical to the PLAIN fault-free engine (latency features and
+faults both invisible in the token stream).
 """
 
 import glob
@@ -194,3 +204,107 @@ class TestServingChaosSoak:
         assert {"fault_injected", "serving_recovered", "backend_degraded",
                 "serving_drained", "serving_resumed", "request_shed",
                 "deadline_miss"} <= types, types
+
+
+def _shared_load(n=24):
+    """Mostly-shared-prefix mix: ~2/3 of the requests extend one long
+    system prompt (the prefix cache's target traffic), the rest are
+    unique — so the soak exercises hits, forks AND cold paths."""
+    rng = np.random.default_rng(17)
+    shared = rng.integers(0, 128, size=(34,)).astype(np.int32)
+    reqs = []
+    for i in range(n):
+        if i % 3 < 2:
+            p = np.concatenate([shared, rng.integers(0, 128, size=(
+                int(rng.integers(2, 8)),)).astype(np.int32)])
+        else:
+            p = rng.integers(0, 128, size=(
+                int(rng.integers(5, 30)),)).astype(np.int32)
+        reqs.append((p, int(rng.integers(8, 14))))
+    return reqs
+
+
+class TestLatencyTierChaosSoak:
+    def test_soak_with_prefix_cache_and_speculation_armed(self, tmp_path):
+        """ISSUE 12: the fault schedule replayed with CoW prefix cache +
+        chunked prefill + speculation armed ends bit-identical to the
+        PLAIN fault-free run — shared (refcounted) block tables survive
+        recovery pool-rebuilds, drain/resume and preemption re-prefill."""
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        reqs = _shared_load()
+        latency = dict(enable_prefix_cache=True, prefill_token_budget=48,
+                       spec_tokens=2, decode_backend="auto")
+
+        # plain fault-free baseline: no latency features, no faults — the
+        # strictest possible reference (greedy parity makes the features
+        # invisible; the soak proves the faults are too)
+        srv = _serving(model, params, decode_backend="auto")
+        base = srv.run(list(reqs))
+        del srv
+
+        inj = rb_faults.install(FaultInjector(FaultSchedule([
+            {"kind": "decode_dispatch", "at": 2},
+            {"kind": "pool_exhaust", "at": 5, "times": 2},
+            {"kind": "decode_dispatch", "at": 9},
+            {"kind": "preempt", "round": 14},
+        ], seed=5)))
+        rb_events.clear()
+        drain_dir = str(tmp_path / "drain_lat")
+        handler = PreemptionHandler().install()
+        outs, engines = {}, []
+        try:
+            srv1 = _serving(model, params, **latency)
+            engines.append(srv1)
+            srv1.attach_preemption(handler, drain_dir)
+            for p, k in reqs:
+                srv1.add_request(p, k)
+            resumed = False
+            srv_cur = srv1
+            while not srv_cur.scheduler.done:
+                try:
+                    for r in srv_cur.step():
+                        outs[r.rid] = r.output
+                except Preempted:
+                    assert not resumed, "preempted twice"
+                    resumed = True
+                    handler.reset()
+                    srv2 = _serving(model, params, **latency)
+                    engines.append(srv2)
+                    rids = srv2.resume(drain_dir)
+                    assert rids, "nothing was in flight at the drain"
+                    srv_cur = srv2
+            assert resumed, "the SIGTERM preemption never fired"
+        finally:
+            handler.restore()
+            rb_faults.clear()
+        for srv in engines:
+            for r in srv._finished:
+                outs.setdefault(r.rid, r.output)
+
+        fired = {f["kind"] for f in inj.fired}
+        assert fired == {"decode_dispatch", "pool_exhaust", "preempt"}, \
+            fired
+        # the latency tier actually engaged: cache hits with forks on the
+        # shared prompts, chunked prefills, speculation verify steps —
+        # across both engines (the resumed one re-prefills via ITS cache)
+        st = [e.stats() for e in engines]
+        assert sum(s.get("prefix_hits", 0) for s in st) >= 6
+        assert sum(s.get("cow_forks", 0) for s in st) >= 1
+        assert sum(s.get("spec_steps", 0) for s in st) > 0
+        assert sum(s.get("prefill_chunks", 0) for s in st) >= 1
+        assert sum(s["recoveries"] for s in st) >= 2
+
+        # the acceptance bar: BIT-IDENTICAL to the plain engine
+        assert set(outs) >= set(base)
+        for rid in base:
+            np.testing.assert_array_equal(
+                base[rid], outs[rid],
+                err_msg=f"request {rid} diverged under latency-tier chaos")
+        # refcount hygiene after the storm: every surviving engine's held
+        # blocks are exactly its cache's (nothing leaked through the
+        # recoveries and the drain)
+        for e in engines:
+            if e.scheduler.done:
+                assert e.allocator.used_blocks == \
+                    e._prefix_cache.held_blocks
