@@ -549,6 +549,23 @@ def prefix_refcount_leak(devices=None):
     return audit_prefix(correct=False)
 
 
+def offload_serial_pipeline(devices=None):
+    """Offload pipeline audit: a layer-streamed executor whose overlap
+    pipeline was silently disabled — every param fetch resolves
+    synchronously on the critical path and every write drains before the
+    next layer runs, so the step pays the full storage latency on top of
+    compute (the BENCH_r05 capacity shape: offload_cpu_adam_ratio 7x).
+    ``audit_offload`` drives the REAL InfinityExecutor with calibrated
+    injected fetch latency; the drained defect exposes ~the whole injected
+    budget and ``offload-overlap`` must fire (host-stall dominant). The
+    pipelined twin (same executor, same latency,
+    ``pipeline_read/pipeline_write`` on) hides it under layer compute and
+    passes — tests assert both directions; the twin is also CLI-runnable
+    (``python -m deepspeed_tpu.analysis.offload_lint --pipelined``)."""
+    from deepspeed_tpu.analysis.offload_lint import audit_offload
+    return audit_offload(pipeline=False)
+
+
 def exposed_collective_trace(devices=None):
     """Perf doctor gate: a TRACED step (not a compiled program) whose
     all-reduce runs with nothing scheduled under it — 8 ms of measured
@@ -575,6 +592,7 @@ CORPUS = {
     "serving-unbounded-queue": serving_unbounded_queue,
     "router-blackhole": router_blackhole,
     "prefix-refcount-leak": prefix_refcount_leak,
+    "offload-serial-pipeline": offload_serial_pipeline,
     "exposed-collective-trace": exposed_collective_trace,
     "serialized-backward": serialized_backward,
 }

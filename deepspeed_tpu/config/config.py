@@ -74,8 +74,14 @@ class OffloadDeviceConfig(ConfigModel):
     buffer_count: int = 5
     buffer_size: int = 100_000_000
     pin_memory: bool = False
-    pipeline_read: bool = False
-    pipeline_write: bool = False
+    # overlapped offload pipeline (the reference's pipelined optimizer
+    # swapper defaults these OFF; here the double-buffered layer streaming /
+    # three-way read(i+1) || update(i) || write(i-1) schedule IS the
+    # supported fast path, so both default ON — setting BOTH knobs of an
+    # offload section to False gets the fully-drained executor/swapper,
+    # e.g. for bit-for-bit pipeline bisection)
+    pipeline_read: bool = True
+    pipeline_write: bool = True
     fast_init: bool = False
     max_in_cpu: int = 1_000_000_000
     ratio: float = 1.0
@@ -264,12 +270,19 @@ class CommsLoggerConfig(ConfigModel):
 
 @dataclasses.dataclass
 class AIOConfig(ConfigModel):
-    """Reference: aio section (``runtime/swap_tensor/constants.py``)."""
+    """Reference: aio section (``runtime/swap_tensor/constants.py``).
+
+    The offload tiers open TWO native handles — one ring for prefetch
+    reads, one for write-behind — so the read and write queues never
+    serialize behind each other. ``read_queue_depth``/``write_queue_depth``
+    size them independently (None = ``queue_depth`` for both)."""
     block_size: int = 1_048_576
     queue_depth: int = 8
     thread_count: int = 1
     single_submit: bool = False
     overlap_events: bool = True
+    read_queue_depth: Optional[int] = None
+    write_queue_depth: Optional[int] = None
 
 
 @dataclasses.dataclass

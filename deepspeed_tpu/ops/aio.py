@@ -80,18 +80,63 @@ def aio_available() -> bool:
     return _load() is not None
 
 
+def report_fallback(component: str, reason: str = "native build "
+                    "unavailable") -> None:
+    """Surface an aio-unavailable fallback as a STRUCTURED event
+    (``aio_fallback``) through the robustness event stream — the monitor
+    drains it at the next window boundary, so an offload tier silently
+    running on synchronous numpy file IO is visible in the telemetry
+    JSONL, not just a one-time log line."""
+    from deepspeed_tpu.robustness import events
+    events.emit("aio_fallback", component=component, reason=str(reason))
+
+
+# the handle's own proven defaults (deeper/wider than the reference's
+# conservative AIOConfig constants of 8/1)
+_DEFAULT_QUEUE_DEPTH = 32
+_DEFAULT_THREAD_COUNT = 4
+
+
 class AIOHandle:
     """Reference: ``aio_handle``. block_size/queue_depth/thread_count map to
     the same-named config keys (AIOConfig)."""
 
-    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
-                 thread_count: int = 4):
+    @classmethod
+    def from_config(cls, aio_cfg=None, role: str = "read") -> "AIOHandle":
+        """Build a handle from the config ``aio`` section. ``role`` picks
+        the read- or write-side queue depth: the offload pipelines open one
+        ring per direction so prefetch reads never queue behind write-behind
+        (read_queue_depth/write_queue_depth default to queue_depth).
+
+        The AIOConfig dataclass defaults mirror the reference constants
+        (queue_depth 8, thread_count 1), but this handle's own proven
+        defaults are 32/4 — fields the user did NOT set in their config
+        keep the handle defaults, so wiring the config section through
+        never silently downgrades a default-config run's IO parallelism."""
+        if aio_cfg is None:
+            return cls()
+        was_set = getattr(aio_cfg, "was_set", lambda _k: True)
+        depth = (aio_cfg.read_queue_depth if role == "read"
+                 else aio_cfg.write_queue_depth)
+        if depth is None:
+            depth = (aio_cfg.queue_depth if was_set("queue_depth")
+                     else _DEFAULT_QUEUE_DEPTH)
+        threads = (aio_cfg.thread_count if was_set("thread_count")
+                   else _DEFAULT_THREAD_COUNT)
+        return cls(block_size=aio_cfg.block_size, queue_depth=depth,
+                   thread_count=threads)
+
+    def __init__(self, block_size: int = 1 << 20,
+                 queue_depth: int = _DEFAULT_QUEUE_DEPTH,
+                 thread_count: int = _DEFAULT_THREAD_COUNT):
         lib = _load()
         if lib is None:
             raise RuntimeError("native aio library unavailable (g++ build failed)")
         self._lib = lib
         self._h = lib.dstpu_aio_open(block_size, queue_depth, thread_count)
         self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
 
     @property
     def uses_io_uring(self) -> bool:

@@ -47,6 +47,11 @@ DIVERGENCE_TOLERANCE = 0.25
 # on tiny buckets from gating)
 REGRESSION_REL = 0.20
 REGRESSION_ABS = 0.02
+# offload pipeline gate: the measured share of the streamed step's storage
+# IO the executor hid under compute (bench: offload_overlap_fraction).
+# Below this the capacity rung is paying serialized wire/host time the
+# three-way read || update || write schedule exists to hide.
+OFFLOAD_MIN_OVERLAP = 0.8
 
 
 def diagnose(trace: Any, hlo_text: str = "", *,
@@ -139,6 +144,97 @@ def gate(diag: Dict[str, Any], *,
                     program=program, ident=name,
                     data={"fraction": cur_f, "baseline": base_f})])
     return report
+
+
+def diagnose_offload(decomp: Dict[str, Any],
+                     step_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Host-stall attribution for the offload phases of a layer-streamed
+    step, from the measured decomposition
+    (``InfinityExecutor.measure_decomposition``) plus a measured step time.
+
+    Attribution: compute = L x (layer fwd+bwd) + L x (chunk Adam) + the
+    embed/CE-head top; io = 2L param-chunk fetches + L opt-chunk
+    round-trips; everything the step spent beyond compute is EXPOSED io/
+    host stall (clamped to the io budget), and
+    ``offload_overlap_fraction = 1 - exposed/io`` prices how much of the
+    storage traffic the pipeline actually hid under compute."""
+    compute = (float(decomp.get("offload_compute_ms", 0.0))
+               + float(decomp.get("offload_update_sweep_ms", 0.0))
+               + float(decomp.get("offload_top_ms", 0.0)))
+    io = float(decomp.get("offload_io_ms")
+               or decomp.get("offload_dma_ms") or 0.0)
+    out: Dict[str, Any] = {
+        "offload_compute_total_ms": round(compute, 2),
+        "offload_io_ms": round(io, 2),
+        "offload_pipeline": decomp.get("offload_pipeline"),
+    }
+    if step_ms is None:
+        step_ms = decomp.get("offload_step_ms")
+    if step_ms:
+        exposed = max(0.0, min(float(step_ms) - compute, io))
+        out["offload_step_ms"] = round(float(step_ms), 2)
+        out["offload_exposed_io_ms"] = round(exposed, 2)
+        out["offload_overlap_fraction"] = (round(1.0 - exposed / io, 4)
+                                           if io > 0 else 1.0)
+        # which phase dominates the step — the "turn this knob" signal
+        phases = {"layer-compute": float(decomp.get("offload_compute_ms",
+                                                    0.0)),
+                  "host-adam": float(decomp.get("offload_update_sweep_ms",
+                                                0.0)),
+                  "top-compute": float(decomp.get("offload_top_ms", 0.0)),
+                  "exposed-io-stall": exposed}
+        out["offload_dominant_phase"] = max(phases, key=phases.get)
+    elif "offload_overlap_fraction" in decomp:
+        out["offload_overlap_fraction"] = decomp["offload_overlap_fraction"]
+    return out
+
+
+def gate_offload(diag: Dict[str, Any], *,
+                 min_overlap: float = OFFLOAD_MIN_OVERLAP,
+                 program: str = "offload_step") -> Report:
+    """The ``offload-overlap`` rule: the streamed step left more than
+    (1 - min_overlap) of its storage IO exposed — the executor is running
+    fetch -> compute -> host-Adam -> write-back serially instead of the
+    three-way pipeline. Report in the graft-lint mold (exit status = CI
+    gate); the corpus twin is ``offload-serial-pipeline``."""
+    report = Report(meta={"tool": "perf-doctor", "program": program,
+                          "offload": diag})
+    frac = diag.get("offload_overlap_fraction")
+    if frac is None:
+        # fail CLOSED: a gate that cannot price the overlap (no
+        # offload_step_ms / offload_overlap_fraction in the input) must
+        # not certify the pipeline it never measured
+        report.extend([Finding(
+            rule="offload-overlap",
+            message="offload overlap cannot be priced: the decomposition "
+                    "carries no offload_overlap_fraction and no "
+                    "offload_step_ms (pass the measured step time "
+                    "alongside the measure_decomposition fields)",
+            program=program, ident="unpriced", data=dict(diag))])
+        return report
+    if frac < min_overlap:
+        exposed = diag.get("offload_exposed_io_ms", 0.0)
+        io = diag.get("offload_io_ms", 0.0)
+        report.extend([Finding(
+            rule="offload-overlap",
+            message=(f"offload pipeline hid only {frac:.0%} of the streamed "
+                     f"step's {io:.1f} ms storage IO under compute (budget "
+                     f"{min_overlap:.0%}; {exposed:.1f} ms exposed host "
+                     f"stall, dominant phase "
+                     f"{diag.get('offload_dominant_phase', 'unknown')}) — "
+                     "check offload_param/offload_optimizer "
+                     "pipeline_read/pipeline_write and the aio "
+                     "read_queue_depth/write_queue_depth"),
+            program=program, ident="offload-overlap",
+            data={"stall": "host-io", **diag})])
+    return report
+
+
+def offload_fields(diag: Dict[str, Any]) -> Dict[str, Any]:
+    """The bench-JSON fields for the offload attribution."""
+    keys = ("offload_overlap_fraction", "offload_exposed_io_ms",
+            "offload_io_ms", "offload_dominant_phase")
+    return {k: diag[k] for k in keys if k in diag}
 
 
 def baseline_dict(diag: Dict[str, Any]) -> Dict[str, Any]:
@@ -254,7 +350,33 @@ def main(argv=None) -> int:
                    help="accept the current attribution and exit 0")
     p.add_argument("--corpus", help="run a seeded known-bad entry instead "
                                     "of a trace (doctor gate self-test)")
+    p.add_argument("--offload-decomp", metavar="PATH",
+                   help="offload decomposition JSON (the "
+                        "measure_decomposition fields + offload_step_ms, "
+                        "e.g. cut from the bench JSON): run the "
+                        "offload-overlap gate instead of a trace")
+    p.add_argument("--min-offload-overlap", type=float,
+                   default=OFFLOAD_MIN_OVERLAP)
     args = p.parse_args(argv)
+
+    if args.offload_decomp:
+        decomp = _load_json(args.offload_decomp)
+        diag = diagnose_offload(decomp)
+        report = gate_offload(diag,
+                              min_overlap=args.min_offload_overlap,
+                              program=os.path.basename(args.offload_decomp))
+        print(report.summary(), file=sys.stderr)
+        if args.json_out:
+            payload = dict(diag)
+            payload["findings"] = [f.to_dict() for f in report.findings]
+            payload["ok"] = report.ok
+            text = json.dumps(payload, indent=2, default=str)
+            if args.json_out == "-":
+                print(text)
+            else:
+                with open(args.json_out, "w") as f:
+                    f.write(text + "\n")
+        return 0 if report.ok else 1
 
     if args.corpus:
         name = ("exposed-collective-trace" if args.corpus == "doctor"

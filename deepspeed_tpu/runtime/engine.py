@@ -835,7 +835,10 @@ class Engine:
             param_shardings=self.param_shardings,
             grad_shardings=grad_shardings,
             compute_dtype=self.compute_dtype,
-            pipeline=off.pipeline_read or off.pipeline_write or True)
+            # both pipeline knobs off = the fully-drained swapper (the old
+            # `... or True` ignored an explicit opt-out)
+            pipeline=bool(off.pipeline_read or off.pipeline_write),
+            aio_config=cfg.aio)
 
     def _build_infinity(self):
         from deepspeed_tpu.runtime.infinity import InfinityExecutor
@@ -871,7 +874,16 @@ class Engine:
             max_live_params=(
                 cfg.zero_optimization.stage3_max_live_parameters
                 if cfg.zero_optimization.was_set("stage3_max_live_parameters")
-                else 0))
+                else 0),
+            # overlapped offload pipeline: double-buffered layer streaming +
+            # the three-way update sweep. The executor has ONE switch, so
+            # turning BOTH knobs of EITHER offload section off drains it
+            # (the offload-serial-pipeline corpus twin) — an explicit
+            # opt-out on just offload_param must not be vetoed by
+            # offload_optimizer's defaults
+            pipeline=bool((off_p.pipeline_read or off_p.pipeline_write)
+                          and (off_o.pipeline_read or off_o.pipeline_write)),
+            aio_config=cfg.aio)
 
     def _state_shardings_from(self, state_shapes):
         """Build shardings for the full train-state pytree: params use

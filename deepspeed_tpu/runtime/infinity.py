@@ -64,7 +64,7 @@ class LayerStore:
     """
 
     def __init__(self, path: Optional[str], n_layers: int, chunk_elems: int,
-                 backend: str = "nvme", host_sharding=None):
+                 backend: str = "nvme", host_sharding=None, aio_config=None):
         self.n_layers = n_layers
         self.chunk = chunk_elems
         self.backend = backend
@@ -81,17 +81,23 @@ class LayerStore:
             self._dir = os.path.join(path, f"dstpu-infinity-{os.getpid()}")
             os.makedirs(self._dir, exist_ok=True)
             try:
-                from deepspeed_tpu.ops.aio import AIOHandle, aio_available
+                from deepspeed_tpu.ops.aio import (AIOHandle, aio_available,
+                                                   report_fallback)
                 if aio_available():
                     # separate handles: reads (prefetch) and writes
-                    # (write-behind) each get their own ring
-                    self._aio_r = AIOHandle()
-                    self._aio_w = AIOHandle()
+                    # (write-behind) each get their own ring, with
+                    # independently-sized queue depths from the config
+                    # `aio` section (read_queue_depth / write_queue_depth)
+                    self._aio_r = AIOHandle.from_config(aio_config, "read")
+                    self._aio_w = AIOHandle.from_config(aio_config, "write")
                 else:  # pragma: no cover - no toolchain
-                    logger.warning("native aio unavailable; LayerStore uses "
-                                   "numpy file IO")
+                    # structured event (not just a log line): a capacity
+                    # tier silently on synchronous numpy IO must be
+                    # visible in the telemetry stream
+                    report_fallback("infinity-layer-store")
             except Exception as e:  # pragma: no cover
-                logger.warning(f"aio init failed ({e}); numpy file IO")
+                from deepspeed_tpu.ops.aio import report_fallback
+                report_fallback("infinity-layer-store", reason=f"{e}")
 
     def _path(self, kind: str, i: int) -> str:
         return os.path.join(self._dir, f"{kind}_{i}.bin")
@@ -137,6 +143,18 @@ class LayerStore:
 
         def do_read():
             rb_faults.io_seam("nvme_read", p)
+            if out is not None:
+                # staging-buffer path: read straight into the caller's
+                # pinned buffer (no per-read allocation in the hot loop).
+                # A short read (torn/truncated chunk) must raise like the
+                # np.fromfile path does, never hand back a buffer whose
+                # tail is the PREVIOUS chunk's bytes
+                with open(p, "rb") as f:
+                    got = f.readinto(memoryview(out).cast("B"))
+                if got != out.nbytes:
+                    raise OSError(
+                        f"short read: {got} of {out.nbytes} bytes from {p}")
+                return out
             return np.fromfile(p, dtype).reshape(shape)
         return retry_io(do_read, what="layer-chunk read", path=p)
 
@@ -155,14 +173,22 @@ class LayerStore:
         return self._read("opt", i, (_PLANES, self.chunk), np.float32, out=out)
 
     def save_to(self, dst: str):
-        """Checkpoint: copy every chunk into dst."""
+        """Checkpoint: copy every chunk into dst. Same PR-6 ``retry_io``
+        contract as the step-path IO: a transient EIO mid-copy retries with
+        backoff instead of torching the save."""
+        from deepspeed_tpu.robustness.retry import retry_io
         os.makedirs(dst, exist_ok=True)
         if self.backend in ("host", "pinned"):
             for k, v in self._host.items():
-                np.asarray(jax.device_get(v)).tofile(os.path.join(dst, f"{k}.bin"))
+                p = os.path.join(dst, f"{k}.bin")
+                arr = np.asarray(jax.device_get(v))
+                retry_io(lambda arr=arr, p=p: arr.tofile(p),
+                         what="layer-chunk checkpoint write", path=p)
             return
         for f in os.listdir(self._dir):
-            shutil.copyfile(os.path.join(self._dir, f), os.path.join(dst, f))
+            src, out = os.path.join(self._dir, f), os.path.join(dst, f)
+            retry_io(lambda src=src, out=out: shutil.copyfile(src, out),
+                     what="layer-chunk checkpoint copy", path=out)
 
     def load_from(self, src: str, saved_chunk: Optional[int] = None):
         """Restore chunks. `saved_chunk` (from the shapes manifest) may
@@ -178,12 +204,15 @@ class LayerStore:
                 return np.ascontiguousarray(plane[:self.chunk])
             return np.pad(plane, (0, self.chunk - saved))
 
+        from deepspeed_tpu.robustness.retry import retry_io
         for f in os.listdir(src):
             if not f.endswith(".bin"):
                 continue
             kind, i = f[:-4].rsplit("_", 1)
             dtype = np.uint16 if kind == "param" else np.float32
-            arr = np.fromfile(os.path.join(src, f), dtype)
+            p = os.path.join(src, f)
+            arr = retry_io(lambda p=p, dtype=dtype: np.fromfile(p, dtype),
+                           what="layer-chunk checkpoint read", path=p)
             if kind == "opt":
                 arr = np.stack([rechunk(p)
                                 for p in arr.reshape(_PLANES, saved)])
@@ -194,6 +223,9 @@ class LayerStore:
     def close(self):
         if self._dir:
             shutil.rmtree(self._dir, ignore_errors=True)
+            # idempotent (pid-keyed dir): a re-run close() must not rmtree
+            # a successor store's live directory
+            self._dir = None
 
 
 class InfinityExecutor:
@@ -212,7 +244,8 @@ class InfinityExecutor:
                  backend: str = "nvme", param_cache_bytes: int = 0,
                  gas: int = 1, mesh=None, fp16: Optional[Dict[str, Any]] = None,
                  compression=None, use_cpu_adam: bool = False,
-                 max_live_params: int = 0, moq: bool = False):
+                 max_live_params: int = 0, moq: bool = False,
+                 pipeline: bool = True, aio_config=None):
         if model_cfg.num_experts > 1:
             raise ValueError("offload_param.device=nvme supports dense "
                              "transformers (MoE experts not yet streamed)")
@@ -384,9 +417,30 @@ class InfinityExecutor:
         self.num_params = L * numel
         self.store = LayerStore(nvme_path, L, self.chunk, backend=backend,
                                 host_sharding={"param": self._bits_host_sh,
-                                               "opt": self._opt_host_sh})
-        self._pool = ThreadPoolExecutor(max_workers=2)
-        self._pending_write = None
+                                               "opt": self._opt_host_sh},
+                                aio_config=aio_config)
+        # --- overlapped offload pipeline (reference: the three-stage
+        # pipelined optimizer swapper, pipelined_optimizer_swapper.py:50).
+        # pipeline=True (default): fwd/bwd walks keep TWO param fetches in
+        # flight ahead of compute, and every update sweep runs the
+        # three-way schedule  read(i+1) || update(i) || write(i-1)  with
+        # SEPARATE read/write pools (a queued write-behind must never delay
+        # the next prefetch behind it) and write-behind bounded to 2.
+        # pipeline=False is the fully-drained executor: synchronous
+        # resolve-at-use reads and a drain after every layer's write — the
+        # `offload-serial-pipeline` corpus twin and the bit-for-bit
+        # pipeline-bisection baseline.
+        self.pipeline = bool(pipeline)
+        self._rpool = ThreadPoolExecutor(max_workers=2)
+        self._wpool = ThreadPoolExecutor(max_workers=2)
+        self._pending_writes: list = []
+        # host staging buffers, lazily allocated on first use: two per
+        # plane (param bits / opt planes) for the double-buffered reads of
+        # the device-Adam sweep, three opt buffers for the native host-Adam
+        # sweep (read fills one while Adam updates another in place and
+        # write-behind drains the third)
+        self._opt_stage = None
+        self._opt_stage_busy = None
         # host bf16-bits cache of param chunks (fast refetch for bwd/next
         # step; NVMe stays the system of record). Pointless for the pinned
         # backend — the store itself IS host memory.
@@ -799,9 +853,36 @@ class InfinityExecutor:
             return got
         if self._pinned:
             return self._param_dev(i)  # async dispatch, returns a handle
+        if not self.pipeline:
+            return None   # drained executor: resolve-at-use, synchronously
         if i in self._param_cache:
             return None
-        return self._pool.submit(self._get_param, i)
+        return self._rpool.submit(self._get_param, i)
+
+    def _stream_params(self, order):
+        """Yield ``(i, resolved_bits)`` over layer indices ``order``,
+        keeping TWO fetches in flight ahead of the consumer (double-
+        buffered streaming): while layer i computes, layer order[+1]'s
+        read is resolving and order[+2]'s is queued on the read pool.
+        pipeline=False degrades to synchronous resolve-at-use.
+
+        Pinned backend stays at depth 1: there a "fetch" IS the
+        pinned->HBM device_put dispatch, so each prefetched layer is
+        DEVICE-resident bits — depth 2 would hold a third layer's chunk
+        in HBM on rungs sized for two (the 7B capacity rung budgets one
+        working layer + one prefetch), for no IO win over the already-
+        async dispatch."""
+        order = list(order)
+        depth = (1 if self._pinned else 2) if self.pipeline else 0
+        futs = {}
+        for k in order[:depth]:
+            futs[k] = self._fetch_param_async(k)
+        for pos, i in enumerate(order):
+            fut = futs.pop(i, None)
+            if depth and pos + depth < len(order):
+                nxt = order[pos + depth]
+                futs[nxt] = self._fetch_param_async(nxt)
+            yield i, self._resolve_param(fut, i)
 
     def _resolve_param(self, fut, i: int):
         if fut is not None and not hasattr(fut, "result"):
@@ -827,9 +908,18 @@ class InfinityExecutor:
         return jnp.asarray(h)
 
     def _drain_write(self):
-        if self._pending_write is not None:
-            self._pending_write.result()
-            self._pending_write = None
+        """Drain ALL in-flight write-behind. Called only at step
+        boundaries (and on overflow/checkpoint/close) — never inside the
+        sweeps, where it would serialize the pipeline."""
+        pend, self._pending_writes = self._pending_writes, []
+        for f in pend:
+            f.result()
+
+    def _bound_writes(self, limit: int = 2):
+        """Write-behind depth: two writes in flight (double buffer);
+        the oldest completes before a third is queued."""
+        while len(self._pending_writes) >= limit:
+            self._pending_writes.pop(0).result()
 
     def _write_layer_async(self, i: int, opt_buf_dev, bits_dev):
         if self._pinned:
@@ -838,17 +928,26 @@ class InfinityExecutor:
             self.store.write_opt(i, opt_buf_dev)
             self.store.write_param(i, bits_dev)
             return
-        self._drain_write()  # bound in-flight writes to 1
 
-        def work(opt_host, bits_host):
+        def work(opt_dev, bits_dev):
+            # the device_get runs ON the writer thread: the main thread
+            # keeps dispatching chunk i+1's update while chunk i's result
+            # drains off the device and onto storage
+            opt_host = np.asarray(jax.device_get(opt_dev))
+            bits_host = np.asarray(jax.device_get(bits_dev))
             self.store.write_opt(i, opt_host)
             self.store.write_param(i, bits_host)
             if i in self._param_cache or len(self._param_cache) < self._cache_layers:
                 self._param_cache[i] = bits_host
 
-        opt_host = np.asarray(jax.device_get(opt_buf_dev))
-        bits_host = np.asarray(jax.device_get(bits_dev))
-        self._pending_write = self._pool.submit(work, opt_host, bits_host)
+        if not self.pipeline:
+            # drained twin: write synchronously, nothing in flight past
+            # this layer
+            work(opt_buf_dev, bits_dev)
+            return
+        self._bound_writes()
+        self._pending_writes.append(
+            self._wpool.submit(work, opt_buf_dev, bits_dev))
 
     # ------------------------------------------------------------------
     def _batch_arrays(self, batch):
@@ -886,17 +985,25 @@ class InfinityExecutor:
         """Measured transfer-vs-compute decomposition of the streamed step
         (VERDICT Weak #2: the offload ratio was prose, not attributable).
 
-        Two direct measurements, no modeling:
+        Direct measurements, no modeling:
           - ``offload_chunk_dma_ms``: wall time to stage ONE layer's param
             chunk host->device (the store's own staging path) with a fence;
           - ``offload_layer_ms``: wall time of one layer's fwd+bwd with the
-            bits already device-resident (pure compute) with a fence.
-        Scaled to the step: DMA crosses twice per layer (fwd + bwd fetch),
-        compute runs once per layer. The update sweep is excluded by
-        design — with use_cpu_adam the opt chunks never cross the bus.
-        Callers price overlap as 1 - exposed/dma where exposed =
-        max(0, step_ms - compute_ms), i.e. the DMA time the step did NOT
-        hide under compute.
+            bits already device-resident (pure compute) with a fence;
+          - ``offload_update_ms`` / ``offload_top_ms`` /
+            ``offload_opt_io_ms``: the update sweep's three legs — one
+            chunk's Adam compute, the embed/CE-head top (once per step),
+            and one opt chunk's storage round-trip.
+        Scaled to the step: param DMA crosses twice per layer (fwd + bwd
+        fetch — ``offload_dma_ms``), layer fwd+bwd and the chunk Adam run
+        once per layer (``offload_compute_ms`` /
+        ``offload_update_sweep_ms``), and ``offload_io_ms`` totals the
+        step's storage traffic (param fetches + opt round-trips).
+        Callers price overlap through
+        ``profiling.doctor.diagnose_offload``: exposure =
+        max(0, step_ms - ALL measured compute) clamped to the io budget,
+        ``offload_overlap_fraction = 1 - exposed/io`` — the storage time
+        the step did NOT hide under compute.
         """
         import time
         with self.mesh:
@@ -936,6 +1043,38 @@ class InfinityExecutor:
                 d = self._to_dev(h)
                 fence(d)
             chunk_ms = (time.perf_counter() - t0) / reps * 1000
+
+            # --- update-sweep probes (the pipelined sweep's three legs:
+            # what the Adam compute costs, what the embed/head top costs,
+            # and what one opt chunk's storage round-trip costs — callers
+            # price exposure against compute INCLUDING these, so the
+            # overlap fraction attributes the sweep too, not just the
+            # fwd/bwd fetches)
+            update_ms = top_ms = opt_io_ms = 0.0
+            try:
+                top_ms = self._measure_top_ms(ids, labels, scale=1.0,
+                                              reps=reps)
+            except Exception:   # noqa: BLE001 — secondary probe
+                pass
+            try:
+                update_ms = self._measure_update_ms(reps=reps)
+            except Exception:   # noqa: BLE001 — secondary probe
+                pass
+            try:
+                if self.store.backend == "nvme":
+                    opt0 = self.store.read_opt(0)
+                    if opt0 is not None:
+                        t0 = time.perf_counter()
+                        for _ in range(reps):
+                            opt0 = self.store.read_opt(0)
+                            # same bytes back: a pure IO probe, no state
+                            # change
+                            self.store.write_opt(0, opt0)
+                        opt_io_ms = ((time.perf_counter() - t0) / reps
+                                     * 1000)
+            except Exception:   # noqa: BLE001 — secondary probe
+                pass
+        io_ms = chunk_ms * 2 * L + opt_io_ms * L
         return {
             "offload_chunk_dma_ms": round(chunk_ms, 3),
             "offload_layer_ms": round(layer_ms, 3),
@@ -944,7 +1083,87 @@ class InfinityExecutor:
             # them together
             "offload_dma_ms": round(chunk_ms * 2 * L, 2),
             "offload_compute_ms": round(layer_ms * L, 2),
+            # the sweep legs: per-layer Adam compute, embed/head top
+            # compute (once per step), per-layer opt-chunk storage IO,
+            # and the step's TOTAL io (param fetches + opt round-trips)
+            "offload_update_ms": round(update_ms, 3),
+            "offload_update_sweep_ms": round(update_ms * L, 2),
+            "offload_top_ms": round(top_ms, 2),
+            "offload_opt_io_ms": round(opt_io_ms, 3),
+            "offload_io_ms": round(io_ms, 2),
+            "offload_pipeline": bool(self.pipeline),
         }
+
+    def _measure_top_ms(self, ids, labels, scale: float, reps: int) -> float:
+        """Embed fwd + CE-head fwd/bwd + embed bwd wall time (the step's
+        non-layer compute)."""
+        import time
+        scale_t = jnp.float32(scale)
+        x = self._embed_fwd(self.nl_params, ids)
+        loss, dnl, dx = self._top_fwd_bwd(self.nl_params, x, labels, scale_t)
+        dnl_e = self._embed_bwd(self.nl_params, ids, dx)
+        np.asarray(jax.device_get(loss))
+        jax.tree.leaves(jax.device_get(dnl_e))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            x = self._embed_fwd(self.nl_params, ids)
+            loss, dnl, dx = self._top_fwd_bwd(self.nl_params, x, labels,
+                                              scale_t)
+            dnl_e = self._embed_bwd(self.nl_params, ids, dx)
+            np.asarray(jax.device_get(jnp.ravel(
+                jax.tree.leaves(dnl_e)[0])[0]))
+        return (time.perf_counter() - t0) / reps * 1000
+
+    def _measure_update_ms(self, reps: int) -> float:
+        """One layer chunk's Adam update cost on scratch state — the
+        compute leg of the update sweep (no store writes)."""
+        import time
+        if self._host_adam == "native":
+            from deepspeed_tpu.ops.cpu_adam import adam_step_flat
+            scratch = np.zeros((_PLANES, self.chunk), np.float32)
+            g = np.zeros(self.chunk, np.float32)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                adam_step_flat(scratch[0], scratch[1], scratch[2], g,
+                               step_num=1, lr=self.lr
+                               if not callable(self.lr) else self.lr(1),
+                               betas=(self.b1, self.b2), eps=self.eps,
+                               weight_decay=self.wd, adamw_mode=self.awm,
+                               bias_correction=self.bc, grad_scale=1.0)
+            return (time.perf_counter() - t0) / reps * 1000
+        lr_t, stepc, coef_t = (jnp.float32(1e-3), jnp.float32(1.0),
+                               jnp.float32(1.0))
+        if self._host_adam == "xla_host":
+            lr_h, step_h, coef_h = jax.device_put((lr_t, stepc, coef_t),
+                                                  self._repl_host_sh)
+            pbits = self.store.read_param(0)
+            gbits = self._to_host(self._grad_bits(
+                jnp.zeros((self.chunk,), jnp.float32)))
+            # warm
+            _o, _b, fence = self._adam_chunk_host(
+                self._zeros_opt_host(), gbits, pbits, lr_h, step_h,
+                coef_h, False)
+            np.asarray(jax.device_get(fence))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _o, _b, fence = self._adam_chunk_host(
+                    self._zeros_opt_host(), gbits, pbits, lr_h, step_h,
+                    coef_h, False)
+                np.asarray(jax.device_get(fence))
+            return (time.perf_counter() - t0) / reps * 1000
+        g_dev = jnp.zeros((self.chunk,), jnp.float32)
+        pbits = self._param_dev(0)
+        _buf, _bits = self._adam_chunk(self._zeros_opt(), g_dev, pbits,
+                                       jnp.asarray(False), lr_t, stepc,
+                                       coef_t)
+        np.asarray(jax.device_get(_bits[0]))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _buf, _bits = self._adam_chunk(self._zeros_opt(), g_dev, pbits,
+                                           jnp.asarray(False), lr_t, stepc,
+                                           coef_t)
+            np.asarray(jax.device_get(_bits[0]))
+        return (time.perf_counter() - t0) / reps * 1000
 
     def _qbits(self, batch, i: int):
         """Layer i's traced MoQ bit-width (engine side-channel), or a dummy
@@ -996,27 +1215,30 @@ class InfinityExecutor:
             mask = mask_all[sl] if mask_all is not None else None
             positions = None
 
-            # ---- forward sweep (prefetch one layer ahead) ----
+            # ---- forward sweep (double-buffered: two fetches in flight
+            # ahead of compute; _stream_params resolves at use) ----
             x = self._embed_fwd(self.nl_params, ids)
             acts = [x]
-            fut = self._fetch_param_async(0)
-            for i in range(L):
-                bits = self._resolve_param(fut, i)
-                fut = self._fetch_param_async(i + 1) if i + 1 < L else None
+            for i, bits in self._stream_params(range(L)):
                 x = self._layer_fwd(bits, x, mask, positions, step_t,
                                     self._qbits(batch, i))
                 acts.append(x)
+                if not self.pipeline:
+                    # fully-drained executor: fence the layer before the
+                    # next synchronous fetch — fetch -> compute -> drain,
+                    # strictly in sequence (the offload-serial-pipeline
+                    # corpus shape; async dispatch would otherwise still
+                    # hide the next fetch under this layer's compute)
+                    np.asarray(jax.device_get(jnp.ravel(x)[0]))
 
             loss, dnl_top, dx = self._top_fwd_bwd(self.nl_params, acts[L],
                                                   labels, scale_t)
             loss_sum += float(np.asarray(jax.device_get(loss))) / scale
 
-            # ---- backward sweep (reverse, prefetch one behind) ----
+            # ---- backward sweep (reverse, double-buffered: two fetches
+            # in flight behind the walk) ----
             last_mb = g == gas - 1
-            fut = self._fetch_param_async(L - 1)
-            for i in range(L - 1, -1, -1):
-                bits = self._resolve_param(fut, i)
-                fut = self._fetch_param_async(i - 1) if i > 0 else None
+            for i, bits in self._stream_params(range(L - 1, -1, -1)):
                 dp, dx, sq = self._layer_bwd(bits, acts[i], dx, mask,
                                              positions, step_t,
                                              self._qbits(batch, i))
@@ -1167,14 +1389,26 @@ class InfinityExecutor:
         elif self._host_adam == "native":
             self._native_update_sweep(grad_stage, float(lr_t), coef)
         else:
-            opt_fut = (self.store.read_opt(0) if self._pinned
-                       else self._pool.submit(self.store.read_opt, 0))
+            # three-way pipelined sweep (reference schedule,
+            # swap_tensor.py:16):  read(i+1)  ||  adam(i) on device  ||
+            # write(i-1).  Opt reads prefetch on the read pool, the write-
+            # behind (device_get runs ON the writer thread) drains on the
+            # write pool two layers deep, and _drain_write happens only at
+            # the step boundary below. The drained twin (pipeline=False)
+            # resolves reads at use and syncs every write. Reads come back
+            # as fresh host arrays (no staging reuse here: the jit upload
+            # may be zero-copy on CPU jaxlibs, so a recycled buffer could
+            # alias a live device array — the native host-Adam sweep is
+            # where the rotating staging buffers live).
+            pipe = self.pipeline and not self._pinned
+            opt_fut = self._rpool.submit(self.store.read_opt, 0) \
+                if pipe else None
             for i in range(L):
-                opt_host = opt_fut if self._pinned else opt_fut.result()
-                if i + 1 < L:
-                    opt_fut = (self.store.read_opt(i + 1) if self._pinned
-                               else self._pool.submit(self.store.read_opt,
-                                                      i + 1))
+                opt_host = (opt_fut.result() if pipe
+                            else self.store.read_opt(i))
+                if pipe:
+                    opt_fut = (self._rpool.submit(self.store.read_opt, i + 1)
+                               if i + 1 < L else None)
                 have = opt_host is not None
                 opt_dev = (self._to_dev(opt_host, self._opt_dev_sh) if have
                            else self._zeros_opt())
@@ -1202,26 +1436,55 @@ class InfinityExecutor:
             out["loss_scale"] = jnp.float32(scale)
         return out
 
+    def _opt_read_staged(self, i: int):
+        """Read opt chunk i into one of the three rotating host staging
+        buffers (lazy-init from the bf16 params when the chunk is missing).
+        Waits for any write-behind still draining the target buffer, so
+        read(i+1), update(i) and write(i-1) can all be in flight at once
+        without aliasing. Only meaningful for the native host-Adam sweep,
+        whose consumption is pure numpy (in-place update + same-buffer
+        write)."""
+        import ml_dtypes
+        k = i % 3
+        busy = self._opt_stage_busy[k]
+        if busy is not None:
+            busy.result()
+            self._opt_stage_busy[k] = None
+        buf = self._opt_stage[k]
+        got = self.store.read_opt(i, out=buf)
+        if got is None:   # lazy init: master from the bf16 params
+            np.copyto(buf[0], self._get_param(i).view(ml_dtypes.bfloat16))
+            buf[1:] = 0.0
+            return buf
+        # host backend returns the stored array itself (out is ignored
+        # there) — same in-place-update-then-copy-back contract as before
+        return np.ascontiguousarray(got)
+
     def _native_update_sweep(self, grad_stage, lr: float, coef: float):
         """Fused C++ AdamW (csrc/adam/dstpu_cpu_adam.cpp) over the store's
         chunks — this process IS the TPU host, so the fp32 state never
         touches the device; updated bf16 param bits are derived host-side.
+        Pipelined as the reference's three-stage optimizer swapper
+        (pipelined_optimizer_swapper.py:50): chunk i+1's AIO read fills one
+        staging buffer while the host cores run Adam on chunk i in a second
+        and the write ring drains chunk i-1 from the third.
         Reference: stage_1_and_2.py's cpu_offload step over DeepSpeedCPUAdam."""
         import ml_dtypes
         from deepspeed_tpu.ops.cpu_adam import adam_step_flat
         L = self.cfg.num_layers
         step = self.applied_steps
-        opt_fut = self._pool.submit(self.store.read_opt, 0)
+        pipe = self.pipeline
+        if self._opt_stage is None:
+            self._opt_stage = [np.empty((_PLANES, self.chunk), np.float32)
+                               for _ in range(3)]
+            self._opt_stage_busy = [None, None, None]
+        opt_fut = self._rpool.submit(self._opt_read_staged, 0) \
+            if pipe else None
         for i in range(L):
-            opt = opt_fut.result()
-            if i + 1 < L:
-                opt_fut = self._pool.submit(self.store.read_opt, i + 1)
-            if opt is None:   # lazy init: master from the bf16 params
-                opt = np.zeros((_PLANES, self.chunk), np.float32)
-                np.copyto(opt[0],
-                          self._get_param(i).view(ml_dtypes.bfloat16))
-            else:
-                opt = np.ascontiguousarray(opt)
+            opt = opt_fut.result() if pipe else self._opt_read_staged(i)
+            if pipe:
+                opt_fut = (self._rpool.submit(self._opt_read_staged, i + 1)
+                           if i + 1 < L else None)
             adam_step_flat(opt[0], opt[1], opt[2], grad_stage[i],
                            step_num=step, lr=lr, betas=(self.b1, self.b2),
                            eps=self.eps, weight_decay=self.wd,
@@ -1230,11 +1493,22 @@ class InfinityExecutor:
             grad_stage[i] = None
             bits = np.ascontiguousarray(
                 opt[0].astype(ml_dtypes.bfloat16).view(np.uint16))
-            self.store.write_opt(i, opt)
-            self.store.write_param(i, bits)
-            if i in self._param_cache or \
-                    len(self._param_cache) < self._cache_layers:
-                self._param_cache[i] = bits
+
+            def work(i=i, opt=opt, bits=bits):
+                self.store.write_opt(i, opt)
+                self.store.write_param(i, bits)
+                if i in self._param_cache or \
+                        len(self._param_cache) < self._cache_layers:
+                    self._param_cache[i] = bits
+
+            if pipe:
+                self._bound_writes()
+                fut = self._wpool.submit(work)
+                if opt is self._opt_stage[i % 3]:
+                    self._opt_stage_busy[i % 3] = fut
+                self._pending_writes.append(fut)
+            else:
+                work()   # drained twin: write + implicit drain per layer
             self._refresh_live_cache(i, bits, from_host=True)
 
     def _on_overflow(self):
@@ -1260,10 +1534,7 @@ class InfinityExecutor:
         with self.mesh:
             ids, labels, mask = self._batch_arrays(batch)
             x = self._embed_fwd(self.nl_params, ids)
-            fut = self._fetch_param_async(0)
-            for i in range(L):
-                bits = self._resolve_param(fut, i)
-                fut = self._fetch_param_async(i + 1) if i + 1 < L else None
+            for i, bits in self._stream_params(range(L)):
                 x = self._layer_fwd(bits, x, mask, None,
                                     jnp.int32(self.applied_steps),
                                     self._qbits(batch, i))
@@ -1327,5 +1598,6 @@ class InfinityExecutor:
 
     def close(self):
         self._drain_write()
-        self._pool.shutdown(wait=True)
+        self._rpool.shutdown(wait=True)
+        self._wpool.shutdown(wait=True)
         self.store.close()

@@ -60,10 +60,13 @@ class NVMeOptimizerSwapper:
                  chunk_elems: int = 1 << 24, aio_handle=None,
                  param_shardings=None, grad_shardings=None,
                  compute_dtype=jnp.bfloat16, pipeline: bool = True,
-                 host_inputs: bool = False, storage: str = "nvme"):
+                 host_inputs: bool = False, storage: str = "nvme",
+                 aio_config=None):
         """storage: "nvme" (AIO chunk files), "pinned" (TPU-host pinned
         DRAM buffers — the ZeRO-Offload device=cpu tier, same chunked
-        double-buffered step), or "host" (numpy buffers; CPU tests)."""
+        double-buffered step), or "host" (numpy buffers; CPU tests).
+        aio_config: the config ``aio`` section — block size + SEPARATE
+        read/write queue depths for the two io_uring rings."""
         self.mesh = mesh
         self.storage = storage
         self.b1, self.b2 = betas
@@ -103,18 +106,27 @@ class NVMeOptimizerSwapper:
                                      f"dstpu-optswap-{os.getpid()}")
             os.makedirs(self._dir, exist_ok=True)
             # Two handles: reads (prefetch thread) and writes (writeback
-            # thread) overlap; a handle serializes its ops (one ring each).
+            # thread) overlap; a handle serializes its ops (one ring each),
+            # and the config `aio` section sizes the two rings' queue
+            # depths independently (read_queue_depth / write_queue_depth).
             self._aio = aio_handle
             self._aio_w = aio_handle
             if aio_handle is None:
-                from deepspeed_tpu.ops.aio import AIOHandle, aio_available
+                from deepspeed_tpu.ops.aio import (AIOHandle, aio_available,
+                                                   report_fallback)
                 if aio_available():
-                    self._aio = AIOHandle()
-                    self._aio_w = AIOHandle()
+                    self._aio = AIOHandle.from_config(aio_config, "read")
+                    self._aio_w = AIOHandle.from_config(aio_config, "write")
                 else:  # pragma: no cover - only without a toolchain
-                    logger.warning("native aio unavailable; swapper falls "
-                                   "back to numpy file IO")
-        self._pool = ThreadPoolExecutor(max_workers=2) if pipeline else None
+                    # structured aio_fallback event: the monitor drains it
+                    # at the next window boundary — a swapper silently on
+                    # synchronous numpy IO is observable, not a log line
+                    report_fallback("optimizer-swapper")
+        # separate read/write pools: a queued write-behind must never delay
+        # the next chunk's prefetch behind it (the old shared 2-worker pool
+        # serialized exactly that under load)
+        self._pool = ThreadPoolExecutor(max_workers=1) if pipeline else None
+        self._wpool = ThreadPoolExecutor(max_workers=1) if pipeline else None
         # two host staging buffers for double-buffered file reads — only the
         # nvme tier stages through numpy (pinned/host return stored arrays)
         self._read_bufs = ([np.empty((_PLANES, c), np.float32)
@@ -243,8 +255,20 @@ class NVMeOptimizerSwapper:
             out_shardings=(buf_sh, flat_sh),
             donate_argnums=(0,))
         self._buf_sharding = buf_sh
-        self._pinned_sharding = NamedSharding(
-            mesh, P(None, *_flat_spec(mesh)), memory_kind="pinned_host")
+        # some CPU jaxlibs expose no pinned_host memory kind at all — only
+        # the pinned storage tier needs it, so degrade to the un-kinded
+        # sharding instead of failing every swapper construction (same
+        # fallback the infinity executor carries)
+        try:
+            self._pinned_sharding = NamedSharding(
+                mesh, P(None, *_flat_spec(mesh)), memory_kind="pinned_host")
+        except (ValueError, TypeError) as e:
+            if self.storage == "pinned":
+                raise
+            logger.warning(f"memory_kind='pinned_host' unsupported on this "
+                           f"backend ({e}); un-kinded sharding (no host "
+                           "tiering to defeat off-TPU)")
+            self._pinned_sharding = buf_sh
         self._init_buf = jax.jit(
             lambda ch: jnp.concatenate(
                 [ch[None], jnp.zeros((2, ch.shape[0]), jnp.float32)]),
@@ -337,7 +361,7 @@ class NVMeOptimizerSwapper:
             out_leaves: List = [None] * len(self._sizes)
             alive: Dict[int, object] = {}
             read_f = None
-            write_f = None
+            writes: List = []   # write-behind futures, double-buffered
             if self.pipeline and self._pool is not None:
                 read_f = self._pool.submit(self._read_file, 0, self._read_bufs[0])
             for i in range(self.n_chunks):
@@ -345,7 +369,11 @@ class NVMeOptimizerSwapper:
                     host = read_f.result()
                 else:
                     host = self._read_file(i, self._read_bufs[i % 2])
-                # prefetch next chunk while this one computes on device
+                # prefetch next chunk while this one computes on device —
+                # the read ring and the write ring are separate handles AND
+                # separate pools, so the three-way schedule
+                #   read(i+1)  ||  update(i) on device  ||  write(i-1)
+                # really runs all three legs concurrently
                 if self.pipeline and self._pool is not None and i + 1 < self.n_chunks:
                     read_f = self._pool.submit(
                         self._read_file, i + 1, self._read_bufs[(i + 1) % 2])
@@ -366,14 +394,18 @@ class NVMeOptimizerSwapper:
                           for ci, _, _ in segs if ci <= i}
                 for ci in [k for k in alive if k not in needed and k != i]:
                     del alive[ci]
-                if write_f is not None:
-                    write_f.result()  # bound in-flight writes to 1
-                if self.pipeline and self._pool is not None:
-                    write_f = self._pool.submit(self._writeback, i, new_buf)
+                if self.pipeline and self._wpool is not None:
+                    # bound in-flight writes to 2 (double buffer): chunk
+                    # i-1's write keeps flowing under chunk i's update
+                    # instead of the old drain-before-submit barrier
+                    while len(writes) >= 2:
+                        writes.pop(0).result()
+                    writes.append(self._wpool.submit(self._writeback, i,
+                                                     new_buf))
                 else:
                     self._writeback(i, new_buf)
-            if write_f is not None:
-                write_f.result()
+            for w in writes:
+                w.result()
             new_params = jax.tree.unflatten(self._treedef, out_leaves)
         return new_params, gnorm, False
 
@@ -405,9 +437,17 @@ class NVMeOptimizerSwapper:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+        if self._wpool is not None:
+            self._wpool.shutdown(wait=True)
+            self._wpool = None
         self._buffers.clear()
         if self._dir:
             shutil.rmtree(self._dir, ignore_errors=True)
+            # idempotent: the chunk dir is keyed by pid, so a later
+            # swapper in this process reuses the same path — a delayed
+            # __del__ re-running close() must not rmtree the successor's
+            # live directory out from under it
+            self._dir = None
 
     def __del__(self):  # pragma: no cover
         try:

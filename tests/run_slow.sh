@@ -24,6 +24,11 @@
 #   LATENCY_BUDGET=420 tests/run_slow.sh prefix_cache spec_decode  # the
 #       latency-frontier parity runs: warm-vs-cold prefix cache and
 #       spec-on-vs-off over full serving loads, bf16 + int8 (ISSUE 12)
+#   OFFLOAD_BUDGET=600 tests/run_slow.sh offload_pipeline  # ISSUE 14:
+#       pipelined-vs-drained bit-for-bit parity (3 engine pairs x 20 fp16
+#       steps, NVMe + tmpfs), mid-step read-fault recovery, and the
+#       offload-serial-pipeline audit twins (each builds a real executor
+#       with injected storage latency)
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -78,6 +83,10 @@ for m in "${modules[@]}"; do
         # serving engines and decodes full loads, budgeted together
         *test_prefix_cache*|*test_spec_decode*)
             budget="${LATENCY_BUDGET:-420}" ;;
+        # ISSUE-14 overlapped offload pipeline: bit-for-bit parity pairs
+        # (2 engines x 20 fp16 steps each, NVMe + tmpfs + native host-Adam
+        # variants) + the injected-latency audit twins
+        *test_offload_pipeline*) budget="${OFFLOAD_BUDGET:-600}" ;;
         # ISSUE-11 router chaos soak: a 2-replica mixed load under
         # replica kills + heartbeat-loss partitions + saturation storms,
         # compared bit-for-bit against a fault-free single-replica run —

@@ -1,0 +1,400 @@
+"""Overlapped offload pipeline (ISSUE 14): double-buffered layer streaming
++ the three-way read(i+1) || update(i) || write(i-1) sweep under io_uring
+AIO.
+
+The pipeline is a SCHEDULING change only — every float op runs in the same
+order either way — so the contract is bit-for-bit: the pipelined executor
+and the fully-drained twin must produce identical metrics and identical
+chunk-store bytes over 20 fp16 steps with a forced mid-run overflow (the
+PR-4/8 methodology), on both the NVMe-backed and tmpfs chunk paths; and a
+transient mid-step read failure injected at the nvme_*/aio_* seams must
+recover through retry_io with identical numerics. The lint face
+(offload-serial-pipeline / analysis.offload_lint) proves the drained shape
+trips the doctor's ``offload-overlap`` gate and the pipelined twin passes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# quick tier: pure-host doctor/plumbing checks (no engine builds)
+# ---------------------------------------------------------------------------
+
+class TestOffloadDoctor:
+    """profiling/doctor.py offload attribution: the offload-overlap rule."""
+
+    def _serial_decomp(self):
+        # the drained shape: 520 ms of io on a 1000 ms step whose measured
+        # compute is 480 ms — all 520 ms exposed (and dominant)
+        return {"offload_compute_ms": 300.0, "offload_update_sweep_ms": 100.0,
+                "offload_top_ms": 80.0, "offload_io_ms": 520.0,
+                "offload_dma_ms": 400.0, "offload_pipeline": False}
+
+    def test_gate_fires_on_serial_shape(self):
+        from deepspeed_tpu.profiling.doctor import (diagnose_offload,
+                                                    gate_offload)
+        diag = diagnose_offload(self._serial_decomp(), step_ms=1000.0)
+        assert diag["offload_compute_total_ms"] == 480.0
+        assert diag["offload_exposed_io_ms"] == 520.0
+        assert diag["offload_overlap_fraction"] == 0.0
+        assert diag["offload_dominant_phase"] == "exposed-io-stall"
+        report = gate_offload(diag)
+        assert not report.ok
+        (f,) = report.findings
+        assert f.rule == "offload-overlap"
+        assert f.data["stall"] == "host-io"
+        assert "pipeline_read" in f.message
+
+    def test_gate_passes_when_hidden(self):
+        from deepspeed_tpu.profiling.doctor import (diagnose_offload,
+                                                    gate_offload)
+        # pipelined shape: the step barely exceeds compute — io hidden
+        diag = diagnose_offload(self._serial_decomp(), step_ms=532.0)
+        assert diag["offload_overlap_fraction"] == 0.9
+        assert gate_offload(diag).ok
+        assert not gate_offload(diag, min_overlap=0.95).ok
+
+    def test_exposure_clamped_to_io_budget(self):
+        from deepspeed_tpu.profiling.doctor import diagnose_offload
+        # step way past compute + io: the excess is host overhead, not
+        # storage — exposure clamps at the io budget (fraction floors at 0)
+        diag = diagnose_offload(self._serial_decomp(), step_ms=5000.0)
+        assert diag["offload_exposed_io_ms"] == 520.0
+        assert diag["offload_overlap_fraction"] == 0.0
+
+    def test_gate_fails_closed_when_unpriced(self):
+        from deepspeed_tpu.profiling.doctor import (diagnose_offload,
+                                                    gate_offload)
+        # no step time anywhere: the gate must NOT certify a pipeline it
+        # never measured
+        diag = diagnose_offload(self._serial_decomp())
+        assert "offload_overlap_fraction" not in diag
+        report = gate_offload(diag)
+        assert not report.ok
+        assert report.findings[0].ident == "unpriced"
+
+    def test_offload_fields_extraction(self):
+        from deepspeed_tpu.profiling.doctor import (diagnose_offload,
+                                                    offload_fields)
+        diag = diagnose_offload(self._serial_decomp(), step_ms=1000.0)
+        fields = offload_fields(diag)
+        assert set(fields) == {"offload_overlap_fraction",
+                               "offload_exposed_io_ms", "offload_io_ms",
+                               "offload_dominant_phase"}
+
+    def test_doctor_cli_offload_decomp(self, tmp_path):
+        """CLI gate: --offload-decomp exits 1 on the serial shape, 0 on
+        the hidden one."""
+        bad = dict(self._serial_decomp(), offload_step_ms=1000.0)
+        good = dict(self._serial_decomp(), offload_step_ms=532.0)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+        env.pop("XLA_FLAGS", None)
+        rcs = {}
+        for name, decomp in (("bad", bad), ("good", good)):
+            p = tmp_path / f"{name}.json"
+            p.write_text(json.dumps(decomp))
+            rcs[name] = subprocess.run(
+                [sys.executable, "-m", "deepspeed_tpu.profiling.doctor",
+                 "--offload-decomp", str(p)],
+                env=env, capture_output=True, text=True).returncode
+        assert rcs == {"bad": 1, "good": 0}, rcs
+
+    def test_corpus_registry_has_offload_entry(self):
+        from deepspeed_tpu.analysis.corpus import CORPUS
+        assert "offload-serial-pipeline" in CORPUS
+
+
+class TestAIOPlumbing:
+    """Separate read/write queue depths + the aio_fallback event."""
+
+    def test_from_config_role_depths(self):
+        from deepspeed_tpu.config import AIOConfig
+        from deepspeed_tpu.ops.aio import AIOHandle, aio_available
+        if not aio_available():
+            pytest.skip("no g++/native build")
+        cfg = AIOConfig.from_dict({"block_size": 1 << 16, "queue_depth": 8,
+                                   "read_queue_depth": 16,
+                                   "write_queue_depth": 4})
+        r = AIOHandle.from_config(cfg, "read")
+        w = AIOHandle.from_config(cfg, "write")
+        assert (r.queue_depth, w.queue_depth) == (16, 4)
+        assert r.block_size == w.block_size == 1 << 16
+        # role depths unset: both rings take the USER-set queue_depth
+        cfg2 = AIOConfig.from_dict({"queue_depth": 8})
+        assert AIOHandle.from_config(cfg2, "read").queue_depth == 8
+        assert AIOHandle.from_config(cfg2, "write").queue_depth == 8
+        # a default-constructed aio section keeps the handle's own proven
+        # defaults (32/4) — wiring the config through must not silently
+        # downgrade a default-config run's IO parallelism to 8/1
+        cfg3 = AIOConfig.from_dict({})
+        h = AIOHandle.from_config(cfg3, "read")
+        assert (h.queue_depth, h.thread_count) == (32, 4)
+
+    def test_config_pipeline_defaults_on(self):
+        from deepspeed_tpu.config import AIOConfig, OffloadDeviceConfig
+        off = OffloadDeviceConfig()
+        assert off.pipeline_read and off.pipeline_write
+        aio = AIOConfig()
+        assert aio.read_queue_depth is None and aio.write_queue_depth is None
+
+    def test_aio_fallback_event_on_unavailable(self, tmp_path, monkeypatch):
+        """aio-unavailable is a STRUCTURED event through the monitor
+        stream, not a one-time log line."""
+        from deepspeed_tpu.robustness import events
+        from deepspeed_tpu.runtime.infinity import LayerStore
+        monkeypatch.setattr("deepspeed_tpu.ops.aio.aio_available",
+                            lambda: False)
+        events.clear()
+        store = LayerStore(str(tmp_path), n_layers=1, chunk_elems=128,
+                           backend="nvme")
+        try:
+            recs = events.history("aio_fallback")
+            assert recs and recs[-1]["component"] == "infinity-layer-store"
+        finally:
+            store.close()
+            events.clear()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: engine-level parity / fault recovery / corpus twins
+# ---------------------------------------------------------------------------
+
+def _cfg_dict(tmp, pipeline: bool, *, use_cpu_adam: bool = False,
+              scale_power: int = 8):
+    return {
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "fp16": {"enabled": True, "initial_scale_power": scale_power,
+                 "hysteresis": 1},
+        "bf16": {"enabled": False},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "nvme", "nvme_path": str(tmp),
+                              "pipeline_read": pipeline,
+                              "pipeline_write": pipeline},
+            "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp),
+                                  "use_cpu_adam": use_cpu_adam,
+                                  "pipeline_read": pipeline,
+                                  "pipeline_write": pipeline},
+        },
+        "steps_per_print": 1000000,
+    }
+
+
+def _model():
+    # deliberately small: fp16 compute is SOFTWARE-emulated on CPU XLA
+    # (~100x slower than bf16 at llama-tiny size) and the parity contract
+    # is about SCHEDULING, not model scale — hidden-64 exercises the exact
+    # same executor code paths at a wall cost the slow tier can afford
+    from deepspeed_tpu.models import TransformerConfig, make_model
+    return make_model(TransformerConfig(
+        vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+        max_seq_len=64, attention_impl="xla", loss_chunk=32), name="tiny")
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (4, 64), dtype=np.int32)}
+
+
+def _run_steps(engine, nsteps=20, poke_at=7):
+    """nsteps fp16 steps; at step ``poke_at`` the loss scale is poked to
+    2^24 (the PR-8 methodology for token-id inputs) forcing a
+    deterministic overflow burst + recovery on both arms."""
+    ex = engine._infinity_exec
+    out = []
+    for s in range(nsteps):
+        if s == poke_at:
+            ex._scale = 2.0 ** 24
+        m = engine.train_batch(_batch(seed=s))
+        out.append((float(m["loss"]), float(m["grad_norm"]),
+                    bool(m["overflow"]), float(m["loss_scale"])))
+    return out
+
+
+def _store_bytes(ex):
+    """Every layer's param bits + opt chunk, fetched from the store."""
+    out = []
+    for i in range(ex.cfg.num_layers):
+        out.append(np.asarray(ex.store.read_param(i)).copy())
+        opt = ex.store.read_opt(i)
+        out.append(None if opt is None else np.asarray(opt).copy())
+    return out
+
+
+@pytest.mark.slow
+class TestPipelineParity:
+    """Pipelined vs fully-drained offload is bit-for-bit identical: same
+    per-step metrics (incl. the forced-overflow skip/rescale) and the same
+    chunk-store bytes, on NVMe-backed and tmpfs paths, for both the
+    device-Adam and native host-Adam sweeps."""
+
+    def _parity(self, root_a, root_b, use_cpu_adam):
+        import deepspeed_tpu
+        if use_cpu_adam:
+            from deepspeed_tpu.ops.cpu_adam import cpu_adam_available
+            if not cpu_adam_available():
+                pytest.skip("native cpu_adam toolchain unavailable")
+        e1, *_ = deepspeed_tpu.initialize(
+            model=_model(),
+            config=_cfg_dict(root_a, True, use_cpu_adam=use_cpu_adam))
+        e2, *_ = deepspeed_tpu.initialize(
+            model=_model(),
+            config=_cfg_dict(root_b, False, use_cpu_adam=use_cpu_adam))
+        assert e1._infinity_exec.pipeline is True
+        assert e2._infinity_exec.pipeline is False
+        m1 = _run_steps(e1)
+        m2 = _run_steps(e2)
+        # exact float equality, NaN-aware (the overflow step's grad_norm
+        # is NaN by contract and NaN != NaN under tuple equality)
+        np.testing.assert_array_equal(np.asarray(m1, np.float64),
+                                      np.asarray(m2, np.float64))
+        # the overflow burst actually happened (else the test proves less)
+        assert any(o for _, _, o, _ in m1)
+        assert any(not o for _, _, o, _ in m1[8:])
+        s1, s2 = _store_bytes(e1._infinity_exec), _store_bytes(
+            e2._infinity_exec)
+        for a, b in zip(s1, s2):
+            if a is None or b is None:
+                assert a is None and b is None
+            else:
+                np.testing.assert_array_equal(a, b)
+        e1._infinity_exec.close()
+        e2._infinity_exec.close()
+
+    def test_nvme_device_adam(self, tmp_path):
+        self._parity(tmp_path / "a", tmp_path / "b", use_cpu_adam=False)
+
+    def test_nvme_native_host_adam(self, tmp_path):
+        self._parity(tmp_path / "a", tmp_path / "b", use_cpu_adam=True)
+
+    def test_tmpfs_native_host_adam(self, tmp_path):
+        shm = "/dev/shm"
+        if not (os.path.isdir(shm) and os.access(shm, os.W_OK)):
+            pytest.skip("no writable tmpfs at /dev/shm")
+        import tempfile
+        root = tempfile.mkdtemp(dir=shm, prefix="dstpu-offload-")
+        try:
+            self._parity(os.path.join(root, "a"), os.path.join(root, "b"),
+                         use_cpu_adam=True)
+        finally:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+
+
+@pytest.mark.slow
+class TestFaultRecovery:
+    """A transient mid-step read failure at the nvme_*/aio_* seams
+    recovers through retry_io with numerics identical to the fault-free
+    run (and a fault_recovered event on the stream)."""
+
+    def test_mid_step_read_fault_recovers_identically(self, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.robustness import events, faults
+        ref, *_ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg_dict(tmp_path / "ref", True))
+        m_ref = _run_steps(ref, nsteps=6, poke_at=99)
+        ref._infinity_exec.close()
+
+        events.clear()
+        # whichever read path is active fires (aio_read with the native
+        # build, nvme_read on the numpy fallback); times=2 < retry_io's 4
+        # attempts, so the fault is transient and MUST be absorbed
+        sched = faults.FaultSchedule([
+            {"kind": "io_error", "op": "aio_read", "at": 3, "times": 2,
+             "errno": "EIO"},
+            {"kind": "io_error", "op": "nvme_read", "at": 3, "times": 2,
+             "errno": "EIO"},
+        ])
+        injector = faults.install(faults.FaultInjector(sched))
+        try:
+            got, *_ = deepspeed_tpu.initialize(
+                model=_model(), config=_cfg_dict(tmp_path / "got", True))
+            m_got = _run_steps(got, nsteps=6, poke_at=99)
+            got._infinity_exec.close()
+            assert injector.fired, "scheduled read fault never fired"
+            assert events.history("fault_recovered"), \
+                "transient read fault was not retried"
+        finally:
+            faults.install(None)
+            events.clear()
+        assert m_got == m_ref, (m_got, m_ref)
+
+
+@pytest.mark.slow
+class TestOffloadCorpusTwins:
+    """offload-serial-pipeline: the drained executor trips the doctor's
+    offload-overlap gate (host-stall dominant); the pipelined twin passes.
+    (CLI: python -m deepspeed_tpu.analysis.offload_lint [--pipelined];
+    seeded via analysis.lint --corpus offload-serial-pipeline.)"""
+
+    def test_serial_fires(self):
+        from deepspeed_tpu.analysis.offload_lint import audit_offload
+        report = audit_offload(pipeline=False)
+        assert not report.ok
+        assert {f.rule for f in report.findings} == {"offload-overlap"}
+        (f,) = report.findings
+        assert f.data["stall"] == "host-io"
+        assert f.data["offload_overlap_fraction"] < 0.5
+
+    def test_pipelined_twin_passes(self):
+        from deepspeed_tpu.analysis.offload_lint import audit_offload
+        report = audit_offload(pipeline=True)
+        assert report.ok, [f.message for f in report.findings]
+
+
+@pytest.mark.slow
+class TestSwapperPipeline:
+    """NVMeOptimizerSwapper: the double-buffered write-behind + separate
+    read/write rings change nothing numerically vs the drained swapper."""
+
+    def test_pipelined_vs_drained_identical(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        from deepspeed_tpu.runtime.swap_tensor import NVMeOptimizerSwapper
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        tmpl = {"w": jnp.zeros((256, 128), jnp.float32),
+                "b": jnp.zeros((97,), jnp.float32)}
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.standard_normal((256, 128)),
+                                   jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal((97,)), jnp.float32)}
+        grads = {"w": jnp.asarray(rng.standard_normal((256, 128)),
+                                  jnp.float32),
+                 "b": jnp.asarray(rng.standard_normal((97,)), jnp.float32)}
+
+        def run(pipeline):
+            root = tmp_path / ("pipe" if pipeline else "drained")
+            root.mkdir(exist_ok=True)
+            sw = NVMeOptimizerSwapper(
+                tmpl, mesh=mesh, nvme_path=str(root),
+                chunk_elems=4096,    # several chunks: the pipeline engages
+                compute_dtype=jnp.float32, pipeline=pipeline)
+            sw.initialize(params)
+            p = params
+            for s in range(1, 4):
+                p, gnorm, ovf = sw.step(grads, lr=1e-3, step_num=s)
+                assert not ovf
+            state = sw.export_state()
+            sw.close()
+            return p, gnorm, state
+
+        p1, g1, s1 = run(True)
+        p2, g2, s2 = run(False)
+        assert g1 == g2
+        for k in p1:
+            np.testing.assert_array_equal(np.asarray(p1[k]),
+                                          np.asarray(p2[k]))
+        for k in s1:
+            np.testing.assert_array_equal(s1[k], s2[k])
