@@ -1318,7 +1318,13 @@ def _serving_bench(size: str, n_requests: int = 32,
         "serve_tok_per_sec_bs32_mixed": round(
             st.get("generated_tokens", 0.0) / serve_dt, 1),
         "serve_preemptions": int(st.get("preemptions", 0)),
+        # PER-DEVICE pool shard (ISSUE 15 fix: the old number was the
+        # logical pool — on a tp-sharded engine that overstated HBM by
+        # the tp degree); the logical size rides alongside, and the
+        # active mesh is recorded so the SLO numbers say what they ran on
         "serve_pool_bytes": int(st.get("pool_bytes", 0)),
+        "serve_pool_bytes_logical": int(st.get("pool_bytes_logical", 0)),
+        "serve_mesh": srv.mesh_desc,
         "serve_decode_backend": srv.decode_backend,
     }
     for k, v in srv.backend_bench.items():

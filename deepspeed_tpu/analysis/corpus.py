@@ -508,6 +508,113 @@ def paged_cache_leak(devices=None):
         settings=AnalysisSettings(max_hbm_bytes=PAGED_LEAK_BUDGET))
 
 
+# Exact census of the tp=2 paged decode quantum step (the ISSUE 15 pin,
+# measured on jax 0.4.37 — re-measure BOTH twins before retuning):
+#   all-reduce x3, 1024 B each: the scanned layer body's TWO row-parallel
+#     out-projections (attn wo + MLP w_out — the only per-layer cross-chip
+#     reductions) + ONE for the token-embedding gather over the
+#     vocab-sharded table;
+#   all-gather x2, 32 B each: the greedy argmax's cross-shard
+#     (value, index) exchange at the vocab-sharded lm head.
+# The POOL SCATTER contributes ZERO collectives: each chip writes its own
+# kv-head slice of the fresh rows in place. A pool accidentally replicated
+# across `tensor` shows up as census DRIFT (the fresh rows all-gather
+# before the scatter) on top of the replication/memory findings.
+TP_SERVE_CENSUS = {"all-reduce": 3, "all-gather": 2}
+# between the twins: modeled per-device peaks ~583 KiB (head-sharded pool)
+# vs ~1.72 MiB (replicated pool) on jax 0.4.37 — the 1 MiB budget sits
+# between (same re-measure protocol as remat-missing)
+TP_SERVE_POOL_BUDGET = 1 << 20
+
+
+class _FakeTPPlan(_FakePlan):
+    data, tensor = 1, 2
+
+    def describe(self):
+        return "corpus[tensor=2]"
+
+
+def tp_serving_pool_report(shard_pool: bool, devices=None):
+    """Lower the serving tier's tp=2 paged decode step (decode_step_paged
+    + greedy argmax) over a 2-device `tensor` mesh — weights in the
+    Megatron col/row layout (make_rules), the KV block pool either
+    head-sharded per ``paged_cache_logical_axes`` (the correct twin) or
+    REPLICATED across `tensor` (the planted defect) — and audit it with
+    the exact ISSUE-15 census pin + replication/memory budgets."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  make_model)
+    from deepspeed_tpu.parallel import make_rules, spec_tree
+
+    devs = devices or jax.devices()[:2]
+    if len(devs) < 2:
+        raise SystemExit("corpus: needs >= 2 devices "
+                         "(--xla_force_host_platform_device_count)")
+    mesh = Mesh(list(devs)[:2], ("tensor",))
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=256,
+                            dtype=jnp.float32, attention_impl="xla")
+    model = make_model(cfg, name="tiny-serve-tp")
+    S, MB, bs, NB = 4, 4, 32, 33
+    rules = make_rules(zero_stage=0, tp=True)
+
+    def with_specs(tree, spec_t):
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        specs = treedef.flatten_up_to(spec_t)
+        return treedef.unflatten([
+            jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                 sharding=NamedSharding(mesh, s))
+            for l, s in zip(flat, specs)])
+
+    params = with_specs(jax.eval_shape(model.init, jax.random.PRNGKey(0)),
+                        spec_tree(model.logical_axes, rules))
+    pools_a = jax.eval_shape(lambda: model.init_paged_cache(NB, bs))
+    pool_spec = (spec_tree(model.paged_cache_axes(), rules) if shard_pool
+                 else jax.tree.map(lambda _: P(), pools_a))
+    pools = with_specs(pools_a, pool_spec)
+    toks = jax.ShapeDtypeStruct((S,), jnp.int32)
+    tables = jax.ShapeDtypeStruct((S, MB), jnp.int32)
+    lens = jax.ShapeDtypeStruct((S,), jnp.int32)
+
+    def step(params, pools, tokens, tables, lens):
+        logits, pools = model.decode_step_paged(params, tokens, pools,
+                                                tables, lens, backend="xla")
+        return jnp.argmax(logits, -1).astype(jnp.int32), pools
+
+    name = ("serve_decode_step_tp2" if shard_pool
+            else "serve_decode_step_tp2_replpool")
+    art = lower_program(
+        jax.jit(step, donate_argnums=(1,)), params, pools, toks, tables,
+        lens, name=name, mesh=mesh, donatable={"pools": pools},
+        donation_expected=False,
+        meta={"skip_required": True, "world_size": 2})
+    return analyze_programs(
+        [art], _stage0_config(), _FakeTPPlan(),
+        settings=AnalysisSettings(
+            expect_collectives=dict(TP_SERVE_CENSUS),
+            # the pool tensors are ~270 KiB each on this toy rung: drop the
+            # replication floor below them so the replicated twin's pool
+            # (540 KiB across k+v) is in scope
+            min_replicated_bytes=256 << 10,
+            max_hbm_bytes=TP_SERVE_POOL_BUDGET))
+
+
+def tp_serving_replicated_pool(devices=None):
+    """Pod-serving audit: the tp=2 paged decode step whose KV block pool
+    was accidentally REPLICATED across the `tensor` axis — each chip pays
+    the full logical pool (the per-device peak blows the budget:
+    `memory-peak`), the replicated pool tensors blow the replication
+    budget (`replication-over-budget`), and the fresh-row scatter now
+    all-gathers the head-sharded rows before writing (census drift against
+    the exact TP_SERVE_CENSUS pin). The correctly head-sharded twin
+    (``tp_serving_pool_report(shard_pool=True)``) passes the identical
+    settings — tests assert both directions; both CLI-runnable
+    (``lint --corpus tp-serving-replicated-pool``)."""
+    return tp_serving_pool_report(shard_pool=False, devices=devices)
+
+
 def serving_unbounded_queue(devices=None):
     """Admission audit: the serving scheduler configured with NO admission
     watermark under a sustained exhaustion storm — every arrival queues,
@@ -589,6 +696,7 @@ CORPUS = {
     "remat-missing": remat_missing,
     "stage3-replicated-opt": stage3_replicated_opt,
     "paged-cache-leak": paged_cache_leak,
+    "tp-serving-replicated-pool": tp_serving_replicated_pool,
     "serving-unbounded-queue": serving_unbounded_queue,
     "router-blackhole": router_blackhole,
     "prefix-refcount-leak": prefix_refcount_leak,

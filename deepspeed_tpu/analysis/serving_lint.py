@@ -222,7 +222,8 @@ class _StubReplica:
             pass     # mirror ReplicaHandle.step: a store-write hiccup
         return out   # must never drop the round's completed work
 
-    def accept_migration(self, recs, rng_counter=None, source=None):
+    def accept_migration(self, recs, rng_counter=None, source=None,
+                         geometry=None):
         rids = [int(r["rid"]) for r in recs]
         now = self._clock()
         self._q.extend((rid, now) for rid in rids)

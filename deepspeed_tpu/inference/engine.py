@@ -56,6 +56,12 @@ def init_inference(model, config=None, mesh=None, dtype=None, params=None,
 class InferenceConfig:
     """Reference: ``deepspeed/inference/config.py:125``."""
     tensor_parallel: int = 1
+    # expert parallelism for MoE serving (ISSUE 15): the stacked expert dim
+    # of the MoE FFN weights shards over the `expert` mesh axis (the
+    # reference's expert-parallel groups, utils/groups.py); GSPMD inserts
+    # the dispatch/combine all-to-alls at the token<->expert resharding.
+    # Needs a MoE model whose num_experts divides by the degree.
+    expert_parallel: int = 1
     dtype: Any = None
     max_tokens: int = 1024
     max_batch_size: int = 8
@@ -85,16 +91,35 @@ class InferenceEngine:
         self.model = model
         self.config = config
         tp = max(1, config.tensor_parallel)
+        ep = max(1, getattr(config, "expert_parallel", 1) or 1)
         n_dev = jax.device_count()
         if mesh is None:
-            if n_dev % tp != 0:
-                raise ValueError(f"tp={tp} does not divide device count {n_dev}")
-            plan = MeshPlan(data=n_dev // tp, tensor=tp)
+            if n_dev % (tp * ep) != 0:
+                raise ValueError(f"tp={tp} x ep={ep} does not divide "
+                                 f"device count {n_dev}")
+            plan = MeshPlan(data=n_dev // (tp * ep), expert=ep, tensor=tp)
             mesh = build_mesh(plan)
+        else:
+            # mesh-native: an explicit mesh is authoritative for the
+            # parallel degrees — a config degree that CONTRADICTS it is a
+            # caller bug (sharding rules built from the config degree would
+            # silently replicate what the mesh was built to shard)
+            mesh_tp = mesh.shape.get("tensor", 1)
+            mesh_ep = mesh.shape.get("expert", 1)
+            if config.tensor_parallel > 1 and mesh_tp != tp:
+                raise ValueError(f"tensor_parallel={tp} but the mesh's "
+                                 f"tensor axis has size {mesh_tp}")
+            if ep > 1 and mesh_ep != ep:
+                raise ValueError(f"expert_parallel={ep} but the mesh's "
+                                 f"expert axis has size {mesh_ep}")
+            tp, ep = mesh_tp, mesh_ep
         self.mesh = mesh
+        self.tp = tp
+        self.ep = ep
         from deepspeed_tpu.parallel.context import set_parallel_context
         from deepspeed_tpu.parallel import MeshPlan as _MP
         self._plan = _MP(data=mesh.shape.get("data", 1),
+                         expert=mesh.shape.get("expert", 1),
                          tensor=mesh.shape.get("tensor", 1))
         set_parallel_context(mesh, self._plan)
         self.dtype = config.dtype or jnp.bfloat16
@@ -104,6 +129,27 @@ class InferenceEngine:
         self._quantized = bool(config.quantize_bits)
         from deepspeed_tpu.models.transformer import TransformerConfig
         is_tf = isinstance(getattr(model, "config", None), TransformerConfig)
+        if ep > 1:
+            n_exp = getattr(getattr(model, "config", None),
+                            "num_experts", 1) or 1
+            if n_exp <= 1:
+                if config.expert_parallel > 1:
+                    raise ValueError(
+                        f"expert_parallel={ep} needs a MoE model "
+                        "(num_experts > 1) — the expert axis shards the "
+                        "stacked expert dim of the MoE FFN weights")
+                # the expert axis came from a SHARED mesh, not a request:
+                # a dense model simply has no "expert" logical axis, so
+                # nothing shards over it — same as before the axis was
+                # adopted (a training mesh reused for dense inference
+                # must not crash)
+                ep = 1
+                self.ep = 1
+            elif n_exp % ep:
+                raise ValueError(
+                    f"expert_parallel={ep} does not divide "
+                    f"num_experts={n_exp}: each chip must hold a whole "
+                    "expert slice")
 
         # int8 KV cache: the ModelSpec closures capture the config, so flip
         # the flag by REBUILDING the spec before the quantize/fuse branches

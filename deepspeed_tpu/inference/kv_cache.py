@@ -156,11 +156,17 @@ def blocks_for(n_tokens: int, block_size: int) -> int:
 
 
 def pool_bytes(cfg, num_blocks: int, block_size: int, dtype=None) -> int:
-    """Resident bytes of the block pools for a transformer config — the
-    paged-cache memory math the README documents and the serving bench
-    reports. int8: 1 byte/elem payload + 4 bytes/row/head scale x2 (k, v);
-    float: itemsize of the POOL dtype x2 — pass the engine's compute dtype
-    (the pools are allocated with it, which may differ from cfg.dtype)."""
+    """LOGICAL resident bytes of the block pools for a transformer config
+    — the paged-cache memory math the README documents. int8: 1 byte/elem
+    payload + 4 bytes/row/head scale x2 (k, v); float: itemsize of the
+    POOL dtype x2 — pass the engine's compute dtype (the pools are
+    allocated with it, which may differ from cfg.dtype).
+
+    On a tensor-parallel serving mesh each chip holds only its kv-head
+    slice: the PER-DEVICE number — what ``ServingEngine.pool_bytes`` /
+    ``stats()["pool_bytes"]`` report — is this divided by the tp degree
+    (``parallel.partitioning.sharded_bytes`` prices it from the committed
+    shardings; the memory-law test pins per_device * tp == logical)."""
     L, nkv, hd = cfg.num_layers, cfg.kv_heads, cfg.dim_per_head
     rows = L * num_blocks * nkv * block_size
     if cfg.kv_cache_bits == 8:

@@ -158,7 +158,13 @@ class ReplicaHandle:
 
     def meta(self) -> Dict[str, Any]:
         """The heartbeat payload's routing half: what a remote router
-        needs to rank this replica without touching it."""
+        needs to rank this replica without touching it. Carries the mesh
+        topology (tp/ep degrees, ISSUE 15): ``_survivor_order`` ranks
+        geometry-matched survivors first during a failover (a mismatched
+        one refuses drain-origin records typed anyway — the ordering
+        skips the wasted round-trips) and an operator can see which
+        replicas are pod-sharded; old no-meta/no-topology heartbeats
+        interop (the schema satellite's contract)."""
         sched = self.engine.scheduler
         return {"role": "replica",
                 "queue_depth": int(sched.num_waiting),
@@ -166,7 +172,9 @@ class ReplicaHandle:
                 "capacity": self.capacity,
                 "pool_free": round(
                     1.0 - self.engine.allocator.used_fraction, 4),
-                "draining": bool(self.engine._draining)}
+                "draining": bool(self.engine._draining),
+                "tp": int(getattr(self.engine, "tp", 1)),
+                "ep": int(getattr(self.engine, "ep", 1))}
 
     def publish(self) -> None:
         if self.dead or self.mute_heartbeat:
@@ -213,9 +221,11 @@ class ReplicaHandle:
             pass
         return finished
 
-    def accept_migration(self, recs, rng_counter=None, source=None):
+    def accept_migration(self, recs, rng_counter=None, source=None,
+                         geometry=None):
         return self.engine.accept_migration(recs, rng_counter=rng_counter,
-                                            source=source)
+                                            source=source,
+                                            geometry=geometry)
 
     def new_cancelled(self) -> List[Request]:
         cur = self.engine.cancelled
@@ -612,12 +622,33 @@ class ServingRouter:
             rep.drain_dir, deep=False,
             exclude=self._stale_tags.get(rep.name, ()))
 
-    def _survivor_order(self, exclude: str) -> List[Any]:
-        """Migration targets, best first: CLOSED by load, then HALF_OPEN,
-        then OPEN-but-alive (placing on a degraded survivor beats losing
-        the request; its breaker still blocks NEW admissions)."""
+    def _survivor_order(self, exclude: str,
+                        geometry: Optional[Dict[str, Any]] = None
+                        ) -> List[Any]:
+        """Migration targets, best first: geometry-matched (the drained
+        tp/ep degrees vs each survivor's heartbeat meta — a mismatched
+        survivor would refuse drain-origin records with
+        ``ResumeIncompatible`` anyway, so trying it first just wastes a
+        round-trip), then CLOSED by load, then HALF_OPEN, then
+        OPEN-but-alive (placing on a degraded survivor beats losing the
+        request; its breaker still blocks NEW admissions). Survivors
+        whose meta predates the topology fields rank as matched — the
+        typed refusal is still the arbiter, ordering is only a hint."""
         state_rank = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
                       BREAKER_OPEN: 2}
+        want_tp = want_ep = None
+        if geometry is not None:
+            want_tp, want_ep = geometry.get("tp"), geometry.get("ep")
+
+        def mismatch(name: str) -> int:
+            meta = (self._info.get(name) or {}).get("meta") or {}
+            for want, key in ((want_tp, "tp"), (want_ep, "ep")):
+                got = meta.get(key)
+                if want is not None and got is not None \
+                        and int(got) != int(want):
+                    return 1
+            return 0
+
         out = []
         for i, (name, rep) in enumerate(self.replicas.items()):
             if name == exclude or rep.dead:
@@ -625,10 +656,10 @@ class ServingRouter:
             br = self._breaker[name]
             if br["state"] == BREAKER_DEAD:
                 continue
-            out.append((state_rank.get(br["state"], 2),
+            out.append((mismatch(name), state_rank.get(br["state"], 2),
                         self._load_score(name, rep), i, rep))
-        out.sort(key=lambda t: t[:3])
-        return [rep for _, _, _, rep in out]
+        out.sort(key=lambda t: t[:4])
+        return [rep for *_, rep in out]
 
     def _failover(self, rep, tag: Optional[str] = None) -> None:
         """Failover episode for a confirmed-dead replica: resume its
@@ -687,17 +718,34 @@ class ServingRouter:
             self._counters["resubmitted"] += 1
         migrated = lost = 0
         lost_recs: List[Dict[str, Any]] = []
-        survivors = self._survivor_order(exclude=rep.name)
+        # drain-origin records prefer geometry-matched survivors (a
+        # mismatched one refuses them typed anyway); resubmit-origin
+        # records regenerate from scratch with NO geometry constraint —
+        # they keep the plain health/load order, never skipping a
+        # healthy idle survivor for a mesh it doesn't care about
+        survivors = self._survivor_order(exclude=rep.name,
+                                         geometry=drained_engine)
+        survivors_resubmit = (self._survivor_order(exclude=rep.name)
+                              if drained_engine is not None else survivors)
         for rec in recs:
             rid = int(rec["rid"])
             origin = rec.pop("_origin", "drain")
             placed = None
-            for target in survivors:
+            for target in (survivors if origin == "drain"
+                           else survivors_resubmit):
                 try:
-                    target.accept_migration([rec], rng_counter=rng_counter,
-                                            source=rep.name)
+                    # drain-origin records carry the drained engine's
+                    # geometry: a mesh-mismatched survivor refuses typed
+                    # (continuation determinism is per-geometry) and the
+                    # next one is tried. Resubmit-origin records
+                    # regenerate from scratch on whatever mesh accepts
+                    # them — no geometry to honor.
+                    target.accept_migration(
+                        [rec], rng_counter=rng_counter, source=rep.name,
+                        geometry=(drained_engine if origin == "drain"
+                                  else None))
                 except ResumeIncompatible:
-                    continue          # too small for this one: next
+                    continue          # too small / wrong mesh: next
                 placed = target
                 break
             if placed is None:

@@ -57,6 +57,22 @@ one ``decode_span_paged`` pass with greedy output parity. All three are
 default-off and compose with the reliability tier: recoveries clear the
 cache with the pool they rebuild, drains serialize mid-chunk prefills,
 and resume/migration re-prefills THROUGH the cache.
+
+Pod-scale serving (ISSUE 15 — see README "Pod-scale serving"): the engine
+is mesh-native. Under **tensor parallelism** the paged block pools
+``[L, NB, nkv, block_size, hd]`` shard on the kv-head dim over the
+``tensor`` mesh axis via the same Megatron col/row rules the weights use
+(``paged_cache_logical_axes``), every decode/prefill/span program pins its
+pool output to that sharding, and the per-layer out-projection reductions
+are the only cross-chip collectives (census-pinned by graft-lint; the
+``tp-serving-replicated-pool`` corpus entry plants the drift defect).
+**Expert parallelism** shards the MoE FFN expert stacks over the
+``expert`` axis (``InferenceConfig.expert_parallel``) with the existing
+``moe/`` dispatch inserting the all-to-alls. The host side — allocator,
+scheduler, prefix cache, block ids — stays UNSHARDED replicated metadata,
+so CoW/chunked-prefill/spec-decode compose unchanged (parity-pinned).
+Drains record the mesh topology and resume/migration refuse a
+mesh-incompatible placement with the typed ``ResumeIncompatible``.
 """
 
 import dataclasses
@@ -255,6 +271,27 @@ class ServingEngine:
                              "protocol (models/transformer make_model)")
         self.model = model
         mcfg = model.config
+        # --- mesh geometry (ISSUE 15: pod-scale serving) ---------------
+        # the engine's mesh is authoritative: tensor parallelism shards
+        # the KV block pools on the kv-head dim (paged_cache_logical_axes
+        # "heads" -> the Megatron col/row rules), expert parallelism
+        # shards the MoE FFN stacks. Both recorded here so drains,
+        # heartbeats and migrations can carry the topology.
+        # read the ENGINE's resolved degrees, not the raw mesh shape: a
+        # dense model on a shared mesh that happens to carry an expert
+        # axis has ep degraded to 1 (nothing shards over it), and the
+        # drain/heartbeat topology must say so — advertising the unused
+        # axis would spuriously refuse migrations to dense survivors
+        self.tp = int(getattr(engine, "tp",
+                              engine.mesh.shape.get("tensor", 1)))
+        self.ep = int(getattr(engine, "ep",
+                              engine.mesh.shape.get("expert", 1)))
+        nkv = getattr(mcfg, "kv_heads", None)
+        if self.tp > 1 and nkv is not None and nkv % self.tp:
+            raise ValueError(
+                f"tensor parallel degree {self.tp} does not divide "
+                f"kv_heads={nkv}: the paged block pools shard on the "
+                "kv-head dim, so each chip must hold a whole head slice")
         if c.block_size < 8 or c.block_size % 8:
             raise ValueError(f"block_size={c.block_size}: TPU tiling needs "
                              "a multiple of 8")
@@ -338,6 +375,14 @@ class ServingEngine:
             self._proposer = make_proposer(c.spec_proposer, c.spec_ngram)
 
         # device state -------------------------------------------------
+        # Pool shardings come from the SAME col/row rules the weights use:
+        # paged_cache_logical_axes maps the kv-head dim to "heads", which
+        # the engine's rules put on the `tensor` mesh axis — each chip
+        # holds its head-slice of EVERY block, block ids stay replicated
+        # host metadata. Every jitted serving program below pins its pool
+        # output to these shardings (out_shardings), so the pool layout
+        # can never silently drift to replicated mid-serve (the
+        # `tp-serving-replicated-pool` corpus defect).
         axes = (model.paged_cache_axes()
                 if model.paged_cache_axes is not None else None)
         if axes is not None:
@@ -347,6 +392,7 @@ class ServingEngine:
                 is_leaf=lambda x: isinstance(x, P))
         else:
             self._pool_shardings = None
+        self._repl_sharding = NamedSharding(engine.mesh, P())
         # fresh-pool program cached: fault recovery rebuilds the pool with
         # the same jitted init the constructor uses
         self._init_pools_fn = jax.jit(
@@ -355,8 +401,16 @@ class ServingEngine:
             out_shardings=self._pool_shardings)
         with engine.mesh:
             self.pools = self._init_pools_fn()
-        self.pool_bytes = pool_bytes(mcfg, num_blocks, c.block_size,
-                                     dtype=engine.dtype)
+        # logical pool size (the README memory math, mesh-independent) vs
+        # the PER-DEVICE shard each chip actually holds: on a tp-sharded
+        # engine the resident HBM is logical / tp (the kv-head slice), and
+        # pool_bytes — what stats()/bench report — must price THAT, not
+        # the logical array (ISSUE 15: the old single number overstated
+        # HBM by the tp degree on sharded engines)
+        self.pool_bytes_logical = pool_bytes(mcfg, num_blocks, c.block_size,
+                                             dtype=engine.dtype)
+        from deepspeed_tpu.parallel.partitioning import sharded_bytes
+        self.pool_bytes = sharded_bytes(self.pools)
         self._tokens = jnp.zeros((c.max_seqs,), jnp.int32)
         self._requests: Dict[int, Request] = {}
         self._finished: List[Request] = []
@@ -366,11 +420,12 @@ class ServingEngine:
         self._quantum_step = None
         self._spec_step = None
         # one tiny program copies a block in place for the CoW fork — its
-        # shape is the pool's, so it compiles once
+        # shape is the pool's, so it compiles once (per-shard copy: the
+        # block index walks the unsharded NB dim, no collective)
         self._copy_block_fn = jax.jit(
             lambda pools, src, dst: jax.tree.map(
                 lambda a: a.at[:, dst].set(a[:, src]), pools),
-            donate_argnums=(0,))
+            donate_argnums=(0,), out_shardings=self._pool_shardings)
         self._rng_counter = 0
         self._stats_t0: Optional[float] = None
         # latency-frontier counters (reset_stats windows)
@@ -398,6 +453,40 @@ class ServingEngine:
 
         # backend micro-bench (one-time, on the REAL pool shapes) --------
         self.decode_backend, self.backend_bench = self._select_backend()
+
+    # ---- mesh geometry -----------------------------------------------
+
+    @property
+    def mesh_desc(self) -> str:
+        """Human/JSON mesh label, e.g. "tensor=2" / "expert=4" / "single"
+        — what the bench records next to the SLO numbers."""
+        axes = {k: int(v) for k, v in self.engine.mesh.shape.items()
+                if int(v) > 1}
+        return "x".join(f"{k}={v}" for k, v in axes.items()) or "single"
+
+    def _check_geometry(self, eng: Optional[Dict[str, Any]],
+                        source: Optional[str] = None) -> None:
+        """Refuse restoring work drained on a DIFFERENT mesh geometry.
+        The byte-identical-continuation contract is per-geometry: the
+        drained request's already-emitted tokens were argmaxes of the
+        drained mesh's float program, and a different tp/ep degree
+        regroups the out-projection reductions (different float
+        reordering) — a continuation there is best-effort, not the
+        guarantee resume()/accept_migration promise. Records that predate
+        the geometry fields (pre-ISSUE-15 drains) pass: their engines
+        were single-chip and so is the ambiguity."""
+        if eng is None:
+            return
+        want_tp, want_ep = eng.get("tp"), eng.get("ep")
+        src = f" (drained by {source})" if source else ""
+        if want_tp is not None and int(want_tp) != self.tp or \
+                want_ep is not None and int(want_ep) != self.ep:
+            raise ResumeIncompatible(
+                f"drained state{src} came from a tp={want_tp} ep={want_ep} "
+                f"engine; this engine is tp={self.tp} ep={self.ep} — "
+                "byte-identical continuation is only guaranteed on a "
+                "matching mesh geometry (place it on a survivor with the "
+                "same tp/ep degrees)")
 
     # ---- shape bucketing ---------------------------------------------
 
@@ -499,7 +588,9 @@ class ServingEngine:
                     params, ids, pools, block_ids, length=length)
                 return self._sample(last, key), pools
 
-            fn = jax.jit(prefill, donate_argnums=(2,))
+            outs = ((self._repl_sharding, self._pool_shardings)
+                    if self._pool_shardings is not None else None)
+            fn = jax.jit(prefill, donate_argnums=(2,), out_shardings=outs)
             self._prefill_fns[P] = fn
         return fn
 
@@ -523,7 +614,11 @@ class ServingEngine:
                 nxt = jnp.where(active, nxt, tokens)
                 return pools, nxt, seq_lens + active.astype(jnp.int32)
 
-            self._quantum_step = jax.jit(step, donate_argnums=(1, 4))
+            r = self._repl_sharding
+            outs = ((self._pool_shardings, r, r)
+                    if self._pool_shardings is not None else None)
+            self._quantum_step = jax.jit(step, donate_argnums=(1, 4),
+                                         out_shardings=outs)
         return self._quantum_step
 
     def _get_spec_step(self):
@@ -550,7 +645,11 @@ class ServingEngine:
                     active, acc + 1, 0).astype(jnp.int32)
                 return pools, nxt, acc, pend, new_lens
 
-            self._spec_step = jax.jit(step, donate_argnums=(1,))
+            r = self._repl_sharding
+            outs = ((self._pool_shardings, r, r, r, r)
+                    if self._pool_shardings is not None else None)
+            self._spec_step = jax.jit(step, donate_argnums=(1,),
+                                      out_shardings=outs)
         return self._spec_step
 
     def _proposals_device(self):
@@ -690,7 +789,9 @@ class ServingEngine:
                                                     keepdims=False)
                 return self._sample(last[None], key), pools
 
-            fn = jax.jit(chunk, donate_argnums=(2,))
+            outs = ((self._repl_sharding, self._pool_shardings)
+                    if self._pool_shardings is not None else None)
+            fn = jax.jit(chunk, donate_argnums=(2,), out_shardings=outs)
             self._chunk_fns[C] = fn
         return fn
 
@@ -1136,6 +1237,10 @@ class ServingEngine:
                 "block_size": self.config.block_size,
                 "table_width": self.MB,
                 "max_seqs": self.config.max_seqs,
+                # mesh topology (ISSUE 15): a resume/migration target must
+                # match these degrees — see _check_geometry
+                "tp": self.tp,
+                "ep": self.ep,
             },
             "requests": [{
                 "rid": req.rid,
@@ -1163,7 +1268,9 @@ class ServingEngine:
 
     def accept_migration(self, recs: List[Dict[str, Any]],
                          rng_counter: Optional[int] = None,
-                         source: Optional[str] = None) -> List[int]:
+                         source: Optional[str] = None,
+                         geometry: Optional[Dict[str, Any]] = None
+                         ) -> List[int]:
         """Restore drained request records (the ``state.json`` schema) onto
         THIS engine — the remote-drain handoff the router's failover uses
         to re-place a dead replica's in-flight work onto survivors. Each
@@ -1173,7 +1280,15 @@ class ServingEngine:
         reach raises the typed ``ResumeIncompatible`` — the caller tries
         the next survivor. Admission watermarks are bypassed
         (``scheduler.restore``): this work was already admitted once;
-        shedding it on migration would drop accepted requests."""
+        shedding it on migration would drop accepted requests.
+
+        ``geometry`` is the drained engine's envelope (the state.json
+        ``engine`` dict): when it records a mesh topology (tp/ep), a
+        mismatched local geometry refuses the whole batch with the typed
+        ``ResumeIncompatible`` — the failover tries the next survivor
+        (see _check_geometry for why a continuation must not cross mesh
+        geometries)."""
+        self._check_geometry(geometry, source)
         reqs: List[Request] = []
         for rec in recs:
             req = Request(rid=int(rec["rid"]),
@@ -1250,7 +1365,8 @@ class ServingEngine:
                     "large, or migrate per-request via accept_migration")
         rids = self.accept_migration(state["requests"],
                                      rng_counter=state.get("rng_counter"),
-                                     source=state.get("source"))
+                                     source=state.get("source"),
+                                     geometry=eng)
         rb_events.emit("serving_resumed", requests=len(rids), tag=tag)
         self._drain_events()
         return rids
@@ -1333,7 +1449,13 @@ class ServingEngine:
             "completed": float(len(self._finished)),
             "preemptions": float(sum(r.preemptions
                                      for r in self._finished)),
+            # PER-DEVICE pool shard (what a chip's HBM actually pays — on
+            # a tp-sharded engine logical / tp; the logical size rides
+            # alongside so the memory law stays checkable)
             "pool_bytes": float(self.pool_bytes),
+            "pool_bytes_logical": float(self.pool_bytes_logical),
+            "tp": float(self.tp),
+            "ep": float(self.ep),
             "cancelled": float(len(self._cancelled)),
             "queue_depth": float(self.scheduler.num_waiting),
         }
@@ -1374,7 +1496,15 @@ def init_serving(model, config=None, serving: Optional[dict] = None,
     takes ServingConfig field names. The InferenceEngine's context-aware
     int8-KV default keys off the serving context cap (long-context pools
     quantize, short ones keep the compute dtype — the measured
-    crossover)."""
+    crossover).
+
+    Mesh-native (ISSUE 15): pass ``tensor_parallel=N`` /
+    ``expert_parallel=N`` (InferenceConfig fields, via `config` or
+    kwargs) to build the serving mesh, or hand an explicit ``mesh`` —
+    the mesh is authoritative for the degrees, the block pools shard on
+    the kv-head dim over `tensor`, and the MoE expert stacks over
+    `expert`. Greedy outputs stay token-identical to the single-chip
+    engine (the tp-parity tests pin it)."""
     from deepspeed_tpu.inference.engine import init_inference
     sc = ServingConfig(**(serving or {}))
     model_cap = getattr(getattr(model, "config", None), "max_seq_len", None)

@@ -267,3 +267,26 @@ def num_params(params) -> int:
 
 def params_bytes(params) -> int:
     return sum(int(np.prod(p.shape)) * p.dtype.itemsize for p in jax.tree.leaves(params))
+
+
+def sharded_bytes(tree) -> int:
+    """PER-DEVICE resident bytes of a pytree of committed jax Arrays: each
+    leaf is priced at its shard shape (``sharding.shard_shape``), so a
+    tensor-sharded KV block pool is counted once per chip, not once per
+    logical array. Leaves without a sharding (host numpy, abstract shapes)
+    fall back to their full size — on a 1-device mesh the two agree.
+    This is what the serving engine's ``pool_bytes`` reports: the HBM a
+    chip actually spends, the number the memory law is written against."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:  # pragma: no cover - exotic shardings
+                pass
+        total += int(np.prod(shape)) * np.dtype(leaf.dtype).itemsize
+    return total

@@ -29,6 +29,9 @@
 #       steps, NVMe + tmpfs), mid-step read-fault recovery, and the
 #       offload-serial-pipeline audit twins (each builds a real executor
 #       with injected storage latency)
+#   TP_SERVING_BUDGET=420 tests/run_slow.sh tp_serving  # ISSUE 15:
+#       tp=2-vs-single-chip serving parity under preemption + prefix
+#       cache + the latency tier, and the tp2->tp2 drained continuation
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -98,6 +101,12 @@ for m in "${modules[@]}"; do
         # separately from the quick serving module (matched FIRST: the
         # *test_serving* glob below would swallow it)
         *test_serving_chaos*) budget="${SERVING_CHAOS_BUDGET:-600}" ;;
+        # ISSUE-15 pod-scale serving: tp=2-vs-single-chip parity pairs
+        # (preemption + prefix cache, spec/chunked latency tier, drained
+        # continuation) — each builds 2 engines per mesh and serves full
+        # loads on the 2-device CPU mesh (matched before the
+        # *test_serving* glob below)
+        *test_tp_serving*) budget="${TP_SERVING_BUDGET:-420}" ;;
         # ISSUE-9 serving tier: multi-tenant end-to-end runs (engine
         # rebuilds + per-bucket prefill compiles + int8 pool parity over
         # 24 decode steps) own a budget independent of the tier default

@@ -331,6 +331,7 @@ _CORPUS_RULES = {
     "remat-missing": "memory-peak",
     "stage3-replicated-opt": "memory-law",
     "paged-cache-leak": "memory-peak",
+    "tp-serving-replicated-pool": "replication-over-budget",
 }
 
 

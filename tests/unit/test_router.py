@@ -374,7 +374,7 @@ class TestFailover:
 
         class _SmallStub(_StubReplica):
             def accept_migration(self, recs, rng_counter=None,
-                                 source=None):
+                                 source=None, geometry=None):
                 if any(int(r["rid"]) == 1 for r in recs):
                     raise ResumeIncompatible("request 1 exceeds this "
                                              "engine's max_model_len")
