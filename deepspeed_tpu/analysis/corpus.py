@@ -684,6 +684,28 @@ def exposed_collective_trace(devices=None):
     return run_corpus_entry()
 
 
+def staging_buffer_alias(devices=None):
+    """Race corpus (deterministic interleaving explorer, not a compiled
+    program): the REAL ``StagingRing`` with the write-behind fence skipped
+    — the sweep refills a staging buffer before its drain copied it. The
+    explorer must find an interleaving where a drained chunk carries the
+    next chunk's bytes and report ``buffer-alias`` with a replayable
+    schedule id. Corrected twin (``acquire`` through the busy-future
+    fence): race_lint --corpus staging-buffer-alias --correct."""
+    from deepspeed_tpu.analysis.race_lint import audit_schedules
+    return audit_schedules("staging-buffer-alias", correct=False)
+
+
+def allocator_unlocked_share(devices=None):
+    """Race corpus: an unsynchronized check-then-share against the REAL
+    ``BlockAllocator`` racing a concurrent free + fresh allocation — the
+    explorer must find a schedule where the share hits a freed/recycled
+    block (``refcount-race``), with a replayable schedule id. Corrected
+    twin holds the share and the invalidating free atomic."""
+    from deepspeed_tpu.analysis.race_lint import audit_schedules
+    return audit_schedules("allocator-unlocked-share", correct=False)
+
+
 CORPUS = {
     "undonated-state": undonated_state,
     "extra-collective": extra_collective,
@@ -703,6 +725,8 @@ CORPUS = {
     "offload-serial-pipeline": offload_serial_pipeline,
     "exposed-collective-trace": exposed_collective_trace,
     "serialized-backward": serialized_backward,
+    "staging-buffer-alias": staging_buffer_alias,
+    "allocator-unlocked-share": allocator_unlocked_share,
 }
 
 

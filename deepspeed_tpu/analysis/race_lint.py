@@ -1,0 +1,959 @@
+"""graft-race: lock-discipline lint + deterministic interleaving explorer.
+
+The fleet's host tier is concurrent (io_uring pools and staging buffers in
+``runtime/infinity.py``/``runtime/swap_tensor.py``, the serving watchdog
+round thread, the telemetry static-cost worker, router heartbeats), and
+until this pass every analyzer inspected compiled programs or
+single-threaded replays only. Races were a reviewer's catch (PR 13's
+cyclic-GC ``__del__`` rmtree of a live chunk dir, staging-buffer aliasing,
+the abandoned-watchdog stale dispatch). This module makes them findings.
+
+**Face 1 — static lock-discipline lint** (``scan_package``): an AST pass
+that inventories every ``threading.Lock/RLock/Condition``,
+``ThreadPoolExecutor``, ``Thread(target=...)`` and ``Future`` callback
+site, builds a per-class field-access map (which methods read/write which
+``self._*`` attributes under which locks, and which methods run on a
+thread entry point), and flags:
+
+* ``unlocked-shared-write`` — a field with lock-guarded accesses that is
+  also written with no lock held (inconsistent discipline), or a field
+  written from BOTH a thread entry point and the main side without a lock.
+  Single-writer fields read cross-thread are deliberately exempt: the
+  fleet leans on GIL-atomic rebinding for flags like the serving recovery
+  epoch, and flagging those would bury the real findings.
+* ``lock-order-cycle`` — ``with a: with b:`` somewhere and
+  ``with b: with a:`` elsewhere (any cycle, any length, across modules).
+* ``thread-leak`` — a non-daemon thread nobody ``join``s, or a daemon
+  thread whose target touches the filesystem (a GC-time ``__del__`` on a
+  daemon's dirty state is how PR 13's chunk-dir race happened).
+* ``blocking-under-lock`` — ``.result()``, thread ``join``, lock
+  ``acquire`` or ``sleep`` while holding a lock.
+
+Findings carry file:line and thread-entry provenance; pre-existing
+accepted findings live in ``analysis/race_baseline.json`` (same mechanics
+as the collective-census pins — the gate is drift, not history).
+
+**Face 2 — interleaving explorer** (``audit_*``): deterministic-scheduler
+harnesses (``robustness/sched.py``) over the REAL classes. The two seeded
+corpus entries:
+
+* ``allocator-unlocked-share`` (rule ``refcount-race``) — an
+  unsynchronized check-then-share against the real ``BlockAllocator``
+  races a concurrent free+realloc: the explorer finds a schedule where a
+  freshly allocated "exclusive" block is simultaneously mapped as a
+  shared prefix (or the share hits an already-freed block). The corrected
+  twin does the liveness check and the share atomically.
+* ``staging-buffer-alias`` (rule ``buffer-alias``) — the real
+  ``StagingRing`` (``runtime/infinity.py``): handing out a staging buffer
+  without waiting out its write-behind future lets the next chunk's fill
+  overwrite bytes the drain hasn't copied yet; the corrected twin uses
+  ``acquire`` (the fence ``_opt_read_staged`` relies on).
+
+Every failure prints a replayable schedule id — feed it to ``--replay``
+(or ``robustness.sched.replay``) to reproduce the exact interleaving.
+
+CLI::
+
+    python -m deepspeed_tpu.analysis.race_lint            # both faces
+    python -m deepspeed_tpu.analysis.race_lint --corpus staging-buffer-alias
+    python -m deepspeed_tpu.analysis.race_lint --corpus allocator-unlocked-share --correct
+    python -m deepspeed_tpu.analysis.race_lint --replay x1.0.2 --corpus ...
+    python -m deepspeed_tpu.analysis.race_lint --static-only --write-baseline
+"""
+
+import ast
+import contextlib
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.analysis.report import (Finding, Report, load_baseline,
+                                           save_baseline)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(_PKG_ROOT, "analysis", "race_baseline.json")
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore")
+_FS_ROOTS = ("os", "shutil", "tempfile")
+_FS_ATTRS = ("rmtree", "unlink", "remove", "replace", "makedirs", "rename",
+             "tofile", "copyfile", "copytree", "rmdir", "mkdir")
+_MODULE_GLOBAL = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+# -------------------------------------------------------------------------
+# face 1: static lock-discipline lint
+# -------------------------------------------------------------------------
+
+class _Fn:
+    """One function/method (nested defs get their own, qual 'meth.inner')."""
+
+    def __init__(self, qual: str, name: str, lineno: int):
+        self.qual = qual
+        self.name = name
+        self.lineno = lineno
+        self.reads: List[Tuple[str, int, tuple]] = []    # attr, line, locks
+        self.writes: List[Tuple[str, int, tuple, bool]] = []  # +rmw
+        self.calls: set = set()       # "self.m" or bare local names
+        self.fs: List[int] = []       # filesystem-touching call lines
+        self.joins: set = set()       # "self.x" / local names .join()ed
+        # blocking-call candidates: (what, name-or-None, line, locks)
+        self.blocking: List[Tuple[str, Optional[str], int, tuple]] = []
+
+
+class _Entry:
+    """One thread entry point: Thread(target=...), pool.submit(...), or a
+    Future.add_done_callback."""
+
+    def __init__(self, target: Optional[str], kind: str,
+                 daemon: Optional[bool], lineno: int,
+                 assigned: Optional[Tuple[str, str]], creator: str):
+        self.target = target          # "self.m", bare name, or None
+        self.kind = kind              # thread | submit | callback
+        self.daemon = daemon
+        self.lineno = lineno
+        self.assigned = assigned      # ("attr"|"name", x) the Thread landed in
+        self.creator = creator        # qual of the creating function
+
+
+class _Cls:
+    def __init__(self, name: str, module: str):
+        self.name = name
+        self.module = module
+        self.locks: set = set()       # self attrs holding Lock()s
+        self.executors: set = set()   # self attrs holding pools
+        self.fns: Dict[str, _Fn] = {}
+        self.entries: List[_Entry] = []
+
+
+class _ModuleScan:
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.classes: Dict[str, _Cls] = {}
+        self.module_locks: set = set()     # module-level _LOCK names
+        self.module_mut: set = set()       # module-level mutable globals
+        # (outer_lock_id, inner_lock_id, "file:line")
+        self.lock_pairs: List[Tuple[str, str, str]] = []
+        self.counts = {"locks": 0, "executors": 0, "threads": 0,
+                       "submits": 0, "callbacks": 0}
+
+
+def _lockish(name: str) -> bool:
+    n = name.lower()
+    return "lock" in n or n.endswith("_cond") or n.endswith("_sem")
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """Thread/submit target expression -> resolvable name."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Lambda):
+        return "<lambda>"
+    if isinstance(node, ast.Call):       # functools.partial(self.m, ...)
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "partial" and node.args:
+            return _target_name(node.args[0])
+    return None
+
+
+class _Walker:
+    """Per-module AST walk tracking held locks through ``with`` nesting."""
+
+    def __init__(self, scan: _ModuleScan):
+        self.scan = scan
+
+    # -- lock identity ----------------------------------------------------
+
+    def lock_id(self, expr: ast.AST, cls: _Cls) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            if expr.attr in cls.locks or _lockish(expr.attr):
+                return f"{cls.name}.{expr.attr}"
+        elif isinstance(expr, ast.Name):
+            if expr.id in self.scan.module_locks or \
+                    (_lockish(expr.id) and _MODULE_GLOBAL.match(expr.id)):
+                return f"{self.scan.relpath}::{expr.id}"
+        return None
+
+    # -- statement walk ---------------------------------------------------
+
+    def walk_fn(self, fnode, qual: str, cls: _Cls) -> None:
+        fn = _Fn(qual, fnode.name, fnode.lineno)
+        cls.fns[qual] = fn
+        self._stmts(fnode.body, (), fn, cls)
+
+    def _stmts(self, body, held: tuple, fn: _Fn, cls: _Cls) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.walk_fn(st, f"{fn.qual}.{st.name}", cls)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in st.items:
+                    lid = self.lock_id(item.context_expr, cls)
+                    if lid:
+                        for outer in new_held:
+                            self.scan.lock_pairs.append(
+                                (outer, lid,
+                                 f"{self.scan.relpath}:{st.lineno}"))
+                        new_held = new_held + (lid,)
+                    else:
+                        self._expr(item.context_expr, held, fn, cls, None)
+                self._stmts(st.body, new_held, fn, cls)
+                continue
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                self._assign(st, held, fn, cls)
+                continue
+            for _field, val in ast.iter_fields(st):
+                self._generic(val, held, fn, cls)
+
+    def _generic(self, val, held, fn, cls) -> None:
+        if isinstance(val, list):
+            for v in val:
+                self._generic(v, held, fn, cls)
+        elif isinstance(val, ast.stmt):
+            self._stmts([val], held, fn, cls)
+        elif isinstance(val, ast.excepthandler):
+            self._stmts(val.body, held, fn, cls)
+        elif isinstance(val, ast.expr):
+            self._expr(val, held, fn, cls, None)
+
+    def _assign(self, st, held: tuple, fn: _Fn, cls: _Cls) -> None:
+        rmw = isinstance(st, ast.AugAssign)
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        hint: Optional[Tuple[str, str]] = None
+        flat: List[ast.AST] = []
+
+        def flatten(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    flatten(e)
+            else:
+                flat.append(t)
+
+        for t in targets:
+            flatten(t)
+        for t in flat:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                fn.writes.append((t.attr, t.lineno, held, rmw))
+                hint = ("attr", t.attr)
+            elif isinstance(t, ast.Subscript):
+                base = t.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    fn.writes.append((base.attr, t.lineno, held, True))
+                elif isinstance(base, ast.Name) and \
+                        base.id in self.scan.module_mut:
+                    fn.writes.append((f"::{base.id}", t.lineno, held, True))
+                self._expr(t.slice, held, fn, cls, None)
+            elif isinstance(t, ast.Name):
+                if t.id in self.scan.module_mut:
+                    fn.writes.append((f"::{t.id}", t.lineno, held, rmw))
+                hint = ("name", t.id)
+        value = getattr(st, "value", None)
+        if value is not None:
+            self._expr(value, held, fn, cls, hint)
+
+    # -- expression scan --------------------------------------------------
+
+    def _expr(self, e: ast.AST, held: tuple, fn: _Fn, cls: _Cls,
+              hint: Optional[Tuple[str, str]]) -> None:
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                fn.reads.append((node.attr, node.lineno, held))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in self.scan.module_mut:
+                fn.reads.append((f"::{node.id}", node.lineno, held))
+            elif isinstance(node, ast.Call):
+                self._call(node, held, fn, cls, hint)
+
+    def _call(self, c: ast.Call, held: tuple, fn: _Fn, cls: _Cls,
+              hint) -> None:
+        func = c.func
+        chain = _attr_chain(func)
+        tail = chain[-1] if chain else ""
+        # thread / executor / lock construction
+        if tail == "Thread" and (len(chain) == 1 or chain[0] in
+                                 ("threading", "_threading")):
+            target = daemon = None
+            for kw in c.keywords:
+                if kw.arg == "target":
+                    target = _target_name(kw.value)
+                elif kw.arg == "daemon" and \
+                        isinstance(kw.value, ast.Constant):
+                    daemon = bool(kw.value.value)
+            cls.entries.append(_Entry(target, "thread", daemon, c.lineno,
+                                      hint, fn.qual))
+            self.scan.counts["threads"] += 1
+        elif tail == "submit" and len(chain) >= 2 and c.args:
+            cls.entries.append(_Entry(_target_name(c.args[0]), "submit",
+                                      True, c.lineno, None, fn.qual))
+            self.scan.counts["submits"] += 1
+        elif tail == "add_done_callback" and c.args:
+            cls.entries.append(_Entry(_target_name(c.args[0]), "callback",
+                                      True, c.lineno, None, fn.qual))
+            self.scan.counts["callbacks"] += 1
+        elif tail in _LOCK_CTORS and (len(chain) == 1 or chain[0] in
+                                      ("threading", "_threading")):
+            self.scan.counts["locks"] += 1
+            if hint and hint[0] == "attr":
+                cls.locks.add(hint[1])
+            elif hint and hint[0] == "name":
+                self.scan.module_locks.add(hint[1])
+        elif tail == "ThreadPoolExecutor":
+            self.scan.counts["executors"] += 1
+            if hint and hint[0] == "attr":
+                cls.executors.add(hint[1])
+        elif tail == "join" and len(chain) >= 2:
+            # thread join bookkeeping (strings have no Name/self receiver
+            # chain of interest: ", ".join() has chain [", "... ] empty)
+            recv = func.value
+            name = None
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                name = f"self.{recv.attr}"
+            elif isinstance(recv, ast.Name):
+                name = recv.id
+            if name:
+                fn.joins.add(name)
+                if held:
+                    fn.blocking.append(("join", name, c.lineno, held))
+        elif tail == "result" and held:
+            fn.blocking.append(("result", None, c.lineno, held))
+        elif tail == "acquire" and held and \
+                self.lock_id(func.value, cls):
+            fn.blocking.append(("acquire", self.lock_id(func.value, cls),
+                                c.lineno, held))
+        elif tail == "sleep" and held and \
+                (len(chain) == 1 or chain[0] == "time"):
+            fn.blocking.append(("sleep", None, c.lineno, held))
+        # filesystem reach (for daemon-thread targets)
+        if (tail == "open" and len(chain) == 1) or \
+                (chain and chain[0] in _FS_ROOTS and len(chain) >= 2) or \
+                tail in _FS_ATTRS:
+            fn.fs.append(c.lineno)
+
+
+def _scan_module(src: str, relpath: str) -> _ModuleScan:
+    scan = _ModuleScan(relpath)
+    tree = ast.parse(src)
+    # module-level inventory pre-pass: locks + mutable UPPERCASE globals
+    for st in tree.body:
+        if isinstance(st, (ast.Assign, ast.AnnAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            names = [t.id for t in targets
+                     if isinstance(t, ast.Name) and
+                     _MODULE_GLOBAL.match(t.id)]
+            if not names:
+                continue
+            v = st.value
+            if isinstance(v, ast.Call):
+                chain = _attr_chain(v.func)
+                tail = chain[-1] if chain else ""
+                if tail in _LOCK_CTORS:
+                    scan.module_locks.update(names)
+                    scan.counts["locks"] += 1
+                    continue
+                if tail in ("defaultdict", "dict", "list", "set", "deque",
+                            "OrderedDict", "Counter"):
+                    scan.module_mut.update(names)
+            elif isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                scan.module_mut.update(names)
+    walker = _Walker(scan)
+    mod_cls = _Cls(f"<{relpath}>", relpath)
+    scan.classes[mod_cls.name] = mod_cls
+    for st in tree.body:
+        if isinstance(st, ast.ClassDef):
+            cls = _Cls(st.name, relpath)
+            scan.classes[st.name] = cls
+            for sub in st.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walker.walk_fn(sub, sub.name, cls)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walker.walk_fn(st, st.name, mod_cls)
+    return scan
+
+
+def _resolve(cls: _Cls, name: Optional[str],
+             scope: str) -> Optional[str]:
+    """Resolve a call/entry target name to a function qual within cls."""
+    if not name:
+        return None
+    if name.startswith("self."):
+        m = name[5:]
+        return m if m in cls.fns else None
+    # bare name: innermost enclosing scope first
+    parts = scope.split(".")
+    for i in range(len(parts), -1, -1):
+        q = ".".join(parts[:i] + [name])
+        if q in cls.fns:
+            return q
+    return None
+
+
+def _thread_side(cls: _Cls) -> Dict[str, _Entry]:
+    """Map fn qual -> the entry point it is reachable from."""
+    side: Dict[str, _Entry] = {}
+    stack: List[Tuple[str, _Entry]] = []
+    for e in cls.entries:
+        q = _resolve(cls, e.target, e.creator)
+        if q is not None:
+            stack.append((q, e))
+    while stack:
+        q, e = stack.pop()
+        if q in side:
+            continue
+        side[q] = e
+        for callee in cls.fns[q].calls:
+            r = _resolve(cls, callee, q)
+            if r is not None and r not in side:
+                stack.append((r, e))
+        # nested defs invoked by bare name are collected via calls; a
+        # nested def merely *defined* thread-side runs wherever it's
+        # called, so it is not marked here
+    return side
+
+
+def _collect_calls(cls: _Cls) -> None:
+    # reads of self.<m> where m is a method double as call edges; bare
+    # Name calls were not recorded during the walk (Name loads only track
+    # module globals), so recover both from the access lists
+    for fn in cls.fns.values():
+        for attr, _ln, _locks in fn.reads:
+            if attr in cls.fns:
+                fn.calls.add(f"self.{attr}")
+        # nested defs called by bare name: approximate by adding every
+        # nested def of this fn (a defined-but-never-run closure is rare
+        # and only widens thread-side, never misses it)
+        prefix = fn.qual + "."
+        for q in cls.fns:
+            if q.startswith(prefix) and "." not in q[len(prefix):]:
+                fn.calls.add(q.rsplit(".", 1)[1])
+
+
+def _class_findings(scan: _ModuleScan, cls: _Cls) -> List[Finding]:
+    out: List[Finding] = []
+    _collect_calls(cls)
+    side = _thread_side(cls)
+    is_module = cls.name.startswith("<")
+    label = scan.relpath if is_module else f"{scan.relpath}:{cls.name}"
+
+    # ---- unlocked-shared-write ----
+    attrs: Dict[str, Dict[str, list]] = {}
+    for q, fn in cls.fns.items():
+        skip_init = fn.name in ("__init__",) or \
+            (fn.qual.split(".")[0] == "__init__")
+        for attr, ln, locks in fn.reads:
+            attrs.setdefault(attr, {"r": [], "w": []})["r"].append(
+                (q, ln, locks))
+        if skip_init:
+            continue
+        for attr, ln, locks, rmw in fn.writes:
+            attrs.setdefault(attr, {"r": [], "w": []})["w"].append(
+                (q, ln, locks, rmw))
+    for attr, acc in sorted(attrs.items()):
+        if attr in cls.locks or attr in cls.executors:
+            continue
+        writes = acc["w"]
+        if not writes:
+            continue
+        unguarded = [w for w in writes if not w[2]]
+        if not unguarded:
+            continue
+        guarded_sites = [a for a in acc["r"] if a[2]] + \
+            [w for w in writes if w[2]]
+        t_w = [w for w in writes if w[0] in side]
+        m_w = [w for w in writes if w[0] not in side]
+        discipline = bool(guarded_sites)
+        both_sides = bool(t_w) and bool(m_w)
+        if not discipline and not both_sides:
+            continue
+        w0 = unguarded[0]
+        prov = ""
+        if w0[0] in side:
+            e = side[w0[0]]
+            prov = (f" (runs on the {e.kind} entry at "
+                    f"{scan.relpath}:{e.lineno})")
+        why = ("guarded elsewhere but written lock-free here"
+               if discipline else
+               "written from both a thread entry point and the main side "
+               "with no lock")
+        out.append(Finding(
+            rule="unlocked-shared-write",
+            program=scan.relpath,
+            ident=f"{cls.name}.{attr}" if not is_module else attr,
+            message=(f"{label}: field {attr!r} {why} — unguarded write at "
+                     f"{scan.relpath}:{w0[1]} in {w0[0]}{prov}"),
+            data={"writes": [(w[0], w[1], bool(w[2])) for w in writes],
+                  "thread_side": sorted(q for q in side),
+                  "guarded_sites": len(guarded_sites)}))
+
+    # ---- thread-leak ----
+    for e in cls.entries:
+        if e.kind != "thread":
+            continue
+        ident = f"{cls.name}.{e.target or '<unknown>'}:{e.kind}"
+        if not e.daemon:
+            joined = False
+            if e.assigned and e.assigned[0] == "attr":
+                joined = any(f"self.{e.assigned[1]}" in fn.joins
+                             for fn in cls.fns.values())
+            elif e.assigned and e.assigned[0] == "name":
+                creator = cls.fns.get(e.creator)
+                joined = creator is not None and \
+                    e.assigned[1] in creator.joins
+            if not joined:
+                out.append(Finding(
+                    rule="thread-leak",
+                    program=scan.relpath,
+                    ident=ident,
+                    message=(f"{label}: non-daemon thread created at "
+                             f"{scan.relpath}:{e.lineno} is never joined "
+                             "— leaks and blocks interpreter exit"),
+                    data={"lineno": e.lineno, "target": e.target}))
+        else:
+            q = _resolve(cls, e.target, e.creator)
+            fs = cls.fns[q].fs if q else []
+            if fs:
+                out.append(Finding(
+                    rule="thread-leak",
+                    severity="warning",
+                    program=scan.relpath,
+                    ident=ident + ":fs",
+                    message=(f"{label}: daemon thread created at "
+                             f"{scan.relpath}:{e.lineno} touches the "
+                             f"filesystem (line {fs[0]}) — it can die "
+                             "mid-write at interpreter exit"),
+                    data={"lineno": e.lineno, "fs_lines": fs}))
+
+    # ---- blocking-under-lock ----
+    thread_assigned = {f"self.{e.assigned[1]}" if e.assigned and
+                       e.assigned[0] == "attr" else
+                       (e.assigned[1] if e.assigned else None)
+                       for e in cls.entries if e.kind == "thread"}
+    for q, fn in cls.fns.items():
+        for what, name, ln, locks in fn.blocking:
+            if what == "join" and name not in thread_assigned:
+                continue
+            out.append(Finding(
+                rule="blocking-under-lock",
+                program=scan.relpath,
+                ident=f"{cls.name}.{fn.name}:{what}:{ln}"
+                      if not is_module else f"{fn.name}:{what}:{ln}",
+                message=(f"{label}: blocking call {what}() at "
+                         f"{scan.relpath}:{ln} while holding "
+                         f"{', '.join(locks)} — stalls every thread "
+                         "contending on the lock"),
+                data={"lineno": ln, "locks": list(locks)}))
+    return out
+
+
+def _cycle_findings(pairs: Sequence[Tuple[str, str, str]]) -> List[Finding]:
+    graph: Dict[str, Dict[str, str]] = {}
+    for outer, inner, loc in pairs:
+        if outer != inner:
+            graph.setdefault(outer, {}).setdefault(inner, loc)
+    out: List[Finding] = []
+    seen: set = set()
+
+    def dfs(node, path, locs):
+        for nxt, loc in sorted(graph.get(node, {}).items()):
+            if nxt in path:
+                cyc = path[path.index(nxt):] + [node]
+                key = frozenset(cyc)
+                if key not in seen:
+                    seen.add(key)
+                    order = " -> ".join(cyc + [nxt])
+                    out.append(Finding(
+                        rule="lock-order-cycle",
+                        program="package",
+                        ident="->".join(sorted(set(cyc))),
+                        message=(f"lock acquisition order cycle: {order} "
+                                 f"(edges at {', '.join(locs + [loc])}) — "
+                                 "two threads taking these locks in "
+                                 "opposite orders deadlock"),
+                        data={"cycle": cyc, "edges": locs + [loc]}))
+                continue
+            dfs(nxt, path + [node], locs + [loc])
+
+    for start in sorted(graph):
+        dfs(start, [], [])
+    return out
+
+
+def scan_source(src: str, relpath: str = "<snippet>") -> Report:
+    """Static face over one source text (fixture tests use this)."""
+    scan = _scan_module(src, relpath)
+    rep = Report(meta={"face": "static", "module": relpath})
+    for cls in scan.classes.values():
+        rep.extend(_class_findings(scan, cls))
+    rep.extend(_cycle_findings(scan.lock_pairs))
+    rep.census["concurrency"] = {
+        k: {"count": v, "bytes": 0} for k, v in scan.counts.items()}
+    return rep
+
+
+def scan_package(root: Optional[str] = None,
+                 baseline: Optional[Dict[str, Any]] = None) -> Report:
+    """Static face over the whole package tree."""
+    root = root or _PKG_ROOT
+    rep = Report(meta={"face": "static", "root": root})
+    counts = {"locks": 0, "executors": 0, "threads": 0, "submits": 0,
+              "callbacks": 0}
+    all_pairs: List[Tuple[str, str, str]] = []
+    entries_inventory: List[Dict[str, Any]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            relpath = os.path.relpath(path, os.path.dirname(root))
+            with open(path) as f:
+                src = f.read()
+            try:
+                scan = _scan_module(src, relpath)
+            except SyntaxError as e:   # pragma: no cover
+                rep.findings.append(Finding(
+                    rule="parse-error", program=relpath, ident=str(e),
+                    message=f"{relpath}: {e}"))
+                continue
+            for cls in scan.classes.values():
+                rep.extend(_class_findings(scan, cls))
+                for e in cls.entries:
+                    entries_inventory.append({
+                        "module": relpath, "class": cls.name,
+                        "kind": e.kind, "target": e.target,
+                        "daemon": e.daemon, "lineno": e.lineno})
+            all_pairs.extend(scan.lock_pairs)
+            for k in counts:
+                counts[k] += scan.counts[k]
+    rep.extend(_cycle_findings(all_pairs))
+    rep.census["concurrency"] = {
+        k: {"count": v, "bytes": 0} for k, v in counts.items()}
+    rep.meta["entry_points"] = entries_inventory
+    if baseline:
+        rep.apply_baseline(baseline)
+    return rep
+
+
+# -------------------------------------------------------------------------
+# face 2: interleaving explorer audits (corpus entries)
+# -------------------------------------------------------------------------
+
+def _maybe(lock, on: bool):
+    return lock if on else contextlib.nullcontext()
+
+
+def allocator_share_harness(correct: bool):
+    """Check-then-share against the REAL BlockAllocator, racing a
+    concurrent free + fresh allocation. The 'prefix entry' is the
+    ``live`` flag; the corrected twin checks it and shares atomically
+    (one lock with the freeing side, which invalidates under the same
+    lock). Allocator calls themselves are not preempted mid-op — the
+    class is single-threaded by contract; the race under test is the
+    caller's protocol."""
+    from deepspeed_tpu.inference.kv_cache import BlockAllocator
+    from deepspeed_tpu.robustness import sched as rs
+
+    def harness(s):
+        alloc = BlockAllocator(6)
+        held = alloc.alloc(2)            # req0 owns [5, 4]
+        b = held[0]
+        claims = {"req0": list(held)}
+        shared: List[int] = []
+        live = {b: True}                 # the prefix-cache entry for b
+        lock = rs.SchedLock(s)
+
+        def prefix_share():
+            with _maybe(lock, correct):
+                if live.get(b) and alloc.refcount(b) > 0:
+                    s.point("share:between-check-and-act")
+                    try:
+                        alloc.share([b], owner="prefix")
+                    except ValueError as e:
+                        raise rs.InvariantViolation(
+                            f"share raced free: {e}") from e
+                    shared.append(b)
+
+        def req0_free():
+            with _maybe(lock, correct):
+                live[b] = False          # invalidate the cache entry...
+                s.point("free:between-invalidate-and-free")
+                alloc.free([b], owner="req0")   # ...then release the block
+                claims["req0"].remove(b)
+
+        def req1_alloc():
+            got = alloc.alloc(1)
+            claims["req1"] = list(got)
+
+        s.spawn(prefix_share, name="prefix-share")
+        s.spawn(req0_free, name="req0-free")
+        s.spawn(req1_alloc, name="req1-alloc")
+
+        def check():
+            for blk in claims.get("req1", ()):
+                if blk in shared:
+                    raise rs.InvariantViolation(
+                        f"block {blk} owned twice: handed out as a fresh "
+                        "exclusive allocation while a prefix share still "
+                        "maps it")
+            from collections import Counter
+            want: Counter = Counter()
+            for bs in claims.values():
+                want.update(bs)
+            want.update(shared)
+            for blk in range(1, alloc.num_blocks):
+                if alloc.refcount(blk) != want[blk]:
+                    raise rs.InvariantViolation(
+                        f"refcount conservation broken: block {blk} has "
+                        f"refcount {alloc.refcount(blk)} but the ledger "
+                        f"claims {want[blk]}")
+        return check
+
+    return harness
+
+
+def staging_ring_harness(correct: bool):
+    """The REAL StagingRing under a scheduler-driven sweep + write-behind
+    pool: fill chunk i into buffer i%3, hand the buffer to an async drain,
+    move on. The corrected twin acquires through the busy-future fence;
+    the defect twin takes the raw slot — the explorer finds the schedule
+    where fill(i) lands before drain(i-3) copied."""
+    from deepspeed_tpu.robustness import sched as rs
+    from deepspeed_tpu.runtime.infinity import StagingRing
+
+    n_chunks = 6
+
+    def harness(s):
+        ring = StagingRing(3, (4,), np.float32)
+        pool = rs.SchedExecutor(s, max_workers=2)
+        disk: Dict[int, np.ndarray] = {}
+
+        def sweep():
+            for i in range(n_chunks):
+                buf = ring.acquire(i) if correct else ring.slot(i)
+                s.point(f"fill:{i}")
+                buf[:] = float(i)
+
+                def drain(i=i, buf=buf):
+                    s.point(f"drain:{i}")
+                    disk[i] = buf.copy()
+
+                ring.mark_busy(i, pool.submit(drain))
+            pool.shutdown(wait=True)
+
+        s.spawn(sweep, name="sweep")
+
+        def check():
+            if sorted(disk) != list(range(n_chunks)):
+                raise rs.InvariantViolation(
+                    f"write-behind lost chunks: drained {sorted(disk)}")
+            for i in range(n_chunks):
+                got = disk[i]
+                if not (got == float(i)).all():
+                    raise rs.InvariantViolation(
+                        f"staging buffer aliased: chunk {i} drained as "
+                        f"{float(got[0])} — the sweep refilled the buffer "
+                        "before its write-behind copied it")
+        return check
+
+    return harness
+
+
+_AUDITS = {
+    # corpus name: (rule, harness factory)
+    "allocator-unlocked-share": ("refcount-race", allocator_share_harness),
+    "staging-buffer-alias": ("buffer-alias", staging_ring_harness),
+}
+
+
+def audit_schedules(name: str, correct: bool = False, *,
+                    schedules: int = 200, seed: int = 0) -> Report:
+    """Explore one corpus harness; the defect twin's report carries the
+    finding (with a replayable schedule id), the corrected twin's report
+    is ok with the explored count in the census."""
+    from deepspeed_tpu.robustness import sched as rs
+    rule, factory = _AUDITS[name]
+    rep = Report(meta={"face": "explore", "audit": name,
+                       "mode": "correct" if correct else "defect",
+                       "schedules": schedules, "seed": seed})
+    res = rs.explore(factory(correct), schedules=schedules, seed=seed,
+                     stop_on_failure=not correct)
+    rep.census["explore"] = {
+        "schedules": {"count": res.explored, "bytes": 0},
+        "failures": {"count": len(res.failures), "bytes": 0}}
+    fail = res.first_failure
+    if fail is not None:
+        rep.findings.append(Finding(
+            rule=rule,
+            program=name,
+            ident=type(fail.error).__name__,
+            message=(f"{name}: schedule {fail.replay_id} "
+                     f"({fail.index + 1} of {res.explored} explored) — "
+                     f"{fail.error}"),
+            data={"replay_id": fail.replay_id,
+                  "schedule_id": fail.schedule_id,
+                  "explored": res.explored,
+                  "trace_tail": fail.trace_tail[-12:]}))
+        if correct:
+            rep.findings[-1].message = \
+                "REGRESSION in corrected twin: " + rep.findings[-1].message
+    elif not correct:
+        rep.findings.append(Finding(
+            rule="explorer-miss",
+            program=name,
+            ident="no-failure",
+            message=(f"{name}: defect twin survived {res.explored} "
+                     "schedules — the explorer lost the seeded race"),
+            data={"explored": res.explored}))
+    rep.meta["explored"] = res.explored
+    return rep
+
+
+def replay_audit(name: str, schedule_id: str,
+                 correct: bool = False) -> Optional[Any]:
+    """Re-run one recorded schedule of a corpus harness."""
+    from deepspeed_tpu.robustness import sched as rs
+    _rule, factory = _AUDITS[name]
+    return rs.replay(factory(correct), schedule_id)
+
+
+# -------------------------------------------------------------------------
+# CLI
+# -------------------------------------------------------------------------
+
+def _print_report(rep: Report, as_json: bool) -> None:
+    print(rep.to_json() if as_json else rep.summary())
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="race_lint",
+        description="graft-race: lock-discipline lint + deterministic "
+                    "interleaving explorer")
+    p.add_argument("--root", default=None,
+                   help="package root to scan (default: deepspeed_tpu)")
+    p.add_argument("--static-only", action="store_true")
+    p.add_argument("--explore-only", action="store_true")
+    p.add_argument("--corpus", choices=sorted(_AUDITS),
+                   help="run one seeded corpus harness")
+    p.add_argument("--list-corpus", action="store_true")
+    p.add_argument("--correct", action="store_true",
+                   help="run the corrected twin instead of the defect")
+    p.add_argument("--schedules", type=int, default=200)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replay", metavar="SCHEDULE_ID",
+                   help="replay one schedule of --corpus")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--baseline", default=None,
+                   help="baseline json (default: the checked-in "
+                        "analysis/race_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", nargs="?", const=DEFAULT_BASELINE,
+                   metavar="PATH",
+                   help="accept current static findings as the baseline")
+    args = p.parse_args(argv)
+
+    if args.list_corpus:
+        for name in sorted(_AUDITS):
+            print(f"{name}  (rule: {_AUDITS[name][0]})")
+        return 0
+
+    if args.replay:
+        if not args.corpus:
+            p.error("--replay requires --corpus")
+        fail = replay_audit(args.corpus, args.replay, args.correct)
+        if fail is None:
+            print(f"{args.corpus}: schedule {args.replay} passes")
+            return 0
+        print(f"{args.corpus}: schedule {fail.replay_id} fails — "
+              f"{type(fail.error).__name__}: {fail.error}")
+        if fail.trace_tail:
+            print("  trace tail: " + " ".join(fail.trace_tail[-8:]))
+        return 1
+
+    if args.corpus:
+        rep = audit_schedules(args.corpus, args.correct,
+                              schedules=args.schedules, seed=args.seed)
+        _print_report(rep, args.json)
+        return 0 if rep.ok else 1
+
+    rc = 0
+    # face 1: static scan with baseline
+    if not args.explore_only:
+        baseline = None
+        if not args.no_baseline and args.write_baseline is None:
+            path = args.baseline or DEFAULT_BASELINE
+            if os.path.exists(path):
+                baseline = load_baseline(path)
+        rep = scan_package(args.root, baseline)
+        if args.write_baseline is not None:
+            save_baseline(rep, args.write_baseline)
+            print(f"baseline written: {args.write_baseline} "
+                  f"({len(rep.findings)} finding(s) accepted)")
+            return 0
+        _print_report(rep, args.json)
+        if not rep.ok:
+            rc = 1
+    # face 2: both corpus defects must fire, both corrected twins must hold
+    if not args.static_only:
+        for name in sorted(_AUDITS):
+            defect = audit_schedules(name, correct=False,
+                                     schedules=args.schedules,
+                                     seed=args.seed)
+            fired = any(f.rule == _AUDITS[name][0]
+                        for f in defect.findings)
+            if fired:
+                f0 = next(f for f in defect.findings
+                          if f.rule == _AUDITS[name][0])
+                print(f"[explore] {name}: defect twin FIRES "
+                      f"(replay: --corpus {name} "
+                      f"--replay {f0.data['replay_id']})")
+            else:
+                print(f"[explore] {name}: defect twin DID NOT fire "
+                      f"after {defect.meta.get('explored')} schedules")
+                rc = 1
+            fixed = audit_schedules(name, correct=True,
+                                    schedules=args.schedules,
+                                    seed=args.seed)
+            if fixed.ok:
+                print(f"[explore] {name}: corrected twin holds over "
+                      f"{fixed.meta.get('explored')} schedules")
+            else:
+                print(f"[explore] {name}: corrected twin FAILED — "
+                      + fixed.findings[0].message)
+                rc = 1
+    print("race_lint: " + ("OK" if rc == 0 else "FAIL"))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,9 +56,13 @@ class CommsLogger:
         self.reset()
 
     def reset(self):
-        self.counts = defaultdict(int)
-        self.bytes = defaultdict(int)
-        self.host_ms = defaultdict(float)
+        # rebinding races a concurrent record() (an in-flight increment can
+        # land on the dropped maps, or summary() can read a half-swapped
+        # pair) — swap all three under the same lock record() takes
+        with self._lock:
+            self.counts = defaultdict(int)
+            self.bytes = defaultdict(int)
+            self.host_ms = defaultdict(float)
 
     def configure(self, enabled=True, verbose=False, prof_ops=()):
         self.enabled = enabled
@@ -83,11 +87,15 @@ class CommsLogger:
                 self.host_ms[op] += ms
 
     def summary(self) -> str:
+        with self._lock:
+            counts = dict(self.counts)
+            nbytes = dict(self.bytes)
+            host = dict(self.host_ms)
         lines = ["comm op                          count      total MB"]
-        for key in sorted(self.counts):
-            lines.append(f"{key:<32} {self.counts[key]:>6} {self.bytes[key] / 1e6:>12.2f}")
-        for key in sorted(self.host_ms):
-            lines.append(f"{key:<32} host_ms={self.host_ms[key]:.1f}")
+        for key in sorted(counts):
+            lines.append(f"{key:<32} {counts[key]:>6} {nbytes[key] / 1e6:>12.2f}")
+        for key in sorted(host):
+            lines.append(f"{key:<32} host_ms={host[key]:.1f}")
         return "\n".join(lines)
 
     def census_lines(self, census) -> list:

@@ -440,6 +440,9 @@ class ServingEngine:
         # after its (injected) stall and bails out WITHOUT dispatching —
         # stale work never races the recovered engine
         self._epoch = 0
+        # latest watchdog round thread — close() joins it bounded so an
+        # abandoned round can't outlive the engine that spawned it
+        self._round_thread: Optional[threading.Thread] = None
         # the watchdog arms only once the quantum step has run once: the
         # first round's jit compile is legitimate wall time, not a hang
         self._quantum_warm = False
@@ -1086,6 +1089,7 @@ class ServingEngine:
                 box["error"] = e
 
         t = threading.Thread(target=run, daemon=True, name="serving-round")
+        self._round_thread = t
         t.start()
         t.join(timeout)
         if t.is_alive():
@@ -1427,6 +1431,25 @@ class ServingEngine:
                      "prefill_chunk_tokens": 0, "cow_forks": 0}
         if self._prefix_cache is not None:
             self._prefix_cache.reset_stats()
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission and join the latest watchdog round thread with
+        a bounded timeout (default: ``dispatch_timeout_s``, else 5s). A
+        hung round's thread is daemon — it cannot block interpreter exit
+        — but anything rebuilding engines in-process (the router's
+        failover path, test harnesses) must not let an abandoned round
+        outlive the engine that spawned it. Returns False when the round
+        thread outlived the budget (handle kept for a retry)."""
+        self._draining = True
+        if timeout is None:
+            timeout = self.config.dispatch_timeout_s or 5.0
+        t = self._round_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            if t.is_alive():
+                return False
+        self._round_thread = None
+        return True
 
     def stats(self) -> Dict[str, float]:
         """TTFT p50/p99 (ms) + aggregate generated-token throughput across

@@ -48,13 +48,17 @@ def _block_attend(q, k, v, q_off, k_off, causal, sm_scale):
 
 def ring_attention_local(q, k, v, *, axis_name: str = "seq",
                          causal: bool = True,
-                         sm_scale: Optional[float] = None):
+                         sm_scale: Optional[float] = None,
+                         axis_size: Optional[int] = None):
     """Call INSIDE shard_map: q/k/v are the local sequence shards
-    [B, S_local, N, D]; returns the local output shard."""
+    [B, S_local, N, D]; returns the local output shard. ``axis_size``
+    is the static ring size — pass it on jax versions without
+    ``lax.axis_size`` (the ppermute table must be built from a Python
+    int either way)."""
     B, Sl, N, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    sp = lax.axis_size(axis_name)
+    sp = axis_size if axis_size is not None else lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     q_off = my * Sl
     # send k/v to the NEXT rank each step => at step t we hold shard (my - t)
@@ -97,11 +101,21 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
     (ring attention inside a pipelined stage would need nested manual
     meshes)."""
     spec = P(batch_axes, axis_name, heads_axis, None)
-    fn = jax.shard_map(
-        functools.partial(ring_attention_local, axis_name=axis_name,
-                          causal=causal, sm_scale=sm_scale),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False)
+    local = functools.partial(ring_attention_local, axis_name=axis_name,
+                              causal=causal, sm_scale=sm_scale,
+                              axis_size=int(mesh.shape[axis_name]))
+    try:
+        fn = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False)
+    except (AttributeError, TypeError):
+        # older jax: jax.shard_map / check_vma don't exist yet — the
+        # experimental spelling with check_rep is the same full-manual mode
+        from jax.experimental.shard_map import shard_map
+        fn = shard_map(local, mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_rep=False)
     return fn(q, k, v)

@@ -1,0 +1,624 @@
+"""Deterministic interleaving explorer (graft-race, face 2).
+
+The host tier is genuinely concurrent — io_uring read/write pools and
+rotating staging buffers in ``runtime/infinity.py``/``runtime/swap_tensor.py``,
+a watchdog round thread in ``inference/serving.py``, a background telemetry
+worker in ``runtime/engine.py`` — but every analysis pass so far replays it
+single-threaded. This module makes thread schedules a *controlled input*:
+a cooperative scheduler serializes all tasks onto one runnable-at-a-time
+interleaving chosen by an explicit decision sequence, so a harness over the
+REAL classes can be run under hundreds of distinct schedules, assert its
+invariants on every one, and *replay* a failing schedule bit-for-bit from
+its printed id.
+
+How control is obtained
+-----------------------
+Tasks only switch at *preemption points*:
+
+* explicit ``sched.point()`` calls in harness code,
+* every line of code in ``trace_files`` modules (``sys.settrace``-driven,
+  so real classes are explored without modification),
+* the seams the components already route through when patched in
+  (``SchedExecutor`` for ``ThreadPoolExecutor``, ``SchedThread`` for
+  ``threading.Thread``, ``sched.clock``/``sched.sleep`` for time).
+
+Each task runs in a real (daemon) OS thread but is gated by a semaphore:
+exactly one task runs between scheduler decisions, so execution is
+sequentially consistent and fully determined by the decision sequence.
+
+Schedule ids
+------------
+``r<hex>``   — seeded-random: decisions drawn from ``random.Random(seed)``.
+``x1.0.2``   — explicit: the recorded decision list; the canonical REPLAY
+               form every failure report carries (robust to seed-derivation
+               changes, and what ``replay()`` takes).
+
+Timeouts (``Thread.join(t)``, ``Future.result(t)``, ``sched.sleep``) run on
+a VIRTUAL clock: when no task is runnable the clock jumps to the earliest
+deadline, so watchdog expiry is an explored *schedule*, not wall time.
+"""
+
+import contextlib
+import random
+import sys
+import threading as _threading
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+# the scheduler's own worker threads must be REAL threads even while
+# threading.Thread is patched to SchedThread inside `patched()`
+_RealThread = _threading.Thread
+_MAX_TRACE_TAIL = 40
+
+
+class InvariantViolation(AssertionError):
+    """A harness invariant broke under some schedule — the race fired."""
+
+
+class ScheduleDeadlock(RuntimeError):
+    """No task is runnable and no deadline can advance the clock: every
+    live task waits on a condition only another blocked task could
+    establish (e.g. a lock cycle)."""
+
+
+class _Aborted(BaseException):
+    # BaseException: must not be swallowed by harness `except Exception`
+    pass
+
+
+class _Task:
+    __slots__ = ("name", "thread", "gate", "done", "exc", "result",
+                 "pred", "deadline", "atomic", "exc_retrieved")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.thread = None
+        self.gate = _threading.Semaphore(0)
+        self.done = False
+        self.exc: Optional[BaseException] = None
+        self.result = None
+        self.pred: Optional[Callable[[], bool]] = None
+        self.deadline: Optional[float] = None
+        self.atomic = 0
+        self.exc_retrieved = False
+
+
+def _parse_schedule(sid: str) -> Tuple[Tuple[int, ...],
+                                       Optional[random.Random]]:
+    if sid.startswith("r"):
+        return (), random.Random(int(sid[1:] or "0", 16))
+    if sid.startswith("x"):
+        body = sid[1:]
+        forced = tuple(int(p) for p in body.split(".") if p != "")
+        return forced, None
+    raise ValueError(f"bad schedule id {sid!r}: want r<hexseed> or xD.D.D")
+
+
+class DeterministicScheduler:
+    """One interleaving: tasks spawn real threads but run strictly one at
+    a time; every preemption point hands control back here and the next
+    runnable task is chosen by the schedule's decision sequence."""
+
+    def __init__(self, schedule: str = "r0", *,
+                 trace_files: Sequence[str] = (),
+                 max_switches: int = 200_000):
+        self.schedule_id = schedule
+        self._forced, self._rng = _parse_schedule(schedule)
+        self._tasks: List[_Task] = []
+        self._gate = _threading.Semaphore(0)      # scheduler wakeups
+        self._local = _threading.local()
+        self.decisions: List[int] = []            # recorded choices
+        self.branches: List[int] = []             # runnable count per choice
+        self._clock = 0.0
+        self._switches = 0
+        self._max_switches = max_switches
+        self._abort = False
+        self._trace_files = tuple(trace_files)
+        self.trace_tail: List[str] = []           # last N (task, tag) points
+
+    # -- schedule identity ------------------------------------------------
+
+    @property
+    def replay_id(self) -> str:
+        """Explicit form of the decisions actually taken — feed back to
+        ``replay()``/``DeterministicScheduler(schedule=...)`` to reproduce
+        this exact interleaving."""
+        return "x" + ".".join(map(str, self.decisions))
+
+    # -- task plumbing ----------------------------------------------------
+
+    def current(self) -> Optional[_Task]:
+        return getattr(self._local, "task", None)
+
+    def spawn(self, fn: Callable, *args, name: Optional[str] = None,
+              **kwargs) -> _Task:
+        task = _Task(name or f"t{len(self._tasks)}")
+
+        def body():
+            self._local.task = task
+            task.gate.acquire()                 # wait to be scheduled
+            if self._trace_files:
+                sys.settrace(self._make_tracer())
+            try:
+                if not self._abort:
+                    task.result = fn(*args, **kwargs)
+            except _Aborted:
+                pass
+            except BaseException as e:          # noqa: BLE001 — relayed
+                task.exc = e
+            finally:
+                if self._trace_files:
+                    sys.settrace(None)
+                task.done = True
+                self._gate.release()
+
+        task.thread = _RealThread(target=body, daemon=True,
+                                  name=f"sched:{task.name}")
+        self._tasks.append(task)
+        task.thread.start()
+        return task
+
+    def point(self, tag: str = "") -> None:
+        """A potential context switch. No-op outside scheduler tasks and
+        inside ``atomic()`` sections."""
+        task = self.current()
+        if task is None or task.atomic:
+            return
+        if self._abort:
+            raise _Aborted()
+        if tag:
+            self.trace_tail.append(f"{task.name}@{tag}")
+            del self.trace_tail[:-_MAX_TRACE_TAIL]
+        self._gate.release()
+        task.gate.acquire()
+        if self._abort:
+            raise _Aborted()
+
+    def wait_for(self, pred: Callable[[], bool],
+                 deadline: Optional[float] = None,
+                 tag: str = "wait") -> bool:
+        """Block the current task until ``pred()`` holds or the virtual
+        clock reaches ``deadline``. Returns True iff the predicate held."""
+        task = self.current()
+        if task is None:                        # outside the scheduler
+            return bool(pred())
+        while not pred():
+            if deadline is not None and self._clock >= deadline:
+                return False
+            task.pred = pred
+            task.deadline = deadline
+            try:
+                self.point(tag)
+            finally:
+                task.pred = None
+                task.deadline = None
+        return True
+
+    # -- virtual time -----------------------------------------------------
+
+    def clock(self) -> float:
+        return self._clock
+
+    def sleep(self, dt: float) -> None:
+        self.wait_for(lambda: False, deadline=self._clock + dt, tag="sleep")
+
+    @contextlib.contextmanager
+    def atomic(self):
+        """Suppress preemption for the current task (models a critical
+        section the code under test performs without yielding)."""
+        task = self.current()
+        if task is None:
+            yield
+            return
+        task.atomic += 1
+        try:
+            yield
+        finally:
+            task.atomic -= 1
+
+    # -- the decision loop ------------------------------------------------
+
+    def _choose(self, n: int) -> int:
+        self.branches.append(n)
+        i = len(self.decisions)
+        if i < len(self._forced):
+            c = min(self._forced[i], n - 1)
+        elif self._rng is not None:
+            c = self._rng.randrange(n)
+        else:
+            c = 0
+        self.decisions.append(c)
+        return c
+
+    def _runnable(self, t: _Task) -> bool:
+        if t.pred is None:
+            return True
+        if t.deadline is not None and self._clock >= t.deadline:
+            return True
+        return bool(t.pred())
+
+    def run(self) -> None:
+        """Drive all spawned tasks to completion under this schedule; the
+        first task exception (not already retrieved via a future)
+        propagates."""
+        try:
+            while True:
+                live = [t for t in self._tasks if not t.done]
+                if not live:
+                    break
+                runnable = [t for t in live if self._runnable(t)]
+                if not runnable:
+                    deadlines = [t.deadline for t in live
+                                 if t.deadline is not None]
+                    if deadlines:
+                        self._clock = min(deadlines)
+                        continue
+                    raise ScheduleDeadlock(
+                        f"schedule {self.replay_id}: all of "
+                        f"{[t.name for t in live]} blocked with no deadline "
+                        f"(trace tail: {self.trace_tail[-8:]})")
+                self._switches += 1
+                if self._switches > self._max_switches:
+                    raise ScheduleDeadlock(
+                        f"schedule exceeded {self._max_switches} switches "
+                        "(livelock?)")
+                t = runnable[self._choose(len(runnable))]
+                t.gate.release()
+                self._gate.acquire()
+        finally:
+            self._abort_all()
+        for t in self._tasks:
+            if t.exc is not None and not t.exc_retrieved:
+                raise t.exc
+
+    def _abort_all(self) -> None:
+        self._abort = True
+        for _ in range(10_000):
+            live = [t for t in self._tasks if not t.done]
+            if not live:
+                break
+            for t in live:
+                t.gate.release()
+            for t in live:
+                t.thread.join(0.01)
+
+    # -- line-level preemption inside real classes ------------------------
+
+    def _make_tracer(self):
+        files = self._trace_files
+
+        def local_trace(frame, event, arg):
+            if event == "line":
+                self.point(f"{frame.f_code.co_name}:{frame.f_lineno}")
+            return local_trace
+
+        def global_trace(frame, event, arg):
+            if event == "call":
+                fn = frame.f_code.co_filename
+                if any(fn.endswith(sfx) for sfx in files):
+                    return local_trace
+            return None
+
+        return global_trace
+
+    def instrument(self, obj: Any, methods: Sequence[str]) -> Any:
+        """Bracket the named bound methods of ``obj`` with preemption
+        points (method-granularity interleaving over a real object)."""
+        cls = type(obj).__name__
+        for m in methods:
+            orig = getattr(obj, m)
+
+            def wrapped(*a, _orig=orig, _tag=f"{cls}.{m}", **k):
+                self.point(_tag + ":enter")
+                r = _orig(*a, **k)
+                self.point(_tag + ":exit")
+                return r
+
+            setattr(obj, m, wrapped)
+        return obj
+
+    # -- patched concurrency seams ---------------------------------------
+
+    @contextlib.contextmanager
+    def patched(self, *modules, thread: bool = True):
+        """Swap the concurrency seams the fleet routes through:
+        ``ThreadPoolExecutor`` in each given module (they import the name
+        directly) and ``threading.Thread`` globally (creations from
+        non-scheduler threads fall back to real threads)."""
+        sched = self
+        saved = []
+        for mod in modules:
+            if hasattr(mod, "ThreadPoolExecutor"):
+                saved.append((mod, "ThreadPoolExecutor",
+                              mod.ThreadPoolExecutor))
+                mod.ThreadPoolExecutor = \
+                    lambda *a, **k: SchedExecutor(sched, *a, **k)
+        orig_thread = _threading.Thread
+
+        def make_thread(*a, **kw):
+            if sched.current() is None and not sched._in_run_scope():
+                return _RealThread(*a, **kw)
+            return SchedThread(sched, *a, **kw)
+
+        if thread:
+            _threading.Thread = make_thread
+        try:
+            yield
+        finally:
+            if thread:
+                _threading.Thread = orig_thread
+            for mod, attr, val in saved:
+                setattr(mod, attr, val)
+
+    def _in_run_scope(self) -> bool:
+        # the driving (main) thread counts as in-scope while tasks exist
+        # and are not finished — harness setup code runs there too
+        return any(not t.done for t in self._tasks) or not self._tasks
+
+
+class SchedFuture:
+    """concurrent.futures.Future protocol over a scheduler task."""
+
+    def __init__(self, sched: DeterministicScheduler, task: _Task):
+        self._sched = sched
+        self._task = task
+
+    def done(self) -> bool:
+        return self._task.done
+
+    def running(self) -> bool:
+        return not self._task.done
+
+    def cancel(self) -> bool:
+        return False
+
+    def result(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None \
+            else self._sched.clock() + timeout
+        if not self._sched.wait_for(lambda: self._task.done, deadline,
+                                    tag="future.result"):
+            raise FuturesTimeoutError()
+        if self._task.exc is not None:
+            self._task.exc_retrieved = True
+            raise self._task.exc
+        return self._task.result
+
+    def exception(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None \
+            else self._sched.clock() + timeout
+        if not self._sched.wait_for(lambda: self._task.done, deadline,
+                                    tag="future.exception"):
+            raise FuturesTimeoutError()
+        if self._task.exc is not None:
+            self._task.exc_retrieved = True
+        return self._task.exc
+
+
+class SchedExecutor:
+    """ThreadPoolExecutor stand-in: ``submit`` spawns a scheduler task.
+    FIFO admission honors ``max_workers`` — a submit can't start before
+    enough earlier submits finished, exactly like a real bounded pool, so
+    the explorer never reports an interleaving a real 1-worker pool could
+    not produce."""
+
+    def __init__(self, sched: DeterministicScheduler,
+                 max_workers: Optional[int] = None, *args, **kwargs):
+        self._sched = sched
+        self._max_workers = max_workers or 8
+        self._tasks: List[_Task] = []
+        self._n = 0
+
+    def submit(self, fn: Callable, *args, **kwargs) -> SchedFuture:
+        idx = self._n
+        self._n += 1
+        earlier = list(self._tasks)
+
+        def admitted():
+            self._sched.wait_for(
+                lambda: sum(1 for t in earlier if not t.done)
+                < self._max_workers, tag="pool.admit")
+            return fn(*args, **kwargs)
+
+        task = self._sched.spawn(admitted, name=f"pool{idx}")
+        self._tasks.append(task)
+        return SchedFuture(self._sched, task)
+
+    def shutdown(self, wait: bool = True, **kwargs) -> None:
+        if wait:
+            self._sched.wait_for(
+                lambda: all(t.done for t in self._tasks),
+                tag="pool.shutdown")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=True)
+        return False
+
+
+class SchedThread:
+    """threading.Thread protocol over a scheduler task (what component
+    code gets when it calls ``threading.Thread`` under ``patched()``)."""
+
+    def __init__(self, sched: DeterministicScheduler, group=None,
+                 target=None, name=None, args=(), kwargs=None, *,
+                 daemon=None):
+        self._sched = sched
+        self._target = target
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._task: Optional[_Task] = None
+        self.name = name or "SchedThread"
+        self.daemon = bool(daemon)
+
+    def start(self) -> None:
+        if self._task is not None:
+            raise RuntimeError("threads can only be started once")
+        self._task = self._sched.spawn(
+            self._target, *self._args, name=self.name, **self._kwargs)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._task is None:
+            raise RuntimeError("cannot join thread before it is started")
+        deadline = None if timeout is None \
+            else self._sched.clock() + timeout
+        self._sched.wait_for(lambda: self._task.done, deadline,
+                             tag="thread.join")
+
+    def is_alive(self) -> bool:
+        return self._task is not None and not self._task.done
+
+
+class SchedLock:
+    """Cooperative lock for harness code (a real ``threading.Lock`` held
+    across a preemption point would deadlock the OS thread without the
+    scheduler knowing; this one blocks through ``wait_for`` so the
+    scheduler sees — and explores — the contention)."""
+
+    def __init__(self, sched: DeterministicScheduler):
+        self._sched = sched
+        self._owner: Optional[_Task] = None
+
+    def acquire(self) -> bool:
+        self._sched.wait_for(lambda: self._owner is None, tag="lock")
+        self._owner = self._sched.current() or _SENTINEL
+        return True
+
+    def release(self) -> None:
+        self._owner = None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+_SENTINEL = _Task("_outside")
+
+
+# -------------------------------------------------------------------------
+# exploration drivers
+# -------------------------------------------------------------------------
+
+class ScheduleFailure:
+    """One failing interleaving: ``replay_id`` reproduces it exactly."""
+
+    def __init__(self, schedule_id: str, replay_id: str,
+                 error: BaseException, index: int,
+                 trace_tail: Sequence[str] = ()):
+        self.schedule_id = schedule_id
+        self.replay_id = replay_id
+        self.error = error
+        self.index = index
+        self.trace_tail = list(trace_tail)
+
+    def __repr__(self):
+        return (f"ScheduleFailure({self.replay_id!r}, "
+                f"{type(self.error).__name__}: {self.error})")
+
+
+class ExploreResult:
+    def __init__(self, explored: int, failures: List[ScheduleFailure]):
+        self.explored = explored
+        self.failures = failures
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    @property
+    def first_failure(self) -> Optional[ScheduleFailure]:
+        return self.failures[0] if self.failures else None
+
+
+def run_schedule(harness: Callable[[DeterministicScheduler],
+                                   Optional[Callable[[], None]]],
+                 schedule_id: str, *, trace_files: Sequence[str] = (),
+                 max_switches: int = 200_000,
+                 index: int = 0) -> Optional[ScheduleFailure]:
+    """Run one harness under one schedule. The harness receives the
+    scheduler, spawns its tasks (and may return a final-check callable run
+    after every task completed); any exception — a task's, the harness's,
+    the final check's, or a deadlock — is the schedule failing."""
+    sched = DeterministicScheduler(schedule_id, trace_files=trace_files,
+                                   max_switches=max_switches)
+    try:
+        check = harness(sched)
+        sched.run()
+        if callable(check):
+            check()
+    except BaseException as e:    # noqa: BLE001 — every failure is data
+        return ScheduleFailure(schedule_id, sched.replay_id, e,
+                               index, sched.trace_tail)
+    return None
+
+
+def explore(harness, *, schedules: int = 200, seed: int = 0,
+            mode: str = "random", trace_files: Sequence[str] = (),
+            stop_on_failure: bool = False,
+            max_switches: int = 200_000) -> ExploreResult:
+    """Explore up to ``schedules`` interleavings of ``harness``.
+
+    ``mode="random"``: schedule i runs under seed ``seed + i`` — same
+    (seed, i) is always the same interleaving. ``mode="exhaustive"``:
+    DFS over the decision tree (complete when the tree is smaller than
+    the budget)."""
+    failures: List[ScheduleFailure] = []
+    explored = 0
+    if mode == "random":
+        for i in range(schedules):
+            sid = f"r{(seed + i) & 0xffffffffffff:x}"
+            fail = run_schedule(harness, sid, trace_files=trace_files,
+                                max_switches=max_switches, index=i)
+            explored += 1
+            if fail is not None:
+                failures.append(fail)
+                if stop_on_failure:
+                    break
+        return ExploreResult(explored, failures)
+    if mode != "exhaustive":
+        raise ValueError(f"mode={mode!r}: want 'random' or 'exhaustive'")
+    frontier: List[Tuple[int, ...]] = [()]
+    seen = set()
+    while frontier and explored < schedules:
+        prefix = frontier.pop()
+        if prefix in seen:
+            continue
+        seen.add(prefix)
+        sid = "x" + ".".join(map(str, prefix))
+        sched = DeterministicScheduler(sid, trace_files=trace_files,
+                                       max_switches=max_switches)
+        fail = None
+        try:
+            check = harness(sched)
+            sched.run()
+            if callable(check):
+                check()
+        except BaseException as e:  # noqa: BLE001
+            fail = ScheduleFailure(sid, sched.replay_id, e, explored,
+                                   sched.trace_tail)
+        explored += 1
+        if fail is not None:
+            failures.append(fail)
+            if stop_on_failure:
+                break
+        # branch: at every position past the forced prefix with >1
+        # runnable, the untaken choices are new prefixes to explore
+        for j in range(len(prefix), len(sched.decisions)):
+            taken, width = sched.decisions[j], sched.branches[j]
+            for c in range(width):
+                if c != taken:
+                    frontier.append(tuple(sched.decisions[:j]) + (c,))
+    return ExploreResult(explored, failures)
+
+
+def replay(harness, schedule_id: str, *,
+           trace_files: Sequence[str] = ()) -> Optional[ScheduleFailure]:
+    """Re-run one recorded schedule (the ``x...`` replay id a failure
+    printed, or an ``r<seed>`` id). Returns the failure, or None if the
+    schedule now passes."""
+    return run_schedule(harness, schedule_id, trace_files=trace_files)

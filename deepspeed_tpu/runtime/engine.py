@@ -619,6 +619,9 @@ class Engine:
         self._tel_last_window = None  # last drained window stats (host dict)
         self._tel_static = None      # cached static-join cost ({} = failed)
         self._tel_static_thread = None  # background lower/compile worker
+        import threading
+        self._tel_lock = threading.Lock()  # guards _tel_static (worker
+        # thread publishes the compiled cost; boundary drains poll it)
         self._tel_abs = None         # (jitted fn, abstract args, divisor)
         if self._tel_cfg is not None:
             from deepspeed_tpu.telemetry import (AnomalyDetector, HostWindow,
@@ -2083,7 +2086,8 @@ class Engine:
                 fn, abs_args, divisor = self._tel_abs
                 cost = static_step_cost(fn, abs_args, mesh=self.mesh,
                                         divisor=divisor)
-                self._tel_static = cost or {}
+                with self._tel_lock:
+                    self._tel_static = cost or {}
 
             self._tel_static_thread = threading.Thread(
                 target=work, name="telemetry-static-join", daemon=True)
@@ -2092,8 +2096,9 @@ class Engine:
             self._tel_static_thread.join()
         elif self._tel_static_thread.is_alive():
             return None
-        if self._tel_static is None:  # worker died without a result
-            self._tel_static = {}
+        with self._tel_lock:
+            if self._tel_static is None:  # worker died without a result
+                self._tel_static = {}
         return self._tel_static or None
 
     def _fetch_telemetry(self, extra=None):
@@ -2209,6 +2214,21 @@ class Engine:
         """Last drained telemetry window stats (None before the first
         drain). Host dict — reading it costs nothing."""
         return self._tel_last_window
+
+    def close(self, timeout: float = 5.0) -> bool:
+        """Join background host threads with a bounded timeout. Today
+        that is the telemetry static-join worker — daemon, so it never
+        blocks interpreter exit, but a harness that builds many engines
+        in one process wants the compile worker gone before the next
+        engine starts. Returns False when the worker outlived the budget
+        (its handle is kept so a later close can retry)."""
+        t = self._tel_static_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            if t.is_alive():
+                return False
+        self._tel_static_thread = None
+        return True
 
     def export_trace(self, path: Optional[str] = None) -> str:
         """Write the host step-phase spans (dispatch/prefetch/data_wait/

@@ -228,6 +228,49 @@ class LayerStore:
             self._dir = None
 
 
+class StagingRing:
+    """Rotating host staging buffers with write-behind fencing.
+
+    The native host-Adam sweep keeps three operations in flight — read
+    chunk i+1, update chunk i, drain chunk i-1 — over ``nbufs`` fixed
+    buffers. A buffer may still be draining (its write-behind future is
+    live) when the sweep comes back around to it; ``acquire`` is the
+    fence that waits that future out before handing the buffer back.
+    ``slot`` is the raw, unfenced view — identity checks only. Handing a
+    ``slot`` result to a writer is exactly the aliasing race the
+    ``staging-buffer-alias`` corpus entry demonstrates.
+    """
+
+    def __init__(self, nbufs: int, shape, dtype=np.float32):
+        self.nbufs = nbufs
+        self._bufs = [np.empty(shape, dtype) for _ in range(nbufs)]
+        self._busy: list = [None] * nbufs
+
+    def slot(self, i: int) -> np.ndarray:
+        """Raw buffer for slot ``i % nbufs`` — no fence, no wait."""
+        return self._bufs[i % self.nbufs]
+
+    def acquire(self, i: int) -> np.ndarray:
+        """Buffer for slot ``i % nbufs`` after its drain (if any) lands."""
+        k = i % self.nbufs
+        busy = self._busy[k]
+        if busy is not None:
+            busy.result()
+            self._busy[k] = None
+        return self._bufs[k]
+
+    def mark_busy(self, i: int, fut) -> None:
+        """Record the write-behind future draining slot ``i % nbufs``."""
+        self._busy[i % self.nbufs] = fut
+
+    def drain(self) -> None:
+        """Wait out every live write-behind."""
+        for k, busy in enumerate(self._busy):
+            if busy is not None:
+                busy.result()
+                self._busy[k] = None
+
+
 class InfinityExecutor:
     """Layer-streamed train/eval over NVMe-resident transformer layers.
 
@@ -440,7 +483,6 @@ class InfinityExecutor:
         # sweep (read fills one while Adam updates another in place and
         # write-behind drains the third)
         self._opt_stage = None
-        self._opt_stage_busy = None
         # host bf16-bits cache of param chunks (fast refetch for bwd/next
         # step; NVMe stays the system of record). Pointless for the pinned
         # backend — the store itself IS host memory.
@@ -1445,12 +1487,7 @@ class InfinityExecutor:
         whose consumption is pure numpy (in-place update + same-buffer
         write)."""
         import ml_dtypes
-        k = i % 3
-        busy = self._opt_stage_busy[k]
-        if busy is not None:
-            busy.result()
-            self._opt_stage_busy[k] = None
-        buf = self._opt_stage[k]
+        buf = self._opt_stage.acquire(i)
         got = self.store.read_opt(i, out=buf)
         if got is None:   # lazy init: master from the bf16 params
             np.copyto(buf[0], self._get_param(i).view(ml_dtypes.bfloat16))
@@ -1475,9 +1512,8 @@ class InfinityExecutor:
         step = self.applied_steps
         pipe = self.pipeline
         if self._opt_stage is None:
-            self._opt_stage = [np.empty((_PLANES, self.chunk), np.float32)
-                               for _ in range(3)]
-            self._opt_stage_busy = [None, None, None]
+            self._opt_stage = StagingRing(3, (_PLANES, self.chunk),
+                                          np.float32)
         opt_fut = self._rpool.submit(self._opt_read_staged, 0) \
             if pipe else None
         for i in range(L):
@@ -1504,8 +1540,8 @@ class InfinityExecutor:
             if pipe:
                 self._bound_writes()
                 fut = self._wpool.submit(work)
-                if opt is self._opt_stage[i % 3]:
-                    self._opt_stage_busy[i % 3] = fut
+                if opt is self._opt_stage.slot(i):
+                    self._opt_stage.mark_busy(i, fut)
                 self._pending_writes.append(fut)
             else:
                 work()   # drained twin: write + implicit drain per layer

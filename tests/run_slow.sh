@@ -111,6 +111,10 @@ for m in "${modules[@]}"; do
         # rebuilds + per-bucket prefill compiles + int8 pool parity over
         # 24 decode steps) own a budget independent of the tier default
         *test_serving*) budget="${SERVING_BUDGET:-420}" ;;
+        # ISSUE-16 race-explorer soaks: exhaustive decision-tree sweeps
+        # of the corpus harnesses + 1000-schedule random soaks of the
+        # corrected twins + the full two-face CLI gate
+        *test_race_lint*) budget="${RACE_BUDGET:-420}" ;;
     esac
     t0=$(date +%s)
     out=$(timeout -k 10 "$budget" \
