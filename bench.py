@@ -343,6 +343,11 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 print(f"bench: latency bench failed: {e}", file=sys.stderr)
             gc.collect()
             try:
+                result.update(_lora_bench(size))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: lora bench failed: {e}", file=sys.stderr)
+            gc.collect()
+            try:
                 result.update(_router_bench(size))
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: router bench failed: {e}", file=sys.stderr)
@@ -382,6 +387,15 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 result.update(_latency_bench(size, small=True))
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: latency bench failed: {e}", file=sys.stderr)
+            # CPU smoke of the multi-tenancy rungs: tiny model, same
+            # adapter slot-pool / gathered-einsum / int8-weight paths
+            # incl. the mixed-vs-merged-serial parity assertion and the
+            # >=0.9 greedy-agreement bar, so serve_lora_* and
+            # serve_int8w_* can't rot on boxes without the relay
+            try:
+                result.update(_lora_bench(size, small=True))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: lora bench failed: {e}", file=sys.stderr)
             # CPU smoke of the 2-replica router rung: tiny model, same
             # router/registry/failover code path incl. the mid-run kill,
             # so serve_failover_ms / serve_lost_requests can't rot on
@@ -1525,6 +1539,165 @@ def _latency_bench(size: str, small: bool = False) -> dict:
         "serve_spec_steps": int(st.get("spec_steps", 0)),
     })
     del srv
+    _gc.collect()
+    return out
+
+
+def _lora_bench(size: str, small: bool = False) -> dict:
+    """Massive-multi-tenancy rungs (ISSUE 17): paged multi-LoRA serving
+    and weight-only int8 decode matmuls, measured WITH their parity bars.
+
+    * ``serve_lora_tok_per_sec`` — a mixed load (every decode quantum
+      batches requests of DIFFERENT adapters plus base-model traffic)
+      through the device adapter slot pool, next to
+      ``serve_lora_base_tok_per_sec`` (the same load with no adapters
+      armed); ``serve_lora_floor_ok`` pins the >=0.8x SLO bar. The
+      parity bar is asserted, not just recorded: the mixed batch's
+      greedy outputs must EQUAL serving each adapter serially through
+      an engine with that adapter's delta merged into the dense weights
+      (``apply_lora_dense``) — the gathered-einsum path vs the offline
+      single-tenant merge.
+    * ``serve_int8w_tok_per_sec`` / ``serve_int8w_hbm_bytes`` — the same
+      load through ``weight_bits=8`` (per-channel scales, dequant fused
+      into the matmul epilogue, weights RESIDENT int8 in HBM), with the
+      weights-at-rest byte count next to the unquantized engine's and
+      ``serve_int8w_greedy_agreement`` >= 0.9 as the accuracy bar.
+
+    f32 compute + ``kv_cache_bits=0`` so the mixed-vs-serial comparison
+    is EXACT token equality (same reasoning as the prefix-cache rung);
+    the quantized-decode floor rung (``decode_floor_ok``) is untouched.
+    """
+    import gc as _gc
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.lora import (apply_lora_dense,
+                                              make_random_adapter)
+    from deepspeed_tpu.models import llama_config, make_model
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.parallel.partitioning import sharded_bytes
+
+    overrides = dict(vocab_size=2048, num_layers=2, hidden_size=128,
+                     num_heads=4, num_kv_heads=2,
+                     intermediate_size=384) if small else {}
+    cfg = llama_config(size, max_seq_len=4096, dtype=jnp.float32,
+                       **overrides)
+    model = make_model(cfg, name=f"llama-{size}-lora")
+    rng = np.random.default_rng(0)
+    if small:
+        geom = dict(max_seqs=4, block_size=16, max_model_len=128,
+                    decode_quantum=4, prompt_bucket=16)
+        # 4 slots (incl. the reserved null) for 4 tenants: the timed load
+        # EXERCISES eviction/re-page, not just warm hits
+        n_req, n_adapters, rank, slots, max_new = 8, 4, 4, 4, 8
+        plens = (16, 24, 32)
+    else:
+        geom = dict(max_seqs=16, block_size=64, max_model_len=2048,
+                    decode_quantum=8, num_blocks=640)
+        n_req, n_adapters, rank, slots, max_new = 32, 8, 8, 6, 32
+        plens = (64, 128, 256)
+    # the parity oracle folds A@B into the DENSE weights, so every engine
+    # must share one raw (unfused) param tree — init_serving fuses wqkv
+    # internally either way
+    raw = jax.device_get(init_params(jax.random.PRNGKey(0), cfg))
+    adapters = {a: make_random_adapter(cfg, rank, seed=a)
+                for a in range(1, n_adapters + 1)}
+    # round-robin over {base, adapter 1..N}: every quantum mixes tenants
+    aids = [i % (n_adapters + 1) for i in range(n_req)]
+    prompts = [rng.integers(0, cfg.vocab_size, size=(plens[i % len(plens)],)
+                            ).astype(np.int32) for i in range(n_req)]
+
+    def serve(extra, params, config_extra=None):
+        return deepspeed_tpu.init_serving(
+            model, config=dict({"train_batch_size": 1, "kv_cache_bits": 0},
+                               **(config_extra or {})),
+            serving=dict(geom, **extra), params=params,
+            dtype=jnp.float32)
+
+    def timed_run(srv, reqs, warmup=1):
+        for _ in range(warmup):
+            srv.run(list(reqs))
+        srv.reset_stats()
+        t0 = time.perf_counter()
+        outs = srv.run(list(reqs))
+        return outs, time.perf_counter() - t0, srv.stats()
+
+    out = {}
+    base_reqs = [(prompts[i], max_new) for i in range(n_req)]
+    base_srv = serve({}, params=raw)
+    base_outs, base_dt, base_st = timed_run(base_srv, base_reqs)
+    del base_srv
+    _gc.collect()
+
+    lora_srv = serve(dict(adapter_slots=slots, lora_rank=rank), params=raw)
+    for a, tabs in adapters.items():
+        lora_srv.register_adapter(a, tabs)
+    lora_reqs = [(prompts[i], max_new, aids[i]) for i in range(n_req)]
+    lora_outs, lora_dt, lora_st = timed_run(lora_srv, lora_reqs)
+    mixed = [lora_outs[k] for k in sorted(lora_outs)]
+    del lora_srv
+    _gc.collect()
+
+    # the parity bar: serial per-adapter serving through MERGED dense
+    # weights must reproduce the mixed batch token-for-token (small mode
+    # covers every tenant; full mode a 3-tenant sample — the exhaustive
+    # sweep lives in tests/unit/test_lora_serving.py)
+    check = sorted(set(aids)) if small else sorted(set(aids))[:3]
+    for a in check:
+        sp = apply_lora_dense(raw, cfg, adapters[a]) if a else raw
+        ssrv = serve({}, params=sp)
+        idxs = [i for i in range(n_req) if aids[i] == a]
+        souts = ssrv.run([(prompts[i], max_new) for i in idxs])
+        for i, o in zip(idxs, (souts[k] for k in sorted(souts))):
+            np.testing.assert_array_equal(
+                mixed[i], o, err_msg=f"lora rung: request {i} (adapter "
+                f"{a}) diverged from the merged-dense serial oracle")
+        del ssrv
+        _gc.collect()
+
+    base_tps = base_st.get("generated_tokens", 0.0) / base_dt
+    lora_tps = lora_st.get("generated_tokens", 0.0) / lora_dt
+    ratio = lora_tps / base_tps if base_tps else 0.0
+    # the >=0.8x bar is the TPU SLO; the CPU smoke is dispatch-overhead
+    # dominated (tiny model, deliberate slot thrash) so its floor only
+    # guards against pathological regressions
+    floor = 0.4 if small else 0.8
+    out.update({
+        "serve_lora_tok_per_sec": round(lora_tps, 1),
+        "serve_lora_base_tok_per_sec": round(base_tps, 1),
+        "serve_lora_ratio": round(ratio, 3),
+        "serve_lora_floor_ok": bool(ratio >= floor),
+        "serve_adapter_hits": int(lora_st.get("adapter_hits", 0)),
+        "serve_adapter_page_ins": int(lora_st.get("adapter_page_ins", 0)),
+        "serve_adapter_evictions": int(lora_st.get("adapter_evictions", 0)),
+    })
+
+    # weight-only int8 rung: same load, weights at rest int8 + f32
+    # per-channel scales, dequant in the matmul epilogue; agreement is
+    # per-token greedy match vs the unquantized engine
+    i8_srv = serve({}, params=raw, config_extra={"weight_bits": 8})
+    i8_outs, i8_dt, i8_st = timed_run(i8_srv, base_reqs)
+    i8_bytes = int(sharded_bytes(i8_srv.engine.params))
+    base_bytes = int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                         for a in jax.tree.leaves(raw)))
+    agree = tot = 0
+    for b, q in zip((base_outs[k] for k in sorted(base_outs)),
+                    (i8_outs[k] for k in sorted(i8_outs))):
+        n = min(len(b), len(q))
+        agree += int(np.sum(np.asarray(b[:n]) == np.asarray(q[:n])))
+        tot += max(len(b), len(q))
+    agreement = agree / tot if tot else 0.0
+    out.update({
+        "serve_int8w_tok_per_sec": round(
+            i8_st.get("generated_tokens", 0.0) / i8_dt, 1),
+        "serve_int8w_hbm_bytes": i8_bytes,
+        "serve_int8w_hbm_bytes_f32": base_bytes,
+        "serve_int8w_hbm_ratio": round(i8_bytes / base_bytes, 3),
+        "serve_int8w_greedy_agreement": round(agreement, 4),
+        "serve_int8w_agreement_ok": bool(agreement >= 0.9),
+        "serve_int8w_weight_bits": int(i8_st.get("weight_bits", 0)),
+    })
+    del i8_srv
     _gc.collect()
     return out
 

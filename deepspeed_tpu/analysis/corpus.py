@@ -615,6 +615,116 @@ def tp_serving_replicated_pool(devices=None):
     return tp_serving_pool_report(shard_pool=False, devices=devices)
 
 
+# the int8 layer stack of the toy rung is ~88 KiB total (smallest matmul
+# payload 4 KiB): a 4 KiB floor puts every quantized weight in scope while
+# the correctly-sharded twin's explicitly-replicated tensors (norm scales,
+# per-channel dequant scales) all sit below it
+INT8W_REPL_FLOOR = 4 << 10
+
+
+def int8_weight_pool_report(shard_weights: bool, devices=None):
+    """Lower the weight-only int8 tp=2 decode step (decode_step_paged over
+    ``{"q": s8, "scale": f32}`` layer weights, dequant fused into the
+    matmul epilogue) over a 2-device `tensor` mesh — the quantized stack
+    either sharded per ``quantized_logical_axes`` (int8 payload columns
+    with the projection, scales riding the same out-channel axis: the
+    correct twin) or REPLICATED across `tensor` (the planted defect) — and
+    audit the replication budget. The whole point of weight-only int8 is
+    halving what HBM holds; a replicated quantized stack pays full bytes
+    per chip and quietly gives the win back."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  make_model,
+                                                  quantize_layer_stack,
+                                                  quantized_logical_axes)
+    from deepspeed_tpu.parallel import make_rules, spec_tree
+
+    devs = devices or jax.devices()[:2]
+    if len(devs) < 2:
+        raise SystemExit("corpus: needs >= 2 devices "
+                         "(--xla_force_host_platform_device_count)")
+    mesh = Mesh(list(devs)[:2], ("tensor",))
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=256,
+                            dtype=jnp.float32, attention_impl="xla",
+                            # rotary: no learned position table (a 64 KiB
+                            # replicated-by-design f32 param that would sit
+                            # above the 4 KiB scan floor in BOTH twins)
+                            position_type="rotary",
+                            quantized_weights=True, weight_only_bits=8)
+    model = make_model(cfg, name="tiny-serve-int8w")
+    S, MB, bs, NB = 4, 4, 32, 33
+    rules = make_rules(zero_stage=0, tp=True)
+
+    def with_specs(tree, spec_t):
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        specs = treedef.flatten_up_to(spec_t)
+        return treedef.unflatten([
+            jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                 sharding=NamedSharding(mesh, s))
+            for l, s in zip(flat, specs)])
+
+    raw = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    qparams = jax.eval_shape(lambda p: quantize_layer_stack(p, bits=8), raw)
+    qspec = spec_tree(quantized_logical_axes(cfg), rules)
+    if not shard_weights:
+        # the defect: the quantized stack (s8 payloads + f32 scales) lands
+        # replicated on every chip; everything else keeps its layout
+        qspec = dict(qspec)
+        qspec["layers"] = jax.tree.map(lambda _: P(), qparams["layers"])
+    params = with_specs(qparams, qspec)
+    pools = with_specs(jax.eval_shape(lambda: model.init_paged_cache(NB, bs)),
+                       spec_tree(model.paged_cache_axes(), rules))
+    toks = jax.ShapeDtypeStruct((S,), jnp.int32)
+    tables = jax.ShapeDtypeStruct((S, MB), jnp.int32)
+    lens = jax.ShapeDtypeStruct((S,), jnp.int32)
+
+    def step(params, pools, tokens, tables, lens):
+        logits, pools = model.decode_step_paged(params, tokens, pools,
+                                                tables, lens, backend="xla")
+        return jnp.argmax(logits, -1).astype(jnp.int32), pools
+
+    name = ("serve_decode_step_int8w_tp2" if shard_weights
+            else "serve_decode_step_int8w_tp2_repl")
+    art = lower_program(
+        jax.jit(step, donate_argnums=(1,)), params, pools, toks, tables,
+        lens, name=name, mesh=mesh, donatable={"pools": pools},
+        donation_expected=False,
+        meta={"skip_required": True, "world_size": 2})
+    return analyze_programs(
+        [art], _stage0_config(), _FakeTPPlan(),
+        settings=AnalysisSettings(min_replicated_bytes=INT8W_REPL_FLOOR))
+
+
+def quantized_weight_replicated(devices=None):
+    """Weight-only-quantization audit: the tp=2 int8-weight decode step
+    whose quantized layer stack was accidentally REPLICATED across the
+    `tensor` axis — each chip holds the full s8 payload + scales, so the
+    HBM halving that justified weight-only int8 is silently returned.
+    ``replication-over-budget`` must fire (the int8 payloads are in scope:
+    the replication scanner prices s8 tensors alongside floats). The
+    correctly-sharded twin (``int8_weight_pool_report(shard_weights=True)``
+    — payload columns with the projection, scales on the same out-channel
+    axis) passes the identical settings — tests assert both directions;
+    CLI-runnable (``lint --corpus quantized-weight-replicated``)."""
+    return int8_weight_pool_report(shard_weights=False, devices=devices)
+
+
+def adapter_slot_leak(devices=None):
+    """Multi-tenancy audit: a serving request path that never releases its
+    LoRA adapter-slot pin under churned multi-tenant load. Refcounts only
+    climb, refcount-0 residents never reach the LRU queue, and the slot
+    pool exhausts even though every request that pinned it has long
+    finished. ``pool-growth`` must fire. The correctly-releasing twin
+    (same churn, every finish drops its pin) cycles the load through LRU
+    eviction forever and passes — tests assert both directions; the twin
+    is also CLI-runnable (``serving_lint --adapters --correct``)."""
+    from deepspeed_tpu.analysis.serving_lint import audit_adapters
+    return audit_adapters(correct=False)
+
+
 def serving_unbounded_queue(devices=None):
     """Admission audit: the serving scheduler configured with NO admission
     watermark under a sustained exhaustion storm — every arrival queues,
@@ -719,6 +829,8 @@ CORPUS = {
     "stage3-replicated-opt": stage3_replicated_opt,
     "paged-cache-leak": paged_cache_leak,
     "tp-serving-replicated-pool": tp_serving_replicated_pool,
+    "quantized-weight-replicated": quantized_weight_replicated,
+    "adapter-slot-leak": adapter_slot_leak,
     "serving-unbounded-queue": serving_unbounded_queue,
     "router-blackhole": router_blackhole,
     "prefix-refcount-leak": prefix_refcount_leak,

@@ -24,7 +24,8 @@ from typing import Dict, List, Optional, Tuple
 
 # HLO primitive byte widths (token/opaque types are skipped).
 ITEMSIZE = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "pred": 1, "s8": 1, "u8": 1, "i8": 1, "ui8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
     "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
     "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
 }
@@ -782,9 +783,12 @@ def parse_spmd_remat_warning(line: str) -> Dict[str, object]:
 _REPLICATED_RE = re.compile(
     r'sharding\s*=\s*(?:"?\{replicated\}"?|\{\{replicated\}\})')
 # anchored on '=' so only the RESULT shape is charged — matching operand
-# shapes would bill a big sharded input to a tiny replicated result
-_FLOAT_SHAPE_RE = re.compile(r"=\s*(f32|bf16|f16|f64)\[([\d,]+)\]")
-_FLOAT_SHAPE_ST_RE = re.compile(r"tensor<([\dx]+)x(f32|bf16|f16|f64)>")
+# shapes would bill a big sharded input to a tiny replicated result.
+# int8 is in scope alongside floats: weight-only-quantized decode keeps
+# its matmul weights as s8 payloads in HBM (ISSUE 17), and a replicated
+# int8 weight stack wastes HBM exactly like a replicated float one
+_FLOAT_SHAPE_RE = re.compile(r"=\s*(f32|bf16|f16|f64|s8|u8)\[([\d,]+)\]")
+_FLOAT_SHAPE_ST_RE = re.compile(r"tensor<([\dx]+)x(f32|bf16|f16|f64|i8|ui8)>")
 
 
 def replicated_tensor_bytes(hlo_text: str,

@@ -457,6 +457,92 @@ def audit_prefix(correct: bool = False, **sim_kwargs) -> Report:
     return report
 
 
+# the adapter slot pool is tiny by design (slots << registered adapters);
+# a request path that never releases its pin wedges it within a handful of
+# admission waves — any exhaustion under a finishing workload is the leak
+ADAPTER_PIN_BOUND = 6
+
+
+def simulate_adapters(correct: bool, rounds: int = 24, num_slots: int = 8,
+                      adapters: int = 16,
+                      arrivals_per_round: int = 2) -> Dict[str, Any]:
+    """Deterministic multi-tenant churn through the REAL
+    ``AdapterSlotPool`` (pure host, no jax): every round
+    ``arrivals_per_round`` requests arrive for rotating adapter ids,
+    acquire a device slot, serve, and finish. ``correct=False`` models the
+    seeded defect — the finish path never releases its adapter pin
+    (``_release_adapter`` skipped), so refcounts only ever climb: the LRU
+    queue stays empty (eviction needs a refcount-0 resident), every slot
+    wedges pinned, and the next unseen adapter exhausts the pool even
+    though every request that pinned it has long finished. The releasing
+    twin cycles the same load through LRU eviction forever. Returns the
+    per-round outstanding-pin trajectory plus the pool counters."""
+    from deepspeed_tpu.inference.kv_cache import (AdapterSlotPool,
+                                                  BlockPoolExhausted)
+
+    pool = AdapterSlotPool(num_slots)
+    pinned = []
+    exhausted_at = None
+    aid = 0
+    for rnd in range(rounds):
+        served = []
+        for _ in range(arrivals_per_round):
+            aid = aid % adapters + 1          # rotate tenants 1..adapters
+            try:
+                pool.acquire(aid)
+            except BlockPoolExhausted:
+                exhausted_at = rnd
+                break
+            served.append(aid)
+        if exhausted_at is not None:
+            break
+        # ...the requests decode and finish; the release is the lifecycle
+        # step under audit
+        if correct:
+            for a in served:
+                pool.release(a)
+        pinned.append(sum(pool.refcount(a) for a in list(pool._slot)))
+    return {"pinned": pinned, "rounds": rounds, "correct": correct,
+            "exhausted_at": exhausted_at, "num_slots": num_slots,
+            "adapters": adapters, "hits": pool.hits,
+            "evictions": pool.evictions, "page_ins": pool.page_ins}
+
+
+def audit_adapters(correct: bool = False, **sim_kwargs) -> Report:
+    """Run the multi-tenant churn replay and gate it: outstanding adapter
+    pins growing monotonically past ``ADAPTER_PIN_BOUND`` — or the pool
+    exhausting under a workload where every request finishes — = the
+    ``pool-growth`` defect (a request path leaking its adapter-slot pin)."""
+    sim = simulate_adapters(correct=correct, **sim_kwargs)
+    pinned = sim["pinned"]
+    monotone = all(b >= a for a, b in zip(pinned, pinned[1:]))
+    report = Report(meta={"analyzer": "serving-adapters", **sim})
+    grew = pinned and monotone and pinned[-1] >= ADAPTER_PIN_BOUND
+    if grew or sim["exhausted_at"] is not None:
+        report.extend([Finding(
+            rule="pool-growth",
+            message=("multi-tenant LoRA serving leaked adapter-slot pins: "
+                     "outstanding pins grew monotonically to "
+                     f"{pinned[-1] if pinned else 'exhaustion'} over "
+                     f"{len(pinned)} churned rounds"
+                     + (f" (slot pool exhausted at round "
+                        f"{sim['exhausted_at']} with every request long "
+                        "finished)"
+                        if sim["exhausted_at"] is not None else "")
+                     + " — every request leaving the running set (finish / "
+                     "cancel / preempt) must drop its pin "
+                     "(AdapterSlotPool.release), or refcount-0 residents "
+                     "never reach the LRU queue and eviction can never "
+                     "free a slot for the next tenant"),
+            severity="error", program="serving_adapters",
+            ident="adapter-slot-leak",
+            data={"final_pinned": pinned[-1] if pinned else None,
+                  "rounds": len(pinned),
+                  "exhausted_at": sim["exhausted_at"],
+                  "evictions": sim["evictions"]})])
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis.serving_lint",
@@ -480,13 +566,19 @@ def main(argv=None) -> int:
                    help="run the CoW prefix-refcount audit instead "
                         "(churned shared-prefix load; pool-growth gate)")
     p.add_argument("--correct", action="store_true",
-                   help="prefix audit only: the correctly-decrementing "
-                        "fork path (the passing twin; omit = the seeded "
+                   help="prefix/adapters audits: the correctly-releasing "
+                        "path (the passing twin; omit = the seeded "
                         "defect)")
+    p.add_argument("--adapters", action="store_true",
+                   help="run the LoRA adapter-slot audit instead (churned "
+                        "multi-tenant load; pool-growth gate)")
     p.add_argument("--json", action="store_true",
                    help="print the full report as JSON")
     args = p.parse_args(argv)
-    if args.prefix:
+    if args.adapters:
+        report = audit_adapters(correct=args.correct,
+                                rounds=max(args.rounds, 16))
+    elif args.prefix:
         report = audit_prefix(correct=args.correct,
                               rounds=max(args.rounds, 16))
     elif args.router:
@@ -498,6 +590,19 @@ def main(argv=None) -> int:
                                  rounds=args.rounds)
     if args.json:
         print(json.dumps(report.to_dict(), indent=1, default=str))
+    elif args.adapters:
+        sim = report.meta
+        pinned = sim["pinned"]
+        print(f"serving_lint: outstanding adapter pins "
+              f"{pinned[-1] if pinned else 0} after {len(pinned)} churned "
+              f"rounds ({sim['page_ins']} page-ins, {sim['evictions']} "
+              "evictions)"
+              + (f", slot pool EXHAUSTED at round {sim['exhausted_at']}"
+                 if sim["exhausted_at"] is not None else ""))
+        for f in report.findings:
+            print(f"  {f.severity}: {f.rule}: {f.message}")
+        if report.ok:
+            print("serving_lint: OK (pins released, slots recycle)")
     elif args.prefix:
         sim = report.meta
         held = sim["held_blocks"]

@@ -75,6 +75,16 @@ class InferenceConfig:
     # fused_gemm_gelu); tp=1 only. None -> on for float weights, off for
     # int8 (measured: fusion hurts the dequant-in-scan path ~20% on v5e)
     fuse_gemms: Optional[bool] = None
+    # weight-ONLY int8 decode matmuls (ISSUE 17): weights stay int8 in
+    # HBM — a ~2x bigger model fits per replica — and the dequant fuses
+    # into the matmul EPILOGUE (per-out-channel scales factor out of the
+    # contraction; see ops/quantizer.weight_matmul), instead of the
+    # quantize_bits dequant-in-scan path that materializes a float copy
+    # of each layer. Scales shard with their out columns under TP
+    # (quantized_logical_axes), so this composes with tensor parallelism
+    # and the paged/spec/chunked serving paths. Mutually exclusive with
+    # quantize_bits. 8 is the only supported value.
+    weight_bits: Optional[int] = None
     # int8 KV cache for decode: at long context the cache read is the
     # decode bound, and int8 halves it (per-position scales keep the
     # softmax exact to ~1e-2 rel). None -> context-aware default: ON when
@@ -125,8 +135,21 @@ class InferenceEngine:
         self.dtype = config.dtype or jnp.bfloat16
 
         # int8 weight-only quantization: rebuild the model with the
-        # dequant-in-scan forward and the {"q","scale"} param structure
+        # dequant-in-scan forward and the {"q","scale"} param structure.
+        # weight_bits=8 shares the storage layout but keeps the weights
+        # int8 through the matmul (epilogue dequant) — the serving path.
         self._quantized = bool(config.quantize_bits)
+        self._weight_only = bool(getattr(config, "weight_bits", None))
+        if self._weight_only:
+            if int(config.weight_bits) != 8:
+                raise ValueError(f"weight_bits={config.weight_bits} "
+                                 "unsupported (8 = int8 is the only value)")
+            if self._quantized:
+                raise ValueError(
+                    "weight_bits and quantize_bits are mutually exclusive: "
+                    "both store int8 weights — weight_bits fuses the "
+                    "dequant into the matmul epilogue instead of "
+                    "materializing a float copy per layer")
         from deepspeed_tpu.models.transformer import TransformerConfig
         is_tf = isinstance(getattr(model, "config", None), TransformerConfig)
         if ep > 1:
@@ -174,18 +197,20 @@ class InferenceEngine:
         # decode GEMV fusion (wqkv, w_in_gate): tp=1 only — the concat dim
         # would interleave head shards under tensor parallelism
         fuse = (config.fuse_gemms if config.fuse_gemms is not None
-                else not self._quantized)
+                else not (self._quantized or self._weight_only))
         self._fused = (fuse and is_tf and tp == 1
                        and model.config.num_experts == 1)
-        if self._quantized:
+        if self._quantized or self._weight_only:
             import dataclasses as _dc
             from deepspeed_tpu.models.transformer import (
                 fused_logical_axes, quantized_logical_axes)
             from deepspeed_tpu.models import make_model as _mk
             if not is_tf:
-                raise ValueError("quantize_bits requires a transformer "
-                                 "ModelSpec")
-            qcfg = _dc.replace(model.config, quantized_weights=True)
+                raise ValueError("quantize_bits/weight_bits require a "
+                                 "transformer ModelSpec")
+            qcfg = _dc.replace(
+                model.config, quantized_weights=True,
+                weight_only_bits=8 if self._weight_only else 0)
             base_axes = fused_logical_axes(qcfg) if self._fused else None
             model = _dc.replace(_mk(qcfg, name=model.name),
                                 logical_axes=quantized_logical_axes(
@@ -219,7 +244,7 @@ class InferenceEngine:
                 return unfuse_layer_stack(p, model.config)
             return p
 
-        if self._quantized:
+        if self._quantized or self._weight_only:
             from deepspeed_tpu.models.transformer import quantize_layer_stack
             if params is None:
                 rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -228,7 +253,8 @@ class InferenceEngine:
                 lambda p: quantize_layer_stack(_fuse(jax.tree.map(
                     lambda x: x.astype(self.dtype)
                     if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-                    else x, p)), bits=int(config.quantize_bits)),
+                    else x, p)), bits=int(config.quantize_bits
+                                          or config.weight_bits)),
                 out_shardings=self.param_shardings)
             with mesh:
                 params = quant_fn(jax.tree.map(jnp.asarray, params))

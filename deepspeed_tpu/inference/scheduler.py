@@ -114,6 +114,16 @@ class Request:
     cow_dst: Optional[int] = None
     # wall time the request last received tokens at the host (ITL stats)
     last_token_t: Optional[float] = None
+    # --- multi-tenancy (ISSUE 17) -------------------------------------
+    # which registered LoRA adapter serves this request (0 = base model /
+    # the null adapter). Pure routing data to the scheduler; the serving
+    # engine pins a device slot at admission and releases it when the
+    # request leaves the running set.
+    adapter_id: int = 0
+    # device slot the adapter is paged into while running (None when not
+    # pinned) — engine-owned, mirrored here so _tables_device can build
+    # the per-round adapter-index vector without a lookup
+    adapter_slot: Optional[int] = None
 
     @property
     def context(self) -> np.ndarray:
@@ -201,7 +211,8 @@ class RequestScheduler:
     def submit(self, prompt, max_new_tokens: int,
                rid: Optional[int] = None,
                ttft_deadline_ms: Optional[float] = None,
-               deadline_ms: Optional[float] = None) -> Request:
+               deadline_ms: Optional[float] = None,
+               adapter_id: int = 0) -> Request:
         if self.max_queue is not None and len(self.waiting) >= self.max_queue:
             raise AdmissionRejected("queue_full",
                                     queue_len=len(self.waiting),
@@ -222,7 +233,8 @@ class RequestScheduler:
                       max_new_tokens=int(max_new_tokens),
                       submit_t=time.perf_counter(),
                       ttft_deadline_ms=ttft_deadline_ms,
-                      deadline_ms=deadline_ms)
+                      deadline_ms=deadline_ms,
+                      adapter_id=int(adapter_id))
         self._next_rid = max(self._next_rid, req.rid) + 1
         self.waiting.append(req)
         return req
@@ -242,6 +254,7 @@ class RequestScheduler:
         req.prefix_rows = 0
         req.cow_src = req.cow_dst = None
         req.last_token_t = None
+        req.adapter_slot = None
         self._next_rid = max(self._next_rid, req.rid) + 1
         self.waiting.append(req)
 
@@ -260,6 +273,12 @@ class RequestScheduler:
         consumer copy-on-write forks it). Rows past the real context
         (quantum overshoot / rejected speculation) are never published."""
         if self.prefix_cache is None or not req.block_ids:
+            return
+        if req.adapter_id:
+            # adapter KV rows are adapter-SPECIFIC (the LoRA delta flows
+            # into k/v): publishing them under a content-only hash would
+            # alias another tenant's cache — adapter requests neither
+            # publish nor match (base-model traffic still shares)
             return
         ctx = req.context
         valid = min(req.cached_rows, ctx.size)
@@ -315,18 +334,13 @@ class RequestScheduler:
         bonus earned per preemption (higher = fresher = preempted first)."""
         return (req.admission_seq or 0) - AGING_BONUS * req.preemptions
 
-    def _preempt_newest(self) -> Optional[Request]:
-        """Preempt the running request with the newest EFFECTIVE admission:
-        ``admission_seq - AGING_BONUS * preemptions``. A resumed request
-        keeps its original admission_seq AND earns a bonus per preemption,
-        so it is never the victim while any younger tenant runs, and even
-        in a 2-slot pool the victim ROTATES instead of livelocking — the
-        pre-aging ``running.pop()`` always took the resumed request (it
-        was always the newest list entry), re-preempting it forever under
-        sustained growth (regression-pinned)."""
-        if not self.running:
-            return None
-        req = max(self.running, key=self._effective_seq)
+    def preempt(self, req: Request) -> Request:
+        """Preempt a SPECIFIC running request back to the queue head:
+        slot and blocks return to the pool, host cursors stay
+        authoritative (resume re-prefills). The victim-selection policy
+        lives in ``_preempt_newest``; this is the mechanism — also used
+        by the serving engine when an admission cannot pin its adapter
+        slot (every slot held by another in-flight adapter)."""
         self.running.remove(req)
         req.state = "waiting"
         req.preemptions += 1
@@ -340,6 +354,19 @@ class RequestScheduler:
         req.slot = None
         self.waiting.appendleft(req)           # resumes before new arrivals
         return req
+
+    def _preempt_newest(self) -> Optional[Request]:
+        """Preempt the running request with the newest EFFECTIVE admission:
+        ``admission_seq - AGING_BONUS * preemptions``. A resumed request
+        keeps its original admission_seq AND earns a bonus per preemption,
+        so it is never the victim while any younger tenant runs, and even
+        in a 2-slot pool the victim ROTATES instead of livelocking — the
+        pre-aging ``running.pop()`` always took the resumed request (it
+        was always the newest list entry), re-preempting it forever under
+        sustained growth (regression-pinned)."""
+        if not self.running:
+            return None
+        return self.preempt(max(self.running, key=self._effective_seq))
 
     def preempt_all(self) -> int:
         """Evict every running request back to the queue (fault recovery:
@@ -426,7 +453,8 @@ class RequestScheduler:
                            blocks_for(ctx + self.quantum, self.block_size)),
                        self.max_blocks_per_seq)
             m = (self.prefix_cache.match(ctx_arr)
-                 if self.prefix_cache is not None else None)
+                 if self.prefix_cache is not None
+                 and not req.adapter_id else None)
             if m is not None and len(m.blocks) > max(0, need - 1):
                 # never map more shared blocks than the table needs minus
                 # one fresh write target (match caps at ctx-1 rows, so

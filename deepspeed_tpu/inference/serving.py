@@ -245,6 +245,17 @@ class ServingConfig:
     spec_tokens: int = 0
     spec_ngram: int = 3
     spec_proposer: Optional[Any] = None
+    # --- multi-tenant LoRA serving (ISSUE 17; 0 = off) ----------------
+    # device adapter slot pool size INCLUDING the reserved all-zero null
+    # slot 0 (base-model requests index it). Adapter A/B tables live in a
+    # host-side AdapterStore and page into slots like KV blocks: refcount
+    # while requests are in flight, LRU-evicted under slot pressure,
+    # re-paged on demand. A decode quantum batches requests with
+    # DIFFERENT adapters in one dispatch via a per-slot gathered einsum —
+    # one compile per pool shape, never per adapter set.
+    adapter_slots: int = 0
+    lora_rank: int = 0                 # shared by all adapters (one shape)
+    lora_targets: tuple = ("q", "k", "v", "o")
 
 
 class ServingEngine:
@@ -339,13 +350,26 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_token_budget={c.prefill_token_budget}: a "
                 "positive per-round token budget (None disables chunking)")
+        # --- multi-tenant LoRA validation (ISSUE 17) -------------------
+        self._lora = c.adapter_slots > 0
+        if self._lora:
+            if c.adapter_slots < 2:
+                raise ValueError(
+                    f"adapter_slots={c.adapter_slots}: need >= 2 (slot 0 "
+                    "is the reserved all-zero null adapter)")
+            if c.lora_rank < 1:
+                raise ValueError(
+                    f"lora_rank={c.lora_rank}: adapter serving needs a "
+                    "positive shared rank (one device pool shape)")
         latency_armed = (c.enable_prefix_cache or c.spec_tokens > 0
-                         or c.prefill_token_budget is not None)
+                         or c.prefill_token_budget is not None
+                         or self._lora)
         if latency_armed and model.decode_span_paged is None:
             raise ValueError(
-                "prefix cache / chunked prefill / speculative decoding "
-                "need the span protocol (models/transformer make_model "
-                "decode_span_paged) — this model doesn't provide it")
+                "prefix cache / chunked prefill / speculative decoding / "
+                "LoRA serving need the span protocol (models/transformer "
+                "make_model decode_span_paged) — this model doesn't "
+                "provide it")
         if c.spec_tokens > 0 and c.temperature:
             raise ValueError(
                 f"spec_tokens={c.spec_tokens} with temperature="
@@ -411,6 +435,48 @@ class ServingEngine:
                                              dtype=engine.dtype)
         from deepspeed_tpu.parallel.partitioning import sharded_bytes
         self.pool_bytes = sharded_bytes(self.pools)
+        # --- adapter slot pool (ISSUE 17: paged multi-LoRA) ------------
+        # the KV block-pool discipline applied to read-only weights: a
+        # fixed device slot pool (all-zero = the null adapter), host-side
+        # refcount/LRU accounting (kv_cache.AdapterSlotPool), a host RAM
+        # store of every registered adapter's A/B stacks, and ONE jitted
+        # page-in program writing a slot's tables in place. The A/B slot
+        # tables shard under the SAME col/row rules as their projections
+        # (adapter_pool_logical_axes), so the gathered LoRA delta is
+        # computed shard-local.
+        self.adapter_store = None
+        self.adapter_slots = None
+        self.adapter_pool = None
+        self._apool_shardings = None
+        if self._lora:
+            from deepspeed_tpu.inference.kv_cache import AdapterSlotPool
+            from deepspeed_tpu.inference.lora import (
+                AdapterStore, adapter_pool_logical_axes, init_adapter_pool)
+            self.adapter_store = AdapterStore(mcfg, c.lora_rank,
+                                              c.lora_targets)
+            self.adapter_slots = AdapterSlotPool(c.adapter_slots)
+            aspecs = spec_tree(adapter_pool_logical_axes(c.lora_targets),
+                               engine._rules)
+            self._apool_shardings = jax.tree.map(
+                lambda s: NamedSharding(engine.mesh, s), aspecs,
+                is_leaf=lambda x: isinstance(x, P))
+            self._init_apool_fn = jax.jit(
+                lambda: init_adapter_pool(mcfg, c.adapter_slots,
+                                          c.lora_rank, c.lora_targets,
+                                          dtype=engine.dtype),
+                out_shardings=self._apool_shardings)
+            with engine.mesh:
+                self.adapter_pool = self._init_apool_fn()
+            # page-in: one slot's tables written in place (donated pool —
+            # read-only BETWEEN page-ins, never inside a decode round)
+            self._page_in_fn = jax.jit(
+                lambda pool, tabs, slot: jax.tree.map(
+                    lambda p, t: p.at[:, slot].set(t), pool, tabs),
+                donate_argnums=(0,), out_shardings=self._apool_shardings)
+            self.pool_bytes += sharded_bytes(self.adapter_pool)
+            self.pool_bytes_logical += sum(
+                int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.tree.leaves(self.adapter_pool))
         self._tokens = jnp.zeros((c.max_seqs,), jnp.int32)
         self._requests: Dict[int, Request] = {}
         self._finished: List[Request] = []
@@ -609,10 +675,15 @@ class ServingEngine:
 
             backend = self.decode_backend
 
-            def step(params, pools, tokens, tables, seq_lens, active, key):
+            def step(params, pools, tokens, tables, seq_lens, active, key,
+                     apool=None, aidx=None):
+                # apool rides as a trailing NON-donated arg: read-only
+                # shared weights — donating it would force a re-page of
+                # every resident adapter each quantum step
+                lora = (apool, aidx) if apool is not None else None
                 logits, pools = self.model.decode_step_paged(
                     params, tokens, pools, tables, seq_lens,
-                    active=active, backend=backend)
+                    active=active, backend=backend, lora=lora)
                 nxt = self._sample(logits, key)
                 nxt = jnp.where(active, nxt, tokens)
                 return pools, nxt, seq_lens + active.astype(jnp.int32)
@@ -636,9 +707,12 @@ class ServingEngine:
             import jax.numpy as jnp
             from deepspeed_tpu.inference.spec_decode import greedy_accept_len
 
-            def step(params, pools, tok_mat, tables, seq_lens, active, key):
+            def step(params, pools, tok_mat, tables, seq_lens, active, key,
+                     apool=None, aidx=None):
+                lora = (apool, aidx) if apool is not None else None
                 logits, pools = self.model.decode_span_paged(
-                    params, tok_mat, pools, tables, seq_lens, active=active)
+                    params, tok_mat, pools, tables, seq_lens, active=active,
+                    lora=lora)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 acc = greedy_accept_len(nxt, tok_mat[:, 1:])      # [S]
                 pend = jnp.take_along_axis(nxt, acc[:, None],
@@ -680,14 +754,73 @@ class ServingEngine:
 
     # ---- request API -------------------------------------------------
 
+    def register_adapter(self, adapter_id: int, tables,
+                         alpha: Optional[float] = None) -> None:
+        """Register a LoRA adapter's host A/B stacks (``{proj: (A [L, In,
+        r], B [L, r, Out])}`` — ``models/hf_import.load_peft_adapter``
+        emits exactly this) under ``adapter_id``; requests can route to it
+        immediately. ``alpha``: PEFT scaling, folded into B at
+        registration (None = tables already scaled). Host RAM only — the
+        device slot pool pages it in on first demand."""
+        if not self._lora:
+            raise ValueError("adapter_slots=0: LoRA serving is off — set "
+                             "ServingConfig.adapter_slots/lora_rank")
+        self.adapter_store.register(adapter_id, tables, alpha=alpha)
+
+    def _acquire_adapter(self, req: Request) -> bool:
+        """Pin the request's adapter to a device slot (page-in on miss).
+        False = every slot is pinned by other in-flight adapters: the
+        caller preempts the request back to the queue (retried when a
+        slot frees) instead of failing the round."""
+        from deepspeed_tpu.inference.kv_cache import BlockPoolExhausted
+        if not self._lora or req.adapter_id == 0:
+            req.adapter_slot = 0 if self._lora else None
+            return True
+        try:
+            slot, page_in = self.adapter_slots.acquire(req.adapter_id)
+        except BlockPoolExhausted:
+            return False
+        req.adapter_slot = slot
+        if page_in:
+            import jax.numpy as jnp
+            tabs = {
+                p: {"a": jnp.asarray(t["a"]), "b": jnp.asarray(t["b"])}
+                for p, t in self.adapter_store.table_for_slot(
+                    req.adapter_id, self.engine.dtype).items()}
+            with self.engine.mesh:
+                self.adapter_pool = self._page_in_fn(
+                    self.adapter_pool, tabs, np.int32(slot))
+        return True
+
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the request's pin when it leaves the running set (finish /
+        cancel / preempt). The slot stays resident at refcount 0 — the
+        next request for the same adapter is a hit, not a page-in."""
+        if self._lora and req.adapter_id and req.adapter_slot is not None:
+            self.adapter_slots.release(req.adapter_id, owner=req.rid)
+        req.adapter_slot = None
+
     def add_request(self, prompt_ids, max_new_tokens: int = 64,
                     request_id: Optional[int] = None,
                     ttft_deadline_ms: Optional[float] = None,
-                    deadline_ms: Optional[float] = None) -> int:
+                    deadline_ms: Optional[float] = None,
+                    adapter_id: int = 0) -> int:
         """Submit one request. Raises the typed ``AdmissionRejected`` when
         a watermark sheds it or the engine is draining — shed requests are
-        counted (stats()["shed"]) and evented, never silently queued."""
+        counted (stats()["shed"]) and evented, never silently queued.
+        ``adapter_id`` routes the request through a registered LoRA
+        adapter (0 = base model); unknown ids refuse at submission, not
+        at dispatch."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if adapter_id:
+            if not self._lora:
+                raise ValueError(
+                    f"adapter_id={adapter_id} with adapter_slots=0: "
+                    "LoRA serving is off")
+            if adapter_id not in self.adapter_store:
+                raise ValueError(
+                    f"adapter_id={adapter_id} is not registered "
+                    "(register_adapter first)")
         if max_new_tokens < 1:
             # the prefill inherently samples one token; a 0-budget request
             # would still emit it
@@ -709,7 +842,8 @@ class ServingEngine:
                                   if ttft_deadline_ms is not None
                                   else self.config.ttft_deadline_ms),
                 deadline_ms=(deadline_ms if deadline_ms is not None
-                             else self.config.deadline_ms))
+                             else self.config.deadline_ms),
+                adapter_id=adapter_id)
         except AdmissionRejected as e:
             self._counters["shed"] += 1
             rb_events.emit("request_shed", reason=e.reason, **e.detail)
@@ -750,7 +884,9 @@ class ServingEngine:
         pool array threads through every dispatch, so a consumer's read
         depends on this write. The partial boundary block waits for
         ``finish`` (scheduler._publish) — its owner still appends."""
-        if self._prefix_cache is not None:
+        if self._prefix_cache is not None and not req.adapter_id:
+            # adapter KV is adapter-specific — never published under the
+            # content-only hash (see scheduler._publish)
             self._prefix_cache.insert_full(ctx, req.block_ids,
                                            req.cached_rows)
 
@@ -784,10 +920,13 @@ class ServingEngine:
             import jax
             import jax.numpy as jnp
 
-            def chunk(params, ids, pools, table, start, n, key):
+            def chunk(params, ids, pools, table, start, n, key,
+                      apool=None, aidx=None):
+                lora = (apool, aidx) if apool is not None else None
                 logits, pools = self.model.decode_span_paged(
                     params, ids, pools, table,
-                    jnp.reshape(start, (1,)), n_rows=jnp.reshape(n, (1,)))
+                    jnp.reshape(start, (1,)), n_rows=jnp.reshape(n, (1,)),
+                    lora=lora)
                 last = jax.lax.dynamic_index_in_dim(logits[0], n - 1, 0,
                                                     keepdims=False)
                 return self._sample(last[None], key), pools
@@ -813,11 +952,15 @@ class ServingEngine:
         tab = np.zeros((1, self.MB), np.int32)
         tab[0, :len(req.block_ids)] = req.block_ids
         fn = self._get_chunk_fn(C)
+        lora_args = ()
+        if self._lora:
+            lora_args = (self.adapter_pool,
+                         jnp.asarray([req.adapter_slot or 0], jnp.int32))
         with self.engine.mesh:
             first, self.pools = fn(self.engine.params, jnp.asarray(buf),
                                    self.pools, jnp.asarray(tab),
                                    jnp.int32(start), jnp.int32(n),
-                                   self._next_key())
+                                   self._next_key(), *lora_args)
         req.cached_rows = start + n
         self._lat["prefill_chunks"] += 1
         self._lat["prefill_chunk_tokens"] += n
@@ -832,13 +975,18 @@ class ServingEngine:
         ids = np.zeros((self.config.max_seqs, self.MB), np.int32)
         lens = np.zeros((self.config.max_seqs,), np.int32)
         act = np.zeros((self.config.max_seqs,), bool)
+        # per-slot adapter index into the device slot pool (0 = the null
+        # adapter): free slots read slot 0 — an exact-zero delta
+        aidx = np.zeros((self.config.max_seqs,), np.int32)
         for req in self.scheduler.running:
             ids[req.slot, :len(req.block_ids)] = req.block_ids
             lens[req.slot] = req.cached_rows
             # a mid-prefill request (chunked prompt still landing) holds
             # its slot but must not decode yet
             act[req.slot] = req.prefill_done
-        return jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(act)
+            aidx[req.slot] = req.adapter_slot or 0
+        return (jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(act),
+                jnp.asarray(aidx))
 
     def step(self) -> List[Request]:
         """One scheduling round: enforce deadlines, evict/admit/preempt at
@@ -896,22 +1044,42 @@ class ServingEngine:
         try:
             decisions = self.scheduler.schedule(
                 token_budget=self.config.prefill_token_budget)
+            if self._lora:
+                # adapter pins track the running set: scheduler-preempted
+                # victims drop theirs first (their slots become LRU
+                # candidates), then each admission pins — if EVERY slot is
+                # held by another in-flight adapter the admission bounces
+                # back to the queue head, exactly the KV-pool-exhaustion
+                # discipline applied to the adapter pool
+                for req in decisions["preempted"]:
+                    self._release_adapter(req)
+                for req in decisions["admitted"]:
+                    if not self._acquire_adapter(req):
+                        self.scheduler.preempt(req)
+                        rb_events.emit("adapter_slots_exhausted",
+                                       rid=req.rid,
+                                       adapter=req.adapter_id)
             for req in decisions["admitted"]:
-                if req.cow_src is not None:
+                if req.cow_src is not None and req.state == "running":
                     # the copy-on-write fork runs BEFORE any of the
                     # request's own dispatches can write the boundary block
                     self._dispatch_fork(req)
             for req, start, n in decisions["prefill"]:
-                if start == 0 and n == len(req.context):
+                if req.state != "running":
+                    continue     # bounced by the adapter-slot pin above
+                if start == 0 and n == len(req.context) and not self._lora:
                     # whole prompt in one go: the PR-9 program (and its
-                    # warm compiles) — chunking/prefix hits take the span
+                    # warm compiles) — chunking/prefix hits take the span.
+                    # LoRA-armed engines route ALL prefills through the
+                    # span program: it carries the adapter delta, and one
+                    # program family keeps the compile count flat
                     self._dispatch_prefill(req)
                 else:
                     self._dispatch_chunk(req, start, n)
             if not self.scheduler.running:
                 return []
 
-            tables, seq_lens, active = self._tables_device()
+            tables, seq_lens, active, aidx = self._tables_device()
             spec = (self.config.spec_tokens > 0
                     and any(r.prefill_done for r in self.scheduler.running))
             decode = any(r.prefill_done for r in self.scheduler.running)
@@ -931,6 +1099,7 @@ class ServingEngine:
                        for req in self.scheduler.running
                        if getattr(req, "_first_dev", None) is not None]
             pools, tokens = self.pools, self._tokens
+            apool = self.adapter_pool if self._lora else None
             params, mesh = self.engine.params, self.engine.mesh
             S = self.config.max_seqs
             epoch = self._epoch
@@ -950,14 +1119,14 @@ class ServingEngine:
                         # scored in a single span pass
                         p, nxt, acc, t, lens = step_fn(
                             params, p, tok_mat, tables, lens, active,
-                            keys[0])
+                            keys[0], apool, aidx)
                         spec_dev = (nxt, acc)
                     elif decode:
                         for k in keys:
                             if self._epoch != epoch:
                                 return None
                             p, t, lens = step_fn(params, p, t, tables, lens,
-                                                 active, k)
+                                                 active, k, apool, aidx)
                             outs.append(t)
                 # the ONE sync of the round: the sampled tokens (quantum
                 # steps or the verify step's accept verdict) AND every
@@ -1027,6 +1196,7 @@ class ServingEngine:
             self._note_tokens(req, got, now)
             if self._done(req):
                 self.scheduler.finish(req)
+                self._release_adapter(req)
                 self._finished.append(req)
                 finished.append(req)
         return finished
@@ -1070,6 +1240,7 @@ class ServingEngine:
             self._note_tokens(req, got, now)
             if self._done(req):
                 self.scheduler.finish(req)
+                self._release_adapter(req)
                 self._finished.append(req)
                 finished.append(req)
         return finished
@@ -1113,8 +1284,13 @@ class ServingEngine:
         n = self.scheduler.preempt_all()
         for req in self._requests.values():
             req._first_dev = None
+            req.adapter_slot = None   # pool rebuilt below; re-pin on resume
             if req.cow_src is not None:     # un-forked admission caught
                 self.scheduler._release_cow(req)   # mid-round by the fault
+        if self._lora:
+            self.adapter_slots.reset()
+            with self.engine.mesh:
+                self.adapter_pool = self._init_apool_fn()
         if self._prefix_cache is not None:
             # cached rows die with the pool being rebuilt below; drop the
             # cache's references so the fresh pool starts fully free
@@ -1164,6 +1340,7 @@ class ServingEngine:
             else:
                 continue
             self.scheduler.cancel(req, reason=f"{kind}_deadline")
+            self._release_adapter(req)   # no-op for never-pinned waiters
             self._cancelled.append(req)
             self._counters["deadline_misses"] += 1
             rb_events.emit("deadline_miss", rid=req.rid, kind=kind,
@@ -1258,6 +1435,7 @@ class ServingEngine:
                 "state": req.state,
                 "ttft_deadline_ms": req.ttft_deadline_ms,
                 "deadline_ms": req.deadline_ms,
+                "adapter_id": req.adapter_id,
             } for req in live],
         }
         integrity.atomic_write(os.path.join(tag_dir, "state.json"),
@@ -1295,6 +1473,16 @@ class ServingEngine:
         self._check_geometry(geometry, source)
         reqs: List[Request] = []
         for rec in recs:
+            aid = int(rec.get("adapter_id", 0))
+            if aid and (not self._lora or aid not in self.adapter_store):
+                src = f" (drained by {source})" if source else ""
+                raise ResumeIncompatible(
+                    f"migrated request {rec.get('rid')}{src} routes to "
+                    f"LoRA adapter {aid}, which this engine "
+                    + ("has LoRA serving disabled for"
+                       if not self._lora else "has no registration for")
+                    + " — register the adapter here first, or place the "
+                    "request on a replica that serves it")
             req = Request(rid=int(rec["rid"]),
                           prompt=np.asarray(rec["prompt"], np.int32),
                           max_new_tokens=int(rec["max_new_tokens"]),
@@ -1302,7 +1490,8 @@ class ServingEngine:
                                                              [])],
                           preemptions=int(rec.get("preemptions", 0)),
                           ttft_deadline_ms=rec.get("ttft_deadline_ms"),
-                          deadline_ms=rec.get("deadline_ms"))
+                          deadline_ms=rec.get("deadline_ms"),
+                          adapter_id=aid)
             # the add_request context-cap validation, re-applied per
             # record: restoring into an engine with a SMALLER
             # max_model_len must refuse loudly — past the block-table
@@ -1397,9 +1586,15 @@ class ServingEngine:
         fresh window."""
         rids = []
         for r in requests:
-            prompt, n = r if isinstance(r, tuple) else (r, max_new_tokens)
+            aid = 0
+            if isinstance(r, tuple):
+                prompt, n = r[0], r[1]
+                if len(r) > 2:     # (prompt, max_new, adapter_id)
+                    aid = int(r[2])
+            else:
+                prompt, n = r, max_new_tokens
             try:
-                rids.append(self.add_request(prompt, n))
+                rids.append(self.add_request(prompt, n, adapter_id=aid))
             except AdmissionRejected:
                 if not shed_ok:
                     raise
@@ -1431,6 +1626,9 @@ class ServingEngine:
                      "prefill_chunk_tokens": 0, "cow_forks": 0}
         if self._prefix_cache is not None:
             self._prefix_cache.reset_stats()
+        if self._lora:
+            p = self.adapter_slots
+            p.hits = p.evictions = p.page_ins = 0
 
     def close(self, timeout: Optional[float] = None) -> bool:
         """Stop admission and join the latest watchdog round thread with
@@ -1481,6 +1679,17 @@ class ServingEngine:
             "ep": float(self.ep),
             "cancelled": float(len(self._cancelled)),
             "queue_depth": float(self.scheduler.num_waiting),
+            # multi-tenancy (ISSUE 17): adapter slot-pool traffic + the
+            # weight-quantization mode the engine decodes with (0 = full
+            # precision / activation-quantized path)
+            "adapter_hits": float(self.adapter_slots.hits
+                                  if self._lora else 0),
+            "adapter_evictions": float(self.adapter_slots.evictions
+                                       if self._lora else 0),
+            "adapter_page_ins": float(self.adapter_slots.page_ins
+                                      if self._lora else 0),
+            "weight_bits": float(getattr(self.engine.config,
+                                         "weight_bits", 0) or 0),
         }
         out.update({k: float(round(v, 3)) if isinstance(v, float)
                     else float(v) for k, v in self._counters.items()})

@@ -30,7 +30,8 @@ import numpy as np
 
 from deepspeed_tpu.utils.logging import logger
 
-__all__ = ["load_hf_params", "export_hf_state_dict", "hf_config_to_transformer"]
+__all__ = ["load_hf_params", "export_hf_state_dict",
+           "hf_config_to_transformer", "load_peft_adapter"]
 
 
 # --------------------------------------------------------------------------
@@ -753,8 +754,8 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
     from deepspeed_tpu.models.transformer import init_params
     import jax
     ref_shapes = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
-    ref_leaves = jax.tree.leaves_with_path(ref_shapes)
-    got = {jax.tree_util.keystr(p) for p, _ in jax.tree.leaves_with_path(out)}
+    ref_leaves = _leaves_with_path(ref_shapes)
+    got = {jax.tree_util.keystr(p) for p, _ in _leaves_with_path(out)}
     missing = [jax.tree_util.keystr(p) for p, _ in ref_leaves
                if jax.tree_util.keystr(p) not in got]
     if missing:
@@ -771,11 +772,112 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
     return out
 
 
+def _leaves_with_path(tree):
+    """jax.tree.leaves_with_path with a jax<=0.4.37 fallback: the alias
+    only landed on the ``jax.tree`` namespace later — same compat mold as
+    the ``ring_attention`` tree-API fix (PR 15)."""
+    import jax
+    fn = getattr(jax.tree, "leaves_with_path", None)
+    if fn is None:
+        fn = jax.tree_util.tree_leaves_with_path
+    return fn(tree)
+
+
 def _tree_get(tree, path):
     node = tree
     for p in path:
         node = node[getattr(p, "key", getattr(p, "idx", p))]
     return node
+
+
+# --------------------------------------------------------------------------
+# PEFT LoRA adapters (ISSUE 17: multi-tenant serving)
+# --------------------------------------------------------------------------
+
+# PEFT names each factor under the wrapped module's path, e.g.
+#   base_model.model.model.layers.3.self_attn.q_proj.lora_A.weight
+# (the leading wrapper prefix varies by how the model was wrapped, so only
+# the stable tail is matched). torch Linear stores [out, in]: lora_A is
+# [r, in] and lora_B is [out, r]; our matmuls are x @ W, so both transpose.
+_PEFT_KEY_RE = re.compile(
+    r"layers\.(\d+)\.self_attn\.([qkvo])_proj\.lora_([AB])\.weight$")
+
+
+def load_peft_adapter(src, cfg, adapter_config: Optional[dict] = None):
+    """Load a PEFT LoRA checkpoint into the serving engine's table layout.
+
+    ``src`` is anything ``_iter_state_dict`` accepts — a state dict, an
+    ``adapter_model.safetensors`` file, or a PEFT output directory (where
+    ``adapter_config.json`` is read for ``r``/``lora_alpha`` unless
+    ``adapter_config`` is passed explicitly). Returns ``(tables, alpha)``
+    with ``tables[proj] = (A [L, In, r], B [L, r, Out])`` — exactly what
+    ``ServingEngine.register_adapter`` takes::
+
+        srv.register_adapter(7, *load_peft_adapter(peft_dir, cfg))
+
+    Every layer must carry the same projections at the same rank (the
+    device slot pool has ONE shape); partial or ragged checkpoints raise.
+    """
+    path = None
+    if not isinstance(src, dict) and not hasattr(src, "state_dict"):
+        path = os.fspath(src)
+        if os.path.isdir(path):
+            cand = os.path.join(path, "adapter_model.safetensors")
+            if not os.path.exists(cand):
+                cand = os.path.join(path, "adapter_model.bin")
+            if adapter_config is None:
+                cfg_path = os.path.join(path, "adapter_config.json")
+                if os.path.exists(cfg_path):
+                    with open(cfg_path) as f:
+                        adapter_config = json.load(f)
+            src = cand
+
+    L = cfg.num_layers
+    # {proj: {layer: {"A"/"B": arr}}}
+    raw: Dict[str, Dict[int, Dict[str, np.ndarray]]] = {}
+    for key, arr in _iter_state_dict(src):
+        m = _PEFT_KEY_RE.search(key)
+        if m is None:
+            continue
+        layer, proj, which = int(m.group(1)), m.group(2), m.group(3)
+        if layer >= L:
+            raise ValueError(f"peft import: {key!r} indexes layer {layer} "
+                             f"but the model has {L} layers")
+        raw.setdefault(proj, {}).setdefault(layer, {})[which] = _t(arr)
+    if not raw:
+        raise ValueError("peft import: no lora_A/lora_B attention-projection "
+                         "tensors found (expected keys like "
+                         "'...layers.N.self_attn.q_proj.lora_A.weight')")
+
+    rank = None
+    tables: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for proj, per_layer in sorted(raw.items()):
+        missing = [i for i in range(L)
+                   if set(per_layer.get(i, ())) != {"A", "B"}]
+        if missing:
+            raise ValueError(f"peft import: {proj}_proj missing lora_A/B "
+                             f"at layers {missing} — every layer must "
+                             "carry the adapter (one pool shape)")
+        a = np.stack([per_layer[i]["A"] for i in range(L)])  # [L, In, r]
+        b = np.stack([per_layer[i]["B"] for i in range(L)])  # [L, r, Out]
+        r = a.shape[-1]
+        if rank is None:
+            rank = r
+        if r != rank or b.shape[1] != rank:
+            raise ValueError(f"peft import: {proj}_proj rank {r} != {rank} "
+                             "elsewhere — mixed-rank adapters don't fit "
+                             "one slot pool")
+        tables[proj] = (np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+    alpha = None
+    if adapter_config is not None:
+        cfg_r = adapter_config.get("r")
+        if cfg_r is not None and int(cfg_r) != rank:
+            raise ValueError(f"peft import: adapter_config.json r={cfg_r} "
+                             f"but tensors have rank {rank}")
+        if adapter_config.get("lora_alpha") is not None:
+            alpha = float(adapter_config["lora_alpha"])
+    return tables, alpha
 
 
 # --------------------------------------------------------------------------

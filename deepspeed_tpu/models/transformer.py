@@ -82,6 +82,14 @@ class TransformerConfig:
     # scan body dequantizes ONE layer's slice — peak bf16 weight residency is
     # a single layer. Convert with models.quantize_layer_stack.
     quantized_weights: bool = False
+    # weight-ONLY int8 decode matmuls (ISSUE 17, InferenceConfig.weight_bits):
+    # with quantized_weights the {"q","scale"} stacks stay int8 THROUGH the
+    # matmul — the convert fuses into the weight read and the per-out-channel
+    # scale multiplies the result rows (ops/quantizer.weight_matmul), so no
+    # dequantized layer copy ever materializes (vs quantize_bits' dequant-
+    # before-matmul). 0 = off, 8 = int8. MoE expert stacks fall back to
+    # dequant-on-use (the gathered dispatch einsum has no epilogue seam).
+    weight_only_bits: int = 0
     # int8 KV cache for decode (additive over the reference's fp16 decode
     # workspace, inference_context.h): ring buffers live in HBM as int8
     # with per-(batch, head, position) f32 scales. The scale factors out of
@@ -920,16 +928,65 @@ def _decode_pv(probs, cv, kv_scale, dtype):
 def _maybe_dequant(p, cfg: TransformerConfig):
     """int8 weight-only inference: {"q", "scale"} leaves -> compute dtype.
     Called on ONE layer's slice inside the scan, so the dequantized bf16
-    weights of only that layer are ever live."""
+    weights of only that layer are ever live.
+
+    weight_only_bits=8 keeps the dense projection stacks AS {"q","scale"}
+    dicts — ``_wmat``/``_wrow`` run the matmul against the int8 payload
+    with the scale in the epilogue, so the weights never leave int8. Only
+    the MoE expert stacks (and coef) still dequantize here: their gathered
+    dispatch einsum has no per-column epilogue seam."""
     if not cfg.quantized_weights:
         return p
+    epilogue = cfg.weight_only_bits == 8
 
-    def one(v):
+    def one(k, v):
         if isinstance(v, dict) and "q" in v and "scale" in v:
+            if epilogue and not k.startswith("moe_"):
+                return v
             return (v["q"].astype(cfg.dtype)
                     * v["scale"].astype(cfg.dtype))
         return v
-    return {k: one(v) for k, v in p.items()}
+    return {k: one(k, v) for k, v in p.items()}
+
+
+def _wmat(h, w):
+    """h @ w for a weight that may be an epilogue-quantized {"q","scale"}
+    dict (cfg.weight_only_bits, see ops/quantizer.weight_matmul) or a
+    plain array — call sites stay branch-free."""
+    if isinstance(w, dict):
+        from deepspeed_tpu.ops.quantizer import weight_matmul
+        return weight_matmul(h, w["q"], w["scale"])
+    return h @ w.astype(h.dtype)
+
+
+def _wrow(x, w, cfg: TransformerConfig):
+    """Row-parallel twin of ``_wmat``: the per-out-channel scale factors
+    out of the contraction, so it applies AFTER the tensor-axis reduction
+    (the out columns of wo/w_out are unsharded under the Megatron rules —
+    one replicated row multiply, exact)."""
+    if isinstance(w, dict):
+        y = _row_parallel(x, w["q"].astype(x.dtype), cfg)
+        return y * jnp.reshape(w["scale"],
+                               w["scale"].shape[-1:]).astype(x.dtype)
+    return _row_parallel(x, w.astype(x.dtype), cfg)
+
+
+def _lora_delta(h, ab, idx):
+    """Gathered multi-adapter LoRA delta: (h @ A[idx]) @ B[idx].
+
+    ``ab``: one layer's slot tables (A [NS, In, r], B [NS, r, Out]);
+    ``idx``: [B] int32 adapter-slot per batch row. The gather + batched
+    einsum serves a batch whose rows use DIFFERENT adapters in ONE
+    dispatch — the same ragged trick as the MoE dispatch — so the
+    compiled program is shaped by the slot pool, never by which adapters
+    are resident (slot 0 is the all-zero null adapter: base-model rows
+    add an exact zero). Rank is tiny, so the low-rank product goes
+    through the rank bottleneck first."""
+    a, b = ab
+    ga = jnp.take(a, idx, axis=0).astype(h.dtype)      # [B, In, r]
+    gb = jnp.take(b, idx, axis=0).astype(h.dtype)      # [B, r, Out]
+    t = jnp.einsum("bsi,bir->bsr", h, ga)
+    return jnp.einsum("bsr,bro->bso", t, gb)
 
 
 def quantize_layer_stack(params: Params, bits: int = 8) -> Params:
@@ -1040,7 +1097,7 @@ def fused_logical_axes(cfg: TransformerConfig) -> Params:
 def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
                       positions=None, dropout_rng=None, deterministic=True,
                       cache=None, return_kv: bool = False, attn_window=None,
-                      paged=None):
+                      paged=None, lora=None):
     """One pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
     cache=(ck, cv, index[, read_len]): decode mode — x is [B, 1, H]. The
@@ -1055,6 +1112,11 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     BLOCK-POOL slices ([NB, nkv, bs, hd]) instead of per-batch ring
     buffers, and `index` is the per-slot sequence-length vector —
     attention reads through the block table (decode_step_paged).
+
+    lora=({proj: (A, B)}, idx): one layer's adapter slot tables + the
+    per-row adapter-slot index — each projection in the dict gains the
+    gathered low-rank delta (``_lora_delta``), batching rows that use
+    DIFFERENT adapters in the same dispatch (multi-LoRA serving).
     """
     p = _maybe_dequant(layer_params, cfg)
     B, S, H = x.shape
@@ -1071,20 +1133,28 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         # fused projection (see fuse_layer_stack): one GEMV instead of three
         # — decode at short context is op-latency bound, and the reference
         # fuses the same way (qkv_gemm, pt_binding.cpp)
-        qkv = h @ p["wqkv"].astype(h.dtype)
+        qkv = _wmat(h, p["wqkv"])
         if "bqkv" in p:
             qkv = qkv + p["bqkv"].astype(h.dtype)
         q = qkv[..., :nh * hd]
         k = qkv[..., nh * hd:(nh + nkv) * hd]
         v = qkv[..., (nh + nkv) * hd:]
     else:
-        q = h @ p["wq"].astype(h.dtype)
-        k = h @ p["wk"].astype(h.dtype)
-        v = h @ p["wv"].astype(h.dtype)
+        q = _wmat(h, p["wq"])
+        k = _wmat(h, p["wk"])
+        v = _wmat(h, p["wv"])
         if "bq" in p:
             q, k, v = (q + p["bq"].astype(h.dtype),
                        k + p["bk"].astype(h.dtype),
                        v + p["bv"].astype(h.dtype))
+    if lora is not None:
+        tabs, aidx = lora
+        if "q" in tabs:
+            q = q + _lora_delta(h, tabs["q"], aidx)
+        if "k" in tabs:
+            k = k + _lora_delta(h, tabs["k"], aidx)
+        if "v" in tabs:
+            v = v + _lora_delta(h, tabs["v"], aidx)
     q = q.reshape(B, S, nh, hd)
     k = k.reshape(B, S, nkv, hd)
     v = v.reshape(B, S, nkv, hd)
@@ -1155,8 +1225,10 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
         with jax.named_scope("attn"):
             attn_out = attention(q, k, v, mask=mask, causal=cfg.causal,
                                  cfg=cfg, window=attn_window)
-    attn_out = _row_parallel(attn_out.reshape(B, S, nh * hd),
-                             p["wo"].astype(h.dtype), cfg)
+    attn_flat = attn_out.reshape(B, S, nh * hd)
+    attn_out = _wrow(attn_flat, p["wo"], cfg)
+    if lora is not None and "o" in lora[0]:
+        attn_out = attn_out + _lora_delta(attn_flat, lora[0]["o"], lora[1])
     if "bo" in p:
         attn_out = attn_out + p["bo"].astype(h.dtype)
     if cfg.parallel_block:
@@ -1192,13 +1264,12 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
             if tp_moe:
                 moe_out = gather_tokens(moe_out, dim=1)
             if "w_in" in p:  # PR-MoE residual (reference: use_residual)
-                up = h @ p["w_in"].astype(h.dtype)
+                up = _wmat(h, p["w_in"])
                 if "b_in" in p:
                     up = up + p["b_in"].astype(h.dtype)
-                gate = (h @ p["w_gate"].astype(h.dtype)
+                gate = (_wmat(h, p["w_gate"])
                         if "w_gate" in p else None)
-                dense_out = (_activation(up, gate, cfg)
-                             @ p["w_out"].astype(h.dtype))
+                dense_out = _wmat(_activation(up, gate, cfg), p["w_out"])
                 if "b_out" in p:
                     dense_out = dense_out + p["b_out"].astype(h.dtype)
                 coef = jax.nn.softmax(
@@ -1211,20 +1282,20 @@ def transformer_layer(x, layer_params, cfg: TransformerConfig, mask=None,
     elif "w_in_gate" in p:
         # fused up+gate projection (see fuse_layer_stack)
         with jax.named_scope("mlp"):
-            ug = h @ p["w_in_gate"].astype(h.dtype)
+            ug = _wmat(h, p["w_in_gate"])
             half = ug.shape[-1] // 2
             act = _activation(ug[..., :half], ug[..., half:], cfg)
-            out = _row_parallel(act, p["w_out"].astype(h.dtype), cfg)
+            out = _wrow(act, p["w_out"], cfg)
             if "b_out" in p:
                 out = out + p["b_out"].astype(h.dtype)
     else:
         with jax.named_scope("mlp"):
-            up = h @ p["w_in"].astype(h.dtype)
+            up = _wmat(h, p["w_in"])
             if "b_in" in p:
                 up = up + p["b_in"].astype(h.dtype)
-            gate = h @ p["w_gate"].astype(h.dtype) if "w_gate" in p else None
+            gate = _wmat(h, p["w_gate"]) if "w_gate" in p else None
             act = _activation(up, gate, cfg)
-            out = _row_parallel(act, p["w_out"].astype(h.dtype), cfg)
+            out = _wrow(act, p["w_out"], cfg)
             if "b_out" in p:
                 out = out + p["b_out"].astype(h.dtype)
     if cfg.parallel_block:
@@ -1931,7 +2002,7 @@ def paged_cache_logical_axes(cfg: Optional[TransformerConfig] = None
 
 def decode_step_paged(params: Params, tokens, cfg: TransformerConfig,
                       pools: Params, block_tables, seq_lens, active=None,
-                      backend: str = "xla"
+                      backend: str = "xla", lora=None
                       ) -> Tuple[jnp.ndarray, Params]:
     """One decode step for every slot of a paged serving batch.
 
@@ -1941,6 +2012,13 @@ def decode_step_paged(params: Params, tokens, cfg: TransformerConfig,
     Returns (logits [S, V], pools). The program is shaped by the POOL and
     table dims only — admitting/evicting sequences changes the table
     contents, never the compiled program.
+
+    ``lora``: optional ``(adapter_pool, aidx)`` — ``adapter_pool`` maps
+    projection name -> {"a": [L, NS, In, r], "b": [L, NS, r, Out]} device
+    slot tables, ``aidx`` [S] int32 the adapter SLOT each serving slot
+    reads (0 = the all-zero null adapter). Like the block pool, the
+    compiled program is shaped by the slot-pool dims only — which
+    adapters are resident changes table contents, never the program.
 
     Inactive slots still compute (lockstep SPMD) but their K/V rows land in
     the reserved trash block 0 and their logits are discarded host-side.
@@ -1979,10 +2057,15 @@ def decode_step_paged(params: Params, tokens, cfg: TransformerConfig,
         c = (pk, pv, seq_lens, None, sc)
         if cfg.offload_params:
             layer_p = _fetch_layer(layer_p, cfg)
+        lora_i = None
+        if lora is not None:
+            apool, aidx = lora
+            lora_i = ({k: (v["a"], v["b"])
+                       for k, v in at_layer(apool, i).items()}, aidx)
         y, _, (k_row, v_row) = transformer_layer(
             x_c, layer_p, cfg, positions=positions, deterministic=True,
             cache=c, return_kv=False, paged=(block_tables, backend),
-            attn_window=None if wins is None else wins[i])
+            attn_window=None if wins is None else wins[i], lora=lora_i)
         return y, (k_row, v_row)
 
     x, (k_rows, v_rows) = lax.scan(body, x, jnp.arange(cfg.num_layers))
@@ -2024,7 +2107,7 @@ def decode_step_paged(params: Params, tokens, cfg: TransformerConfig,
 
 def decode_span_paged(params: Params, tokens, cfg: TransformerConfig,
                       pools: Params, block_tables, seq_lens, active=None,
-                      n_rows=None, backend: str = "xla"
+                      n_rows=None, backend: str = "xla", lora=None
                       ) -> Tuple[jnp.ndarray, Params]:
     """T consecutive tokens per slot in ONE pass — the latency-frontier
     program (ISSUE 12): the speculation verify step scores K+1 proposed
@@ -2039,7 +2122,9 @@ def decode_span_paged(params: Params, tokens, cfg: TransformerConfig,
     compute garbage but land in the trash block, so padding can never
     overwrite live rows or run off the block table. Inactive slots behave
     as in ``decode_step_paged`` (lockstep compute, trash writes, host
-    discards). The caller owns cursor roll-back: rows past an accepted
+    discards), and ``lora`` carries the same ``(adapter_pool, aidx)``
+    slot tables — multi-adapter prefill chunks and verify spans reuse
+    the identical gathered-einsum path. The caller owns cursor roll-back: rows past an accepted
     speculation prefix stay in place, masked by ``seq_lens`` until
     overwritten — shared (refcounted) blocks are never touched because
     the scheduler's copy-on-write fork runs before any span dispatch.
@@ -2085,10 +2170,15 @@ def decode_span_paged(params: Params, tokens, cfg: TransformerConfig,
         c = (pk, pv, seq_lens, None, sc)
         if cfg.offload_params:
             layer_p = _fetch_layer(layer_p, cfg)
+        lora_i = None
+        if lora is not None:
+            apool, aidx = lora
+            lora_i = ({k: (v["a"], v["b"])
+                       for k, v in at_layer(apool, i).items()}, aidx)
         y, _, (k_row, v_row) = transformer_layer(
             x_c, layer_p, cfg, positions=positions, deterministic=True,
             cache=c, return_kv=False, paged=(block_tables, backend),
-            attn_window=None if wins is None else wins[i])
+            attn_window=None if wins is None else wins[i], lora=lora_i)
         return y, (k_row, v_row)                 # rows: [S, nkv, T, hd]
 
     x, (k_rows, v_rows) = lax.scan(body, x, jnp.arange(cfg.num_layers))

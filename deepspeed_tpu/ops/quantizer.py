@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 __all__ = ["QuantizedTensor", "quantize", "dequantize", "pack_int4",
            "unpack_int4", "fake_quant", "quantize_tree", "dequantize_tree",
-           "quantize_rows"]
+           "quantize_rows", "quantize_channels", "weight_matmul"]
 
 
 def quantize_rows(x):
@@ -40,6 +40,40 @@ def quantize_rows(x):
     q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127
                  ).astype(jnp.int8)
     return q, scale
+
+
+def quantize_channels(w):
+    """Per-OUT-CHANNEL symmetric int8 for weight storage: w [..., In, Out]
+    float -> (int8 [..., In, Out], f32 scale [..., 1, Out]).
+
+    The weight-side twin of ``quantize_rows``: the scale lives on the
+    output column, so it factors OUT of the In-contraction and a matmul
+    against the int8 payload finishes with one row-broadcast multiply —
+    ``weight_matmul`` below. Leading dims (layer stack, expert stack)
+    each get their own scales, matching ``models/transformer
+    .quantize_layer_stack``'s {"q", "scale"} layout."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)   # per (.., out)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def weight_matmul(x, w, scale=None):
+    """x @ w with dequant fused into the matmul EPILOGUE.
+
+    ``w`` int8 [In, Out] (+ broadcastable per-out-channel ``scale``): the
+    contraction runs against the int8 payload — the elementwise convert
+    fuses into the matmul's weight read, so no dequantized copy of the
+    weight ever materializes in HBM (weights stay int8 at rest, the
+    weight_bits=8 serving contract) — and the f32 scale multiplies the
+    [..., Out] RESULT rows (per-column scales factor out of the In
+    contraction exactly). A plain float ``w`` (scale=None) is the
+    ordinary matmul, so call sites stay branch-free."""
+    if scale is None:
+        return x @ w.astype(x.dtype)
+    y = x @ w.astype(x.dtype)
+    return y * jnp.reshape(scale, scale.shape[-1:]).astype(x.dtype)
 
 
 @dataclasses.dataclass

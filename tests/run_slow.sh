@@ -32,6 +32,10 @@
 #   TP_SERVING_BUDGET=420 tests/run_slow.sh tp_serving  # ISSUE 15:
 #       tp=2-vs-single-chip serving parity under preemption + prefix
 #       cache + the latency tier, and the tp2->tp2 drained continuation
+#   LORA_BUDGET=420 tests/run_slow.sh lora_serving  # ISSUE 17: the
+#       rotating-tenant churn soak (evict/re-page under all-pinned
+#       preemptions, latency stack on) vs per-tenant merged-dense
+#       serial engines, token-for-token
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -107,6 +111,11 @@ for m in "${modules[@]}"; do
         # loads on the 2-device CPU mesh (matched before the
         # *test_serving* glob below)
         *test_tp_serving*) budget="${TP_SERVING_BUDGET:-420}" ;;
+        # ISSUE-17 multi-tenancy: the rotating-tenant churn soak builds
+        # one pooled engine + one merged-dense engine per tenant and
+        # decodes full loads with the latency stack on (matched before
+        # the *test_serving* glob below)
+        *test_lora_serving*) budget="${LORA_BUDGET:-420}" ;;
         # ISSUE-9 serving tier: multi-tenant end-to-end runs (engine
         # rebuilds + per-bucket prefill compiles + int8 pool parity over
         # 24 decode steps) own a budget independent of the tier default

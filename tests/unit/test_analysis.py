@@ -332,6 +332,8 @@ _CORPUS_RULES = {
     "stage3-replicated-opt": "memory-law",
     "paged-cache-leak": "memory-peak",
     "tp-serving-replicated-pool": "replication-over-budget",
+    "quantized-weight-replicated": "replication-over-budget",
+    "adapter-slot-leak": "pool-growth",
     "staging-buffer-alias": "buffer-alias",
     "allocator-unlocked-share": "refcount-race",
 }
