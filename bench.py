@@ -1341,6 +1341,39 @@ def _serving_bench(size: str, n_requests: int = 32,
         "serve_mesh": srv.mesh_desc,
         "serve_decode_backend": srv.decode_backend,
     }
+    # tracing-overhead rung (ISSUE 18): the SAME warm engine serves the
+    # SAME load with per-request tracing armed — host-clock spans only,
+    # so like _telemetry_bench's gate the steady-state cost must stay
+    # < 1% (the zero-added-sync design goal; the tracing-sync-leak
+    # corpus twin is the seeded violation). The traced window also
+    # feeds the serving doctor's phase decomposition, so the bench
+    # carries the "what is the round bound on" evidence next to the
+    # SLO numbers. decode_floor_ok is untouched: tracing never rides
+    # the decode floor rung.
+    try:
+        from deepspeed_tpu.profiling.doctor import (diagnose_serving,
+                                                    serving_fields)
+        srv.enable_request_trace(replica="bench")
+        srv.reset_stats()
+        t0 = time.perf_counter()
+        srv.run([(p.copy(), n) for p, n in reqs])
+        traced_dt = time.perf_counter() - t0
+        decomp = srv.phase_decomposition()
+        srv.disable_request_trace()
+        srv.reset_stats()
+        pct = max(0.0, traced_dt / serve_dt - 1.0) * 100
+        decomp["serve_trace_overhead_pct"] = pct
+        out["serve_trace_overhead_pct"] = round(pct, 2)
+        out["serve_trace_overhead_ok"] = bool(traced_dt < 1.01 * serve_dt)
+        out.update(serving_fields(diagnose_serving(decomp)))
+        if not out["serve_trace_overhead_ok"]:
+            print("bench: TRACE OVERHEAD FAILED: traced serving "
+                  f"{traced_dt:.3f}s vs untraced {serve_dt:.3f}s "
+                  "(>= 1% — the host-clock-only contract; see "
+                  "tracing-sync-leak corpus)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — gate reports, never crashes
+        print(f"bench: tracing-overhead rung failed: {e}", file=sys.stderr)
+        out["serve_trace_overhead_ok"] = False
     for k, v in srv.backend_bench.items():
         if k != "backend":
             out[f"serve_backend_{k}"] = v

@@ -794,6 +794,34 @@ def exposed_collective_trace(devices=None):
     return run_corpus_entry()
 
 
+def serving_blind_stall(devices=None):
+    """Serving doctor gate (synthetic decomposition, not a compiled
+    program): a round-phase ring where adapter paging/CoW housekeeping
+    blows up every other round — an injected paging stall that flat
+    counters would average away. ``diagnose_serving`` must attribute the
+    per-token bound to the housekeeping phase and ``serving-phase-stall``
+    must fire naming it (paging-bound, with the adapter_slots knob). The
+    instrumented twin (same synthetic fleet, stall removed) passes —
+    tests assert both directions; the twin is also CLI-runnable
+    (``python -m deepspeed_tpu.profiling.doctor --corpus
+    serving-blind-stall``)."""
+    from deepspeed_tpu.profiling.doctor import run_corpus_entry
+    return run_corpus_entry("serving-blind-stall")
+
+
+def tracing_sync_leak(devices=None):
+    """Serving doctor gate: the REAL ``RequestTracer`` armed with an
+    ``on_span`` hook that performs a ``device_get`` per span — the
+    documented defect seam of the zero-sync tracing contract. The hook
+    self-reports through ``tracer.device_syncs`` and the measured span
+    overhead is priced against the round budget; ``tracing-sync-leak``
+    must fire (device-syncs). The host-clock twin (same span load, no
+    hook) stays under the 1% overhead gate and passes — both directions
+    CLI-runnable (``doctor --corpus tracing-sync-leak``)."""
+    from deepspeed_tpu.profiling.doctor import run_corpus_entry
+    return run_corpus_entry("tracing-sync-leak")
+
+
 def staging_buffer_alias(devices=None):
     """Race corpus (deterministic interleaving explorer, not a compiled
     program): the REAL ``StagingRing`` with the write-behind fence skipped
@@ -836,6 +864,8 @@ CORPUS = {
     "prefix-refcount-leak": prefix_refcount_leak,
     "offload-serial-pipeline": offload_serial_pipeline,
     "exposed-collective-trace": exposed_collective_trace,
+    "serving-blind-stall": serving_blind_stall,
+    "tracing-sync-leak": tracing_sync_leak,
     "serialized-backward": serialized_backward,
     "staging-buffer-alias": staging_buffer_alias,
     "allocator-unlocked-share": allocator_unlocked_share,

@@ -290,9 +290,11 @@ def barrier() -> None:
     if n > 1:
         from jax.sharding import Mesh, PartitionSpec as P
         import numpy as np
+        from .schedule import shard_map_compat  # local: avoid import cycle
         mesh = Mesh(np.asarray(jax.devices()), ("all",))
-        f = jax.jit(jax.shard_map(lambda x: lax.psum(x, "all"), mesh=mesh,
-                                  in_specs=P("all"), out_specs=P()))
+        f = jax.jit(shard_map_compat(lambda x: lax.psum(x, "all"), mesh,
+                                     in_specs=P("all"), out_specs=P(),
+                                     manual_axes=("all",)))
         jax.block_until_ready(f(jnp.zeros((n,), jnp.int32)))
     else:
         jax.effects_barrier()

@@ -149,6 +149,12 @@ class ReplicaHandle:
         self._cancel_seen = 0
         if preemption is not None:
             engine.attach_preemption(preemption, self.drain_dir)
+        # a tracing-armed engine still tagged with the default replica
+        # name inherits THIS handle's: the merged Chrome trace needs one
+        # process row per replica, and "r0" twice would alias them
+        tracer = getattr(engine, "tracer", None)
+        if tracer is not None and tracer.replica == "r0" and name != "r0":
+            tracer.replica = name
 
     # ---- registry ----------------------------------------------------
 
@@ -166,15 +172,24 @@ class ReplicaHandle:
         replicas are pod-sharded; old no-meta/no-topology heartbeats
         interop (the schema satellite's contract)."""
         sched = self.engine.scheduler
-        return {"role": "replica",
-                "queue_depth": int(sched.num_waiting),
-                "running": int(sched.num_running),
-                "capacity": self.capacity,
-                "pool_free": round(
-                    1.0 - self.engine.allocator.used_fraction, 4),
-                "draining": bool(self.engine._draining),
-                "tp": int(getattr(self.engine, "tp", 1)),
-                "ep": int(getattr(self.engine, "ep", 1))}
+        d = {"role": "replica",
+             "queue_depth": int(sched.num_waiting),
+             "running": int(sched.num_running),
+             "capacity": self.capacity,
+             "pool_free": round(
+                 1.0 - self.engine.allocator.used_fraction, 4),
+             "draining": bool(self.engine._draining),
+             "tp": int(getattr(self.engine, "tp", 1)),
+             "ep": int(getattr(self.engine, "ep", 1))}
+        # fleet rollup half (ISSUE 18): mergeable histograms + occupancy.
+        # Optional by the schema contract — stub replicas (and pre-obs
+        # engines) just omit the key; the rollup skips them
+        if hasattr(self.engine, "obs_meta"):
+            try:
+                d["obs"] = self.engine.obs_meta()
+            except Exception:  # noqa: BLE001 - obs must not kill heartbeats
+                pass
+        return d
 
     def publish(self) -> None:
         if self.dead or self.mute_heartbeat:
@@ -294,6 +309,9 @@ class ServingRouter:
         self._stale_tags: Dict[str, set] = {}
         self._placement: Dict[int, str] = {}         # rid -> replica name
         self._records: Dict[int, Dict[str, Any]] = {}  # rid -> resubmit rec
+        # dead replicas whose frozen heartbeat obs left the stats window
+        # (reset_stats): rollups skip them without rewriting the store
+        self._obs_excluded: set = set()
         self._next_rid = 0
         self._round = 0
         self._ttfts: List[float] = []
@@ -780,11 +798,12 @@ class ServingRouter:
             if lost_recs:
                 # the residue keeps the ORIGINAL drained geometry: a
                 # later whole-drain resume of these records must still
-                # hit the v2 envelope check (dropping it would silently
-                # downgrade — the exact refusal the record exists for)
+                # hit the envelope check (dropping it would silently
+                # downgrade — the exact refusal the record exists for).
+                # v3: lost records keep their drained trace context too
                 integrity.atomic_write(
                     os.path.join(tag_dir, "state.json"),
-                    json.dumps({"version": 2, "source": rep.name,
+                    json.dumps({"version": 3, "source": rep.name,
                                 "rng_counter": rng_counter,
                                 "engine": drained_engine,
                                 "failover_residue": True,
@@ -867,14 +886,94 @@ class ServingRouter:
         return outs
 
     def reset_stats(self) -> None:
-        """Start a fresh measurement window (the ServingEngine contract):
-        TTFT records and counters reset; registry, breaker state, and
-        outstanding placements are untouched. A long-lived router should
-        reset at window boundaries — the TTFT list grows per completed
-        request otherwise."""
+        """Start a fresh measurement window (the ServingEngine contract,
+        extended to FLEET scope — ISSUE 18): TTFT records and counters
+        reset, every live replica's engine window resets and re-publishes
+        its heartbeat (so the rollup's histograms restart too), and dead
+        replicas' frozen drained stats leave the window (their history
+        belongs to the window that watched them die). Registry, breaker
+        state, and outstanding placements are untouched."""
         self._ttfts = []
         self._counters = {k: (0.0 if isinstance(v, float) else 0)
                           for k, v in self._counters.items()}
+        for name, rep in self.replicas.items():
+            eng = getattr(rep, "engine", None)
+            if rep.dead:
+                # the store still holds its last heartbeat; exclude it
+                # from rollups instead of rewriting history on disk
+                self._obs_excluded.add(name)
+            elif eng is not None and hasattr(eng, "reset_stats"):
+                eng.reset_stats()
+                rep.publish()
+        self._refresh_info()
+
+    # ---- fleet rollup (ISSUE 18) -------------------------------------
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        """One pod-level snapshot: per-replica heartbeat ``obs`` payloads
+        (live replicas contribute their CURRENT engine state; dead ones
+        their last-seen heartbeat — the drained stats) merged into fleet
+        histograms, plus liveness and the summed completion counters.
+        Histogram values are ``telemetry.Histogram`` — feed the dict to
+        ``exposition()``/``render_prometheus`` for a scrape."""
+        from deepspeed_tpu.telemetry.exposition import (DEFAULT_EDGES_MS,
+                                                        DEPTH_EDGES,
+                                                        FRACTION_EDGES,
+                                                        Histogram)
+        self._refresh_info()
+        ttft, itl = Histogram(DEFAULT_EDGES_MS), Histogram(DEFAULT_EDGES_MS)
+        qdepth = Histogram(DEPTH_EDGES)
+        pool_occ = Histogram(FRACTION_EDGES)
+        adapter_occ = Histogram(FRACTION_EDGES)
+        live = 0
+        totals = {"completed": 0, "cancelled": 0, "generated_tokens": 0,
+                  "adapter_page_ins": 0}
+        for name, rep in self.replicas.items():
+            meta = (self._info.get(name) or {}).get("meta") or {}
+            obs = meta.get("obs")
+            eng = getattr(rep, "engine", None)
+            if not rep.dead and eng is not None \
+                    and hasattr(eng, "obs_meta"):
+                obs = eng.obs_meta()     # fresher than the last heartbeat
+            if rep.dead and name in self._obs_excluded:
+                obs = None               # pre-reset history
+            if not rep.dead:
+                live += 1
+                # gauges are now-facts of the LIVE fleet — a dead
+                # replica's queue depth is not depth anyone waits in
+                qdepth.observe(float(meta.get("queue_depth", 0)))
+                if obs and obs.get("pool_occupancy") is not None:
+                    pool_occ.observe(float(obs["pool_occupancy"]))
+                if obs and obs.get("adapter_occupancy") is not None:
+                    adapter_occ.observe(float(obs["adapter_occupancy"]))
+            if obs:
+                for key, h in (("ttft_ms_hist", ttft),
+                               ("itl_ms_hist", itl)):
+                    part = Histogram.from_dict(obs.get(key))
+                    if part is not None and part.edges == h.edges:
+                        h.merge(part)
+                for key in totals:
+                    totals[key] += int(obs.get(key) or 0)
+        out: Dict[str, Any] = {
+            "fleet_replicas": len(self.replicas),
+            "fleet_live": live,
+            "fleet_ttft_ms": ttft,
+            "fleet_itl_ms": itl,
+            "fleet_queue_depth": qdepth,
+            "fleet_pool_occupancy": pool_occ,
+            "fleet_adapter_occupancy": adapter_occ,
+        }
+        out.update({f"fleet_{k}": v for k, v in totals.items()})
+        return out
+
+    def exposition(self, prefix: str = "dstpu") -> str:
+        """Prometheus text exposition of the fleet: router counters +
+        the ``fleet_stats`` rollup. Serve the returned string from any
+        HTTP handler and the pod is a scrape target."""
+        from deepspeed_tpu.telemetry.exposition import render_prometheus
+        metrics: Dict[str, Any] = dict(self.stats())
+        metrics.update(self.fleet_stats())
+        return render_prometheus(metrics, prefix=prefix)
 
     def stats(self) -> Dict[str, float]:
         """Spill/failover/SLO counters across the router's lifetime plus

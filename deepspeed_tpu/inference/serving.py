@@ -75,6 +75,8 @@ Drains record the mesh topology and resume/migration refuse a
 mesh-incompatible placement with the typed ``ResumeIncompatible``.
 """
 
+import collections
+import contextlib
 import dataclasses
 import threading
 import time
@@ -256,6 +258,14 @@ class ServingConfig:
     adapter_slots: int = 0
     lora_rank: int = 0                 # shared by all adapters (one shape)
     lora_targets: tuple = ("q", "k", "v", "o")
+    # --- fleet observability (ISSUE 18; default off = PR-17 behavior) ---
+    # per-request distributed tracing: host-wall-clock spans only (two
+    # perf_counter calls + a deque append per span, ZERO added device
+    # syncs — tracing on/off is bit-identical, pinned by test_fleet_obs).
+    # Arm at runtime with enable_request_trace() to A/B a warm engine.
+    request_trace: bool = False
+    trace_replica: str = "r0"          # process row in the merged trace
+    trace_events: int = 65536          # tracer ring bound
 
 
 class ServingEngine:
@@ -515,6 +525,18 @@ class ServingEngine:
         self._draining = False
         self._preemption = None            # attach_preemption()
         self._drain_dir: Optional[str] = None
+        # --- fleet observability (ISSUE 18) ----------------------------
+        # round-phase decomposition ring: one entry per _round() with the
+        # host milliseconds each phase took (schedule / housekeeping /
+        # prefill dispatch / decode dispatch / token fetch / commit).
+        # Cheap enough to ALWAYS be on: ~7 perf_counter reads per round.
+        self._phases: "collections.deque[Dict[str, float]]" = \
+            collections.deque(maxlen=256)
+        self._round_tokens = 0             # tokens committed this round
+        self._phase_stall_events = 0       # serving_phase_stall emissions
+        self._tracer = None                # RequestTracer when armed
+        if c.request_trace:
+            self.enable_request_trace(replica=c.trace_replica)
         self._jsonl = None
         if c.telemetry_jsonl:
             from deepspeed_tpu.monitor.monitor import JSONLMonitor
@@ -556,6 +578,143 @@ class ServingEngine:
                 "byte-identical continuation is only guaranteed on a "
                 "matching mesh geometry (place it on a survivor with the "
                 "same tp/ep degrees)")
+
+    # ---- fleet observability (ISSUE 18) ------------------------------
+
+    def enable_request_trace(self, replica: Optional[str] = None,
+                             on_span=None):
+        """Arm per-request tracing on a (possibly warm) engine. Spans are
+        host-wall-clock only — no device syncs, bit-identical outputs —
+        so the bench A/Bs the SAME engine traced vs untraced. Returns the
+        tracer (``on_span`` is the per-span hook; see RequestTracer for
+        the sync-leak contract)."""
+        from deepspeed_tpu.telemetry.request_trace import RequestTracer
+        self._tracer = RequestTracer(
+            replica=replica or self.config.trace_replica,
+            max_events=self.config.trace_events, on_span=on_span)
+        return self._tracer
+
+    def disable_request_trace(self) -> None:
+        self._tracer = None
+
+    @property
+    def tracer(self):
+        return self._tracer
+
+    def _rspan(self, rid: int, name: str, **args):
+        """Span context for request ``rid`` — a no-op nullcontext when
+        tracing is off, so hook sites stay one-liners on the hot path."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(rid, name, **args)
+
+    def export_trace(self, path: Optional[str] = None):
+        """This replica's trace stream (``RequestTracer.export`` dict);
+        with ``path``, write it merged as Chrome-trace JSON. Multi-replica
+        merges go through ``telemetry.merge_chrome_trace`` with every
+        replica's stream."""
+        if self._tracer is None:
+            return None
+        from deepspeed_tpu.telemetry.request_trace import merge_chrome_trace
+        stream = self._tracer.export()
+        if path:
+            merge_chrome_trace([stream], path=path)
+        return stream
+
+    def phase_decomposition(self) -> Dict[str, float]:
+        """Aggregate the round-phase ring into the decomposition the
+        serving doctor prices (``profiling.doctor.diagnose_serving``):
+        total host ms per phase over the window plus round/token counts
+        and the tracing-overhead evidence (device_syncs self-report)."""
+        out: Dict[str, float] = {
+            "serve_rounds": float(len(self._phases)),
+            "serve_schedule_ms": 0.0, "serve_housekeeping_ms": 0.0,
+            "serve_prefill_dispatch_ms": 0.0,
+            "serve_decode_dispatch_ms": 0.0, "serve_fetch_ms": 0.0,
+            "serve_commit_ms": 0.0, "serve_round_ms": 0.0,
+            "serve_tokens": 0.0,
+            "serve_phase_stall_events": float(self._phase_stall_events),
+            "trace_armed": float(self._tracer is not None),
+            "trace_device_syncs": float(self._tracer.device_syncs
+                                        if self._tracer else 0),
+        }
+        for entry in self._phases:
+            out["serve_schedule_ms"] += entry["schedule_ms"]
+            out["serve_housekeeping_ms"] += entry["housekeeping_ms"]
+            out["serve_prefill_dispatch_ms"] += entry["prefill_ms"]
+            out["serve_decode_dispatch_ms"] += entry["decode_ms"]
+            out["serve_fetch_ms"] += entry["fetch_ms"]
+            out["serve_commit_ms"] += entry["commit_ms"]
+            out["serve_round_ms"] += entry["round_ms"]
+            out["serve_tokens"] += entry["tokens"]
+        return {k: (round(v, 3) if k.endswith("_ms") else v)
+                for k, v in out.items()}
+
+    # thresholds for the blind-stall event: only a WARM engine's rounds
+    # count (the first rounds' jit compiles are legitimate wall time), and
+    # a phase must be both absolutely slow and dominant before the event
+    # fires — CPU-test rounds stay quiet
+    _STALL_MIN_ROUND_MS = 50.0
+    _STALL_FRACTION = 0.6
+
+    def _note_phases(self, entry: Dict[str, float]) -> None:
+        """Append one round's phase decomposition and emit (at most one
+        per stats window) a ``serving_phase_stall`` event when a NON-fetch
+        phase dominates a round that regressed against the window's own
+        steady state (3x the prior-round median, with >= 8 warm rounds of
+        baseline — jit-compile rounds never have one, so short CPU runs
+        stay quiet). The fetch phase is exempt: the one sync of the round
+        legitimately waits on the device — a doctor reading fetch-bound
+        means 'the accelerator is the bottleneck', which is health, not a
+        stall."""
+        self._phases.append(entry)
+        if (not self._quantum_warm or self._phase_stall_events
+                or len(self._phases) < 9
+                or entry["round_ms"] < self._STALL_MIN_ROUND_MS):
+            return
+        prior = sorted(e["round_ms"] for e in list(self._phases)[:-1])
+        if entry["round_ms"] < 3.0 * max(prior[len(prior) // 2], 1e-9):
+            return
+        for phase in ("schedule", "housekeeping", "prefill", "decode",
+                      "commit"):
+            ms = entry[f"{phase}_ms"]
+            if ms > self._STALL_FRACTION * entry["round_ms"]:
+                self._phase_stall_events += 1
+                rb_events.emit("serving_phase_stall", phase=phase,
+                               phase_ms=round(ms, 2),
+                               round_ms=round(entry["round_ms"], 2))
+                break
+
+    def obs_meta(self) -> Dict[str, Any]:
+        """Compact rollup payload for the router's fleet aggregation:
+        mergeable fixed-edge histograms (TTFT / ITL over THIS stats
+        window) plus occupancy gauges. Rides every heartbeat ``meta`` —
+        a dead replica's last-seen payload IS its drained stats, so the
+        fleet rollup keeps its history without a side channel."""
+        from deepspeed_tpu.telemetry.exposition import (DEFAULT_EDGES_MS,
+                                                        Histogram)
+        ttft = Histogram(DEFAULT_EDGES_MS)
+        ttft.observe_many((r.first_token_t - r.submit_t) * 1e3
+                          for r in self._finished
+                          if r.first_token_t is not None)
+        itl = Histogram(DEFAULT_EDGES_MS)
+        itl.observe_many(self._itl_ms)
+        pool_occ = float(self.allocator.used_fraction)
+        meta: Dict[str, Any] = {
+            "ttft_ms_hist": ttft.to_dict(),
+            "itl_ms_hist": itl.to_dict(),
+            "pool_occupancy": round(pool_occ, 4),
+            "completed": len(self._finished),
+            "cancelled": len(self._cancelled),
+            "generated_tokens": sum(len(r.generated)
+                                    for r in self._finished),
+        }
+        if self._lora:
+            usable = max(1, self.adapter_slots.num_slots - 1)
+            meta["adapter_occupancy"] = round(
+                self.adapter_slots.resident / usable, 4)
+            meta["adapter_page_ins"] = self.adapter_slots.page_ins
+        return meta
 
     # ---- shape bucketing ---------------------------------------------
 
@@ -783,13 +942,15 @@ class ServingEngine:
         req.adapter_slot = slot
         if page_in:
             import jax.numpy as jnp
-            tabs = {
-                p: {"a": jnp.asarray(t["a"]), "b": jnp.asarray(t["b"])}
-                for p, t in self.adapter_store.table_for_slot(
-                    req.adapter_id, self.engine.dtype).items()}
-            with self.engine.mesh:
-                self.adapter_pool = self._page_in_fn(
-                    self.adapter_pool, tabs, np.int32(slot))
+            with self._rspan(req.rid, "adapter_page_in",
+                             adapter=req.adapter_id, slot=int(slot)):
+                tabs = {
+                    p: {"a": jnp.asarray(t["a"]), "b": jnp.asarray(t["b"])}
+                    for p, t in self.adapter_store.table_for_slot(
+                        req.adapter_id, self.engine.dtype).items()}
+                with self.engine.mesh:
+                    self.adapter_pool = self._page_in_fn(
+                        self.adapter_pool, tabs, np.int32(slot))
         return True
 
     def _release_adapter(self, req: Request) -> None:
@@ -849,6 +1010,14 @@ class ServingEngine:
             rb_events.emit("request_shed", reason=e.reason, **e.detail)
             raise
         self._requests[req.rid] = req
+        if self._tracer is not None:
+            self._tracer.begin(req.rid)
+            self._tracer.instant(req.rid, "admitted",
+                                 prompt_tokens=int(prompt.size),
+                                 adapter=adapter_id)
+        # queue-wait clock: spans from here (or the latest preemption)
+        # until the request's next dispatch
+        req._trace_wait_t0 = req.submit_t
         if self._stats_t0 is None:
             self._stats_t0 = req.submit_t
         return req.rid
@@ -1033,6 +1202,13 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
+        # phase decomposition (ISSUE 18): pure host perf_counter reads —
+        # the ring is always on; the doctor prices it after the fact
+        t_round0 = time.perf_counter()
+        self._round_tokens = 0
+        ph = {"schedule_ms": 0.0, "housekeeping_ms": 0.0, "prefill_ms": 0.0,
+              "decode_ms": 0.0, "fetch_ms": 0.0, "commit_ms": 0.0}
+
         info = rb_faults.serving_round_seam()
         keep = info.get("squeeze")
         if keep is not None:
@@ -1042,8 +1218,26 @@ class ServingEngine:
             self.allocator.set_reserve(
                 max(0, self.allocator.free_blocks - int(keep)))
         try:
+            t0 = time.perf_counter()
             decisions = self.scheduler.schedule(
                 token_budget=self.config.prefill_token_budget)
+            ph["schedule_ms"] = (time.perf_counter() - t0) * 1e3
+            if self._tracer is not None:
+                now = time.perf_counter()
+                for req in decisions["preempted"]:
+                    self._tracer.instant(req.rid, "preempted",
+                                         preemptions=req.preemptions)
+                    req._trace_wait_t0 = now    # queue wait restarts
+                for req in decisions["admitted"]:
+                    # begin() is idempotent; restored/migrated requests
+                    # that never passed add_request get their id here
+                    self._tracer.begin(req.rid)
+                    w0 = getattr(req, "_trace_wait_t0", req.submit_t)
+                    self._tracer.add_span(
+                        req.rid, "queue_wait", self._tracer.epoch(w0),
+                        self._tracer.epoch(now),
+                        preemptions=req.preemptions)
+            t0 = time.perf_counter()
             if self._lora:
                 # adapter pins track the running set: scheduler-preempted
                 # victims drop theirs first (their slots become LRU
@@ -1064,6 +1258,8 @@ class ServingEngine:
                     # the copy-on-write fork runs BEFORE any of the
                     # request's own dispatches can write the boundary block
                     self._dispatch_fork(req)
+            ph["housekeeping_ms"] = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
             for req, start, n in decisions["prefill"]:
                 if req.state != "running":
                     continue     # bounced by the adapter-slot pin above
@@ -1073,12 +1269,20 @@ class ServingEngine:
                     # LoRA-armed engines route ALL prefills through the
                     # span program: it carries the adapter delta, and one
                     # program family keeps the compile count flat
-                    self._dispatch_prefill(req)
+                    with self._rspan(req.rid, "prefill", tokens=int(n),
+                                     reprefill=req.preemptions > 0):
+                        self._dispatch_prefill(req)
                 else:
-                    self._dispatch_chunk(req, start, n)
+                    with self._rspan(req.rid, "prefill_chunk",
+                                     start=int(start), tokens=int(n)):
+                        self._dispatch_chunk(req, start, n)
+            ph["prefill_ms"] = (time.perf_counter() - t0) * 1e3
             if not self.scheduler.running:
+                ph["round_ms"] = (time.perf_counter() - t_round0) * 1e3
+                self._note_phases({**ph, "tokens": 0.0})
                 return []
 
+            t_dec0 = time.perf_counter()
             tables, seq_lens, active, aidx = self._tables_device()
             spec = (self.config.spec_tokens > 0
                     and any(r.prefill_done for r in self.scheduler.running))
@@ -1128,6 +1332,10 @@ class ServingEngine:
                             p, t, lens = step_fn(params, p, t, tables, lens,
                                                  active, k, apool, aidx)
                             outs.append(t)
+                # dispatch done / fetch begins: the split the doctor uses
+                # to tell dispatch-bound from fetch-bound (local stamps —
+                # watchdog-thread-safe, committed only on success)
+                tq1 = time.perf_counter()
                 # the ONE sync of the round: the sampled tokens (quantum
                 # steps or the verify step's accept verdict) AND every
                 # pending prefill/chunk token ride a single device_get
@@ -1135,22 +1343,45 @@ class ServingEngine:
                     (jnp.stack(outs) if outs
                      else jnp.zeros((0, S), jnp.int32),
                      [f for _, f in pending], spec_dev))
-                return p, t, toks, firsts, spec_host
+                return p, t, toks, firsts, spec_host, (
+                    tq1, time.perf_counter())
 
             out = self._with_watchdog(quantum_and_fetch,
                                       armed=self._quantum_warm)
             if out is None:         # only reachable through a stale epoch
                 raise DecodeDispatchHang("round abandoned by recovery")
-            p, t, toks, firsts, spec_host = out
+            p, t, toks, firsts, spec_host, (tq1, tq2) = out
+            ph["decode_ms"] = (tq1 - t_dec0) * 1e3
+            ph["fetch_ms"] = (tq2 - tq1) * 1e3
+            if self._tracer is not None and decode:
+                for req in self.scheduler.running:
+                    if req.prefill_done:
+                        self._tracer.add_span(
+                            req.rid, "decode_quantum",
+                            self._tracer.epoch(t_dec0),
+                            self._tracer.epoch(tq2),
+                            steps=(1 if spec
+                                   else self.config.decode_quantum))
             if decode:
                 self._quantum_warm = True
             self.pools, self._tokens = p, t
         finally:
             if keep is not None:
                 self.allocator.set_reserve(0)
+        t0 = time.perf_counter()
         if spec_host is not None:
-            return self._commit_spec(spec_host, pending, firsts)
-        return self._commit_round(np.asarray(toks), pending, firsts)
+            finished = self._commit_spec(spec_host, pending, firsts)
+        else:
+            finished = self._commit_round(np.asarray(toks), pending, firsts)
+        ph["commit_ms"] = (time.perf_counter() - t0) * 1e3
+        ph["round_ms"] = (time.perf_counter() - t_round0) * 1e3
+        self._note_phases({**ph, "tokens": float(self._round_tokens)})
+        if self._tracer is not None:
+            for req in finished:
+                self._tracer.instant(req.rid, "finish",
+                                     tokens=len(req.generated))
+                self._tracer.end(req.rid)
+        return finished
 
     def _note_tokens(self, req: Request, m: int, now: float) -> None:
         """Inter-token-latency bookkeeping: a commit burst of ``m`` tokens
@@ -1160,6 +1391,7 @@ class ServingEngine:
         ITL's — it only starts the clock."""
         if m <= 0:
             return
+        self._round_tokens += m        # phase ring's per-token denominator
         if req.last_token_t is not None:
             per_tok = (now - req.last_token_t) * 1e3 / m
             self._itl_ms.extend([per_tok] * m)
@@ -1341,6 +1573,10 @@ class ServingEngine:
                 continue
             self.scheduler.cancel(req, reason=f"{kind}_deadline")
             self._release_adapter(req)   # no-op for never-pinned waiters
+            if self._tracer is not None:
+                self._tracer.instant(req.rid, "cancelled",
+                                     reason=f"{kind}_deadline")
+                self._tracer.end(req.rid)
             self._cancelled.append(req)
             self._counters["deadline_misses"] += 1
             rb_events.emit("deadline_miss", rid=req.rid, kind=kind,
@@ -1409,8 +1645,16 @@ class ServingEngine:
         tag_dir = os.path.join(save_dir, tag)
         os.makedirs(tag_dir, exist_ok=True)
         integrity.invalidate(tag_dir)      # rewriting in place: torn-able
+        if self._tracer is not None:
+            # marked BEFORE the context snapshot below so the drain point
+            # itself rides the migrated trace
+            for req in live:
+                self._tracer.instant(req.rid, "drained", tag=tag)
         state = {
-            "version": 2,
+            # v3 (ISSUE 18): per-request "trace" context (id + spans) so a
+            # migrated request's trace stitches across replicas. Readers
+            # ignore unknown fields — v2 consumers interop unchanged.
+            "version": 3,
             "rng_counter": self._rng_counter,
             "source": source,
             "engine": {
@@ -1436,6 +1680,8 @@ class ServingEngine:
                 "ttft_deadline_ms": req.ttft_deadline_ms,
                 "deadline_ms": req.deadline_ms,
                 "adapter_id": req.adapter_id,
+                "trace": (self._tracer.context(req.rid)
+                          if self._tracer is not None else None),
             } for req in live],
         }
         integrity.atomic_write(os.path.join(tag_dir, "state.json"),
@@ -1471,7 +1717,7 @@ class ServingEngine:
         (see _check_geometry for why a continuation must not cross mesh
         geometries)."""
         self._check_geometry(geometry, source)
-        reqs: List[Request] = []
+        reqs: List[Any] = []       # (Request, drained trace ctx or None)
         for rec in recs:
             aid = int(rec.get("adapter_id", 0))
             if aid and (not self._lora or aid not in self.adapter_store):
@@ -1507,13 +1753,20 @@ class ServingEngine:
                     f"(block-table width {self.MB} x "
                     f"{self.config.block_size}-token blocks) — place it "
                     "on an engine at least as large as the drained one")
-            reqs.append(req)
+            reqs.append((req, rec.get("trace")))
         if rng_counter is not None:
             self._rng_counter = max(self._rng_counter, int(rng_counter))
         rids: List[int] = []
-        for req in reqs:
+        for req, trace_ctx in reqs:
             self.scheduler.restore(req)
             self._requests[req.rid] = req
+            if self._tracer is not None:
+                # stitch: inherit the drained trace id + spans (v3 record)
+                # so the merged export shows ONE trace across replicas
+                self._tracer.adopt(req.rid, trace_ctx)
+                self._tracer.instant(req.rid, "migrated_in",
+                                     source=source or "")
+            req._trace_wait_t0 = req.submit_t    # restore() re-stamps it
             rids.append(req.rid)
         if self._stats_t0 is None and rids:
             self._stats_t0 = time.perf_counter()
@@ -1629,6 +1882,14 @@ class ServingEngine:
         if self._lora:
             p = self.adapter_slots
             p.hits = p.evictions = p.page_ins = 0
+        # fleet observability (ISSUE 18): the phase ring, the blind-stall
+        # latch and the tracer's sync self-report are window-scoped too —
+        # the reset-parity sweep pins that every rollup counter clears
+        self._phases.clear()
+        self._round_tokens = 0
+        self._phase_stall_events = 0
+        if self._tracer is not None:
+            self._tracer.device_syncs = 0
 
     def close(self, timeout: Optional[float] = None) -> bool:
         """Stop admission and join the latest watchdog round thread with

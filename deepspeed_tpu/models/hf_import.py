@@ -772,15 +772,16 @@ def load_hf_params(src, cfg, *, shardings=None, dtype=None,
     return out
 
 
-def _leaves_with_path(tree):
+def _leaves_with_path(tree, is_leaf=None):
     """jax.tree.leaves_with_path with a jax<=0.4.37 fallback: the alias
     only landed on the ``jax.tree`` namespace later — same compat mold as
-    the ``ring_attention`` tree-API fix (PR 15)."""
+    the ``ring_attention`` tree-API fix (PR 15). Both spellings accept
+    ``is_leaf``."""
     import jax
     fn = getattr(jax.tree, "leaves_with_path", None)
     if fn is None:
         fn = jax.tree_util.tree_leaves_with_path
-    return fn(tree)
+    return fn(tree, is_leaf=is_leaf)
 
 
 def _tree_get(tree, path):

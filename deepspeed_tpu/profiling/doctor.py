@@ -20,6 +20,14 @@ Rules:
                                 from the static OverlapAudit's modeled
                                 ``exposed_comm_ms`` by > 25% (warning: one
                                 of the two models is lying)
+  * ``offload-overlap``       — the layer-streamed step left too much of
+                                its storage IO exposed (``--offload-decomp``)
+  * ``serving-phase-stall``   — a NON-fetch serving round phase dominates
+                                round wall time (``--serving-decomp``;
+                                ISSUE 18 — fetch-bound is the healthy
+                                "device is the bottleneck" state)
+  * ``tracing-sync-leak``     — request tracing performed device syncs or
+                                exceeds the < 1% overhead budget
 
 Exit status: non-zero when any error finding survives — the CI gate.
 """
@@ -237,6 +245,165 @@ def offload_fields(diag: Dict[str, Any]) -> Dict[str, Any]:
     return {k: diag[k] for k in keys if k in diag}
 
 
+# --------------------------------------------------------------------------
+# serving doctor (ISSUE 18)
+# --------------------------------------------------------------------------
+
+# a NON-fetch phase of the serving round loop above this fraction of round
+# wall time is a stall the knob table names; fetch is exempt — the round's
+# ONE sync legitimately waits on the device, so fetch-dominant means "the
+# accelerator is the bottleneck", which is the healthy steady state
+SERVING_MAX_PHASE_FRACTION = 0.5
+# request tracing must stay under this much added round time (and ZERO
+# device syncs) — _serving_bench asserts the same bar as
+# serve_trace_overhead_pct
+TRACE_MAX_OVERHEAD_PCT = 1.0
+
+# phase -> which resource the round is actually bound on
+SERVING_BOUND = {
+    "schedule": "host-scheduling-bound",
+    "commit": "host-scheduling-bound",
+    "prefill_dispatch": "dispatch-bound",
+    "decode_dispatch": "dispatch-bound",
+    "fetch": "fetch-bound",
+    "housekeeping": "paging-bound",
+}
+# the "turn this knob" message per dominant phase
+SERVING_KNOBS = {
+    "schedule": "raise decode_quantum (fewer scheduling boundaries per "
+                "token) or cap max_seqs — the Python scheduler is the "
+                "bottleneck",
+    "commit": "raise decode_quantum or thin the per-token host "
+              "bookkeeping — round-boundary commit work dominates",
+    "prefill_dispatch": "set/raise prefill_token_budget so long prompts "
+                        "chunk instead of monopolizing rounds, and check "
+                        "prompt_bucket for compile churn",
+    "decode_dispatch": "fewer, larger steps: raise decode_quantum, or "
+                       "hunt per-step recompiles (decode_backend/bucket "
+                       "drift)",
+    "fetch": "healthy: the device is the bottleneck — scale the mesh or "
+             "shrink the model, not the host loop",
+    "housekeeping": "adapter paging / CoW fork traffic dominates: more "
+                    "adapter_slots (or adapter-affinity routing) so hot "
+                    "adapters stay resident instead of re-paging",
+}
+
+
+def diagnose_serving(decomp: Dict[str, Any]) -> Dict[str, Any]:
+    """Round-phase attribution for the serving loop, from
+    ``ServingEngine.phase_decomposition()`` output: per-phase fractions of
+    round wall time, the dominant phase and its bound
+    (host-scheduling-bound / dispatch-bound / fetch-bound / paging-bound),
+    the top-2 phases for the bench, per-token round cost, and the tracing
+    evidence (device-sync self-report + measured overhead) passed through
+    for ``gate_serving``."""
+    phases = {
+        "schedule": float(decomp.get("serve_schedule_ms", 0.0)),
+        "housekeeping": float(decomp.get("serve_housekeeping_ms", 0.0)),
+        "prefill_dispatch": float(decomp.get("serve_prefill_dispatch_ms",
+                                             0.0)),
+        "decode_dispatch": float(decomp.get("serve_decode_dispatch_ms",
+                                            0.0)),
+        "fetch": float(decomp.get("serve_fetch_ms", 0.0)),
+        "commit": float(decomp.get("serve_commit_ms", 0.0)),
+    }
+    round_ms = float(decomp.get("serve_round_ms", 0.0))
+    tokens = float(decomp.get("serve_tokens", 0.0))
+    out: Dict[str, Any] = {
+        "serve_rounds": float(decomp.get("serve_rounds", 0.0)),
+        "serve_phases_ms": {k: round(v, 3) for k, v in phases.items()},
+        "serve_round_ms": round(round_ms, 3),
+        "serve_tokens": tokens,
+    }
+    if round_ms > 0 and out["serve_rounds"] > 0:
+        fr = {k: v / round_ms for k, v in phases.items()}
+        top = sorted(phases, key=phases.get, reverse=True)
+        out["serve_phase_fractions"] = {k: round(v, 4)
+                                        for k, v in fr.items()}
+        out["serve_dominant_phase"] = top[0]
+        out["serve_bound"] = SERVING_BOUND[top[0]]
+        out["serve_phase_top2"] = [
+            {"phase": k, "ms": round(phases[k], 3),
+             "fraction": round(fr[k], 4)} for k in top[:2]]
+        if tokens > 0:
+            out["serve_ms_per_token"] = round(round_ms / tokens, 4)
+    for k in ("trace_armed", "trace_device_syncs",
+              "serve_phase_stall_events", "serve_trace_overhead_pct"):
+        if k in decomp:
+            out[k] = decomp[k]
+    return out
+
+
+def gate_serving(diag: Dict[str, Any], *,
+                 max_phase_fraction: float = SERVING_MAX_PHASE_FRACTION,
+                 max_trace_overhead_pct: float = TRACE_MAX_OVERHEAD_PCT,
+                 program: str = "serving_round") -> Report:
+    """The serving rules, in the graft-lint mold (exit status = CI gate):
+
+    * ``serving-phase-stall`` — a NON-fetch phase exceeds
+      ``max_phase_fraction`` of round wall time (corpus twin:
+      ``serving-blind-stall``). Fails CLOSED when the decomposition
+      carries no priced rounds — a gate that never saw a round must not
+      certify the loop.
+    * ``tracing-sync-leak`` — the tracer self-reports device syncs (a
+      ``device_get`` per span — the defect its host-clock contract
+      forbids), or measured tracing overhead reaches
+      ``max_trace_overhead_pct`` (corpus twin: ``tracing-sync-leak``)."""
+    report = Report(meta={"tool": "perf-doctor", "program": program,
+                          "serving": diag})
+    fr = diag.get("serve_phase_fractions")
+    if not fr:
+        report.extend([Finding(
+            rule="serving-phase-stall",
+            message="serving phases cannot be priced: the decomposition "
+                    "carries no rounds / round wall time (serve some "
+                    "load, then pass phase_decomposition() output)",
+            program=program, ident="unpriced", data=dict(diag))])
+        return report
+    for phase, f in sorted(fr.items(), key=lambda kv: -kv[1]):
+        if phase == "fetch":
+            continue      # the one sync: device-bound is health
+        if f > max_phase_fraction:
+            report.extend([Finding(
+                rule="serving-phase-stall",
+                message=(f"serving rounds are {SERVING_BOUND[phase]}: "
+                         f"phase '{phase}' takes {f:.0%} of round wall "
+                         f"time (budget {max_phase_fraction:.0%}) — "
+                         f"{SERVING_KNOBS[phase]}"),
+                program=program, ident=phase,
+                data={"phase": phase, "fraction": round(f, 4),
+                      "phases_ms": diag.get("serve_phases_ms")})])
+            break         # name the dominant stall, not every echo of it
+    syncs = diag.get("trace_device_syncs") or 0
+    pct = diag.get("serve_trace_overhead_pct")
+    if syncs:
+        report.extend([Finding(
+            rule="tracing-sync-leak",
+            message=(f"request tracing performed {int(syncs)} device "
+                     "syncs — span bookkeeping must be host-wall-clock "
+                     "only (a device_get per span serializes the exact "
+                     "dispatch pipeline tracing exists to observe)"),
+            program=program, ident="device-syncs",
+            data={"trace_device_syncs": syncs})])
+    elif pct is not None and float(pct) >= max_trace_overhead_pct:
+        report.extend([Finding(
+            rule="tracing-sync-leak",
+            message=(f"request tracing adds {float(pct):.2f}% round time "
+                     f"(budget < {max_trace_overhead_pct:.0f}%) — the "
+                     "on_span hook is doing non-trivial work per span"),
+            program=program, ident="overhead",
+            data={"serve_trace_overhead_pct": pct})])
+    return report
+
+
+def serving_fields(diag: Dict[str, Any]) -> Dict[str, Any]:
+    """The bench-JSON fields for the serving attribution (the doctor's
+    bound + top-2 phases ride next to the SLO numbers)."""
+    keys = ("serve_bound", "serve_dominant_phase", "serve_phase_top2",
+            "serve_ms_per_token")
+    return {k: diag[k] for k in keys if k in diag}
+
+
 def baseline_dict(diag: Dict[str, Any]) -> Dict[str, Any]:
     return {"buckets": diag.get("buckets", {}),
             "stall_top2": diag.get("stall_top2", []),
@@ -292,6 +459,86 @@ def synthetic_serialized_backward_trace() -> Dict[str, Any]:
     return {"displayTimeUnit": "ms", "traceEvents": evs}
 
 
+def simulate_serving_decomp(stalled: bool = False) -> Dict[str, Any]:
+    """A synthetic 64-round phase decomposition in the ring's schema.
+    Healthy: fetch-dominant (the round's one sync waits ~3.2 ms of a
+    ~5.7 ms round on the device — the steady state the gate must PASS).
+    ``stalled``: every other round pays an ~18 ms cold adapter page-in,
+    so housekeeping swamps the round — the ``serving-blind-stall`` face
+    the gate must name as paging-bound."""
+    rounds = 64
+    per = {"schedule": 0.35, "housekeeping": 0.15, "prefill_dispatch": 0.45,
+           "decode_dispatch": 1.1, "fetch": 3.2, "commit": 0.25}
+    totals = {k: v * rounds for k, v in per.items()}
+    if stalled:
+        totals["housekeeping"] += 18.0 * (rounds // 2)
+    round_ms = sum(totals.values()) + 0.02 * rounds   # loop overhead
+    return {
+        "serve_rounds": float(rounds),
+        "serve_schedule_ms": totals["schedule"],
+        "serve_housekeeping_ms": totals["housekeeping"],
+        "serve_prefill_dispatch_ms": totals["prefill_dispatch"],
+        "serve_decode_dispatch_ms": totals["decode_dispatch"],
+        "serve_fetch_ms": totals["fetch"],
+        "serve_commit_ms": totals["commit"],
+        "serve_round_ms": round_ms,
+        "serve_tokens": float(rounds * 24),
+    }
+
+
+def audit_serving(stalled: bool = True) -> Report:
+    """Corpus face of the serving gate: the stalled decomposition MUST
+    fire ``serving-phase-stall`` naming housekeeping/paging; the healthy
+    twin MUST pass (fetch-dominant is the certified steady state)."""
+    diag = diagnose_serving(simulate_serving_decomp(stalled=stalled))
+    return gate_serving(diag, program=("serving_blind_stall" if stalled
+                                       else "serving_instrumented"))
+
+
+def audit_tracing(leaky: bool = True) -> Report:
+    """Corpus face of the tracing-overhead gate, driven through the REAL
+    ``RequestTracer`` over a simulated request load. The leaky twin
+    plants the defect the host-clock contract forbids: an ``on_span``
+    hook that round-trips the device per span (one ``device_get`` each,
+    self-reported on ``tracer.device_syncs`` per the hook contract) —
+    the gate fires on the sync count, deterministically, with the
+    measured per-span cost priced against the synthetic healthy round
+    for the overhead field. The host-clock twin's hook is pure host work
+    and MUST pass."""
+    import time
+
+    from deepspeed_tpu.telemetry.request_trace import RequestTracer
+
+    tracer = RequestTracer(replica="audit")
+    if leaky:
+        import jax
+        import jax.numpy as jnp
+
+        def leak(ev):
+            jax.device_get(jnp.zeros(()))   # the defect: a sync per span
+            tracer.device_syncs += 1        # the hook self-report contract
+        tracer.on_span = leak
+    t0 = time.perf_counter()
+    for rid in range(8):
+        tracer.begin(rid)
+        with tracer.span(rid, "prefill"):
+            pass
+        for _ in range(24):
+            with tracer.span(rid, "decode_quantum"):
+                pass
+        tracer.instant(rid, "finish")
+        tracer.end(rid)
+    span_ms = (time.perf_counter() - t0) * 1e3
+    decomp = simulate_serving_decomp(stalled=False)
+    decomp["trace_armed"] = 1.0
+    decomp["trace_device_syncs"] = float(tracer.device_syncs)
+    decomp["serve_trace_overhead_pct"] = round(
+        100.0 * span_ms / decomp["serve_round_ms"], 3)
+    diag = diagnose_serving(decomp)
+    return gate_serving(diag, program=("tracing_sync_leak" if leaky
+                                       else "tracing_host_clock"))
+
+
 DOCTOR_CORPUS = {
     "exposed-collective-trace": (synthetic_exposed_collective_trace,
                                  "exposed_collective_trace"),
@@ -299,10 +546,22 @@ DOCTOR_CORPUS = {
                             "serialized_backward"),
 }
 
+# serving-tier entries run their own audit (decomp/tracer-driven, not a
+# Chrome trace) — run_corpus_entry dispatches on membership
+SERVING_CORPUS = {
+    "serving-blind-stall": (lambda: audit_serving(stalled=True),
+                            "serving_blind_stall"),
+    "tracing-sync-leak": (lambda: audit_tracing(leaky=True),
+                          "tracing_sync_leak"),
+}
+
 
 def run_corpus_entry(name: str = "exposed-collective-trace") -> Report:
     """A ``doctor`` corpus entry (analysis.corpus wires them into the lint
-    --corpus runner): the seeded exposed collective MUST fire the gate."""
+    --corpus runner): the seeded defect MUST fire its gate."""
+    if name in SERVING_CORPUS:
+        run, _program = SERVING_CORPUS[name]
+        return run()
     make_trace, program = DOCTOR_CORPUS[name]
     diag = diagnose(make_trace())
     return gate(diag, program=program)
@@ -357,7 +616,39 @@ def main(argv=None) -> int:
                         "offload-overlap gate instead of a trace")
     p.add_argument("--min-offload-overlap", type=float,
                    default=OFFLOAD_MIN_OVERLAP)
+    p.add_argument("--serving-decomp", metavar="PATH",
+                   help="serving round-phase decomposition JSON "
+                        "(ServingEngine.phase_decomposition() output, e.g. "
+                        "cut from the bench JSON): run the "
+                        "serving-phase-stall / tracing-sync-leak gates "
+                        "instead of a trace")
+    p.add_argument("--max-phase-fraction", type=float,
+                   default=SERVING_MAX_PHASE_FRACTION)
     args = p.parse_args(argv)
+
+    if args.serving_decomp:
+        decomp = _load_json(args.serving_decomp)
+        diag = diagnose_serving(decomp)
+        report = gate_serving(
+            diag, max_phase_fraction=args.max_phase_fraction,
+            program=os.path.basename(args.serving_decomp))
+        print(report.summary(), file=sys.stderr)
+        top = ", ".join(f"{s['phase']}={s['ms']:.2f}ms({s['fraction']:.0%})"
+                        for s in diag.get("serve_phase_top2", [])) or "none"
+        print(f"doctor: {diag.get('serve_rounds', 0):.0f} serving rounds, "
+              f"bound {diag.get('serve_bound', 'unpriced')}, top phases: "
+              f"{top}", file=sys.stderr)
+        if args.json_out:
+            payload = dict(diag)
+            payload["findings"] = [f.to_dict() for f in report.findings]
+            payload["ok"] = report.ok
+            text = json.dumps(payload, indent=2, default=str)
+            if args.json_out == "-":
+                print(text)
+            else:
+                with open(args.json_out, "w") as f:
+                    f.write(text + "\n")
+        return 0 if report.ok else 1
 
     if args.offload_decomp:
         decomp = _load_json(args.offload_decomp)
@@ -381,9 +672,9 @@ def main(argv=None) -> int:
     if args.corpus:
         name = ("exposed-collective-trace" if args.corpus == "doctor"
                 else args.corpus)
-        if name not in DOCTOR_CORPUS:
+        if name not in DOCTOR_CORPUS and name not in SERVING_CORPUS:
             p.error(f"unknown doctor corpus entry '{args.corpus}' — one of "
-                    f"{sorted(DOCTOR_CORPUS)}")
+                    f"{sorted({**DOCTOR_CORPUS, **SERVING_CORPUS})}")
         report = run_corpus_entry(name)
         print(report.summary(), file=sys.stderr)
         return 0 if report.ok else 1

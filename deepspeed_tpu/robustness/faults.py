@@ -76,6 +76,16 @@ rounds, independent of the per-engine ``serving_round`` counter):
                     generation reads during the partition exercise the
                     ``FileRendezvous.current_generation`` fallback
 
+Observability of injected faults (ISSUE 18): every kind above already
+emits ``fault_injected`` plus its recovery record; the fleet-observability
+layer adds two read-side event types an injected stall surfaces through —
+``serving_phase_stall {phase, phase_ms, round_ms}`` when a warm engine's
+round regresses >= 3x its window median with a non-fetch phase dominant
+(a ``pool_exhaust`` squeeze or adapter-paging storm reads as
+``housekeeping``-bound here), and ``trace_export {path, events,
+replicas}`` when a merged Chrome trace is written. Neither is a fault
+kind — they are how a fault LOOKS from the doctor's side of the glass.
+
 Schedules are deterministic by construction: explicit entries fire at exact
 step/op indices, and the optional ``seed`` only feeds probabilistic rates
 through a private ``numpy`` Generator — same seed, same faults, every run.

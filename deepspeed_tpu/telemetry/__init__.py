@@ -17,6 +17,15 @@ serving with zero added steady-state syncs). Four pieces:
                  flops priced by the observed step rate: modeled comms
                  bytes/sec and per-window MFU as monitor events
 
+The serving fleet (PR 18) adds two request-tier pieces on the same rules:
+
+  request_trace — per-request host-clock spans across the whole lifecycle
+                  (admission → prefill chunks → decode quanta → drain/
+                  migration), stitched across replicas through drain-state
+                  v3 and merged into one Chrome trace (replica = process)
+  exposition    — mergeable fixed-edge histograms + Prometheus text
+                  format for the router's ``fleet_stats()`` rollup
+
 The robustness subsystem (``deepspeed_tpu/robustness``) publishes its
 recovery decisions on the same record stream: ``ckpt_fallback``,
 ``fault_recovered``, ``ckpt_save_failed``, ``preempted`` and
@@ -36,12 +45,17 @@ from deepspeed_tpu.telemetry.accumulators import (HIST_BUCKETS, HIST_LOG2_MIN,
                                                   window_stats)
 from deepspeed_tpu.telemetry.anomaly import (SEVERITY_NUM, AnomalyDetector,
                                              severity_num)
+from deepspeed_tpu.telemetry.exposition import (Histogram, parse_exposition,
+                                                render_prometheus)
 from deepspeed_tpu.telemetry.join import joined_rates, static_step_cost
+from deepspeed_tpu.telemetry.request_trace import (RequestTracer,
+                                                   merge_chrome_trace)
 from deepspeed_tpu.telemetry.tracing import StepTracer
 
 __all__ = [
-    "HIST_BUCKETS", "HIST_LOG2_MIN", "AnomalyDetector", "HostWindow",
-    "SEVERITY_NUM", "StepTracer", "accumulate", "init_leaf", "joined_rates",
-    "severity_num", "static_step_cost", "update_to_param_ratio",
-    "window_stats",
+    "HIST_BUCKETS", "HIST_LOG2_MIN", "AnomalyDetector", "Histogram",
+    "HostWindow", "RequestTracer", "SEVERITY_NUM", "StepTracer", "accumulate",
+    "init_leaf", "joined_rates", "merge_chrome_trace", "parse_exposition",
+    "render_prometheus", "severity_num", "static_step_cost",
+    "update_to_param_ratio", "window_stats",
 ]

@@ -36,6 +36,10 @@
 #       rotating-tenant churn soak (evict/re-page under all-pinned
 #       preemptions, latency stack on) vs per-tenant merged-dense
 #       serial engines, token-for-token
+#   OBS_BUDGET=420 tests/run_slow.sh fleet_obs  # ISSUE 18: the fleet
+#       rollup truth test (2 engine builds + a full routed load) and the
+#       traced 2-replica kill/failover stitch, bit-compared against an
+#       untraced fault-free run
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -116,6 +120,11 @@ for m in "${modules[@]}"; do
         # decodes full loads with the latency stack on (matched before
         # the *test_serving* glob below)
         *test_lora_serving*) budget="${LORA_BUDGET:-420}" ;;
+        # ISSUE-18 fleet observability: the rollup-vs-truth and traced
+        # kill/failover stitch tests each build 2-3 engines and serve
+        # full routed loads (matched before the *test_serving* glob
+        # below)
+        *test_fleet_obs*) budget="${OBS_BUDGET:-420}" ;;
         # ISSUE-9 serving tier: multi-tenant end-to-end runs (engine
         # rebuilds + per-bucket prefill compiles + int8 pool parity over
         # 24 decode steps) own a budget independent of the tier default

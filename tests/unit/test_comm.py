@@ -8,6 +8,7 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu import comm
+from deepspeed_tpu.comm.schedule import shard_map_compat
 
 
 @pytest.fixture()
@@ -16,7 +17,13 @@ def mesh1d(devices8):
 
 
 def _run(mesh, fn, x, in_spec, out_spec):
-    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
+    # jax.shard_map only landed on the top-level namespace later; route
+    # through the package's version-compat wrapper (the PR-15 ring_attention
+    # mold) with EVERY mesh axis manual — classic shard_map semantics on
+    # both spellings.
+    f = jax.jit(shard_map_compat(fn, mesh, in_specs=in_spec,
+                                 out_specs=out_spec,
+                                 manual_axes=mesh.axis_names))
     return f(x)
 
 

@@ -65,10 +65,13 @@ def test_logical_axes_structure_matches_params(rng):
         assert jax.tree.structure(jax.tree.map(lambda x: 0, params)) == \
             jax.tree.structure(jax.tree.map(lambda x: 0, axes,
                                             is_leaf=lambda x: x is None or isinstance(x, tuple)))
-        # every axes tuple rank must match the param rank
-        flat_p = jax.tree.leaves_with_path(params)
+        # every axes tuple rank must match the param rank (the path walk
+        # goes through the jax<=0.4.37 compat helper: jax.tree only grew
+        # leaves_with_path later — the PR-16 hf_import fallback)
+        from deepspeed_tpu.models.hf_import import _leaves_with_path
+        flat_p = _leaves_with_path(params)
         axes_map = {jax.tree_util.keystr(k): v for k, v in
-                    jax.tree.leaves_with_path(axes, is_leaf=lambda x: x is None or isinstance(x, tuple))}
+                    _leaves_with_path(axes, is_leaf=lambda x: x is None or isinstance(x, tuple))}
         for path, leaf in flat_p:
             a = axes_map[jax.tree_util.keystr(path)]
             assert a is None or len(a) == len(leaf.shape), f"{path}: {a} vs {leaf.shape}"
