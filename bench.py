@@ -353,6 +353,11 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                 print(f"bench: router bench failed: {e}", file=sys.stderr)
             gc.collect()
             try:
+                result.update(_disagg_bench(size))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: disagg bench failed: {e}", file=sys.stderr)
+            gc.collect()
+            try:
                 result.update(_capacity_bench())
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: capacity bench failed: {e}", file=sys.stderr)
@@ -405,6 +410,16 @@ def run_bench(quick: bool = False, model_size: str = None, seq: int = None,
                                             small=True))
             except Exception as e:  # noqa: BLE001 — secondary metric
                 print(f"bench: router bench failed: {e}", file=sys.stderr)
+            # CPU smoke of the disaggregated rung: tiny model, same KV
+            # handoff / role-routing / autoscale code path incl. the
+            # handoff-vs-reprefill pricing and the TTFT + zero-lost
+            # gates, so serve_handoff_ms / serve_autoscale_* can't rot
+            # on boxes without the relay
+            try:
+                result.update(_disagg_bench(size, n_requests=8, max_new=6,
+                                            small=True))
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                print(f"bench: disagg bench failed: {e}", file=sys.stderr)
             # CPU smoke of the capacity rung: tiny model over the NVMe
             # io_uring tier — the overlapped offload pipeline, its measured
             # decomposition + doctor overlap pricing, and the drained-twin
@@ -1852,6 +1867,279 @@ def _router_bench(size: str, n_requests: int = 24, max_new: int = 16,
             (int(st["completed"]) * max_new) / dt, 1),
     }
     del router, srv0, srv1
+    _gc.collect()
+    return out
+
+
+def _disagg_bench(size: str, n_requests: int = 16, max_new: int = 8,
+                  small: bool = False) -> dict:
+    """Disaggregated prefill/decode rung (ISSUE 19), three measurements:
+
+    1. **Handoff pricing** — engine-level: the KV-byte handoff (export
+       gather -> release -> accept(kv) -> one tail-span step on the
+       decode engine, ``serve_handoff_ms``) against the re-prefill
+       fallback (same hop, record only — the decode engine re-pays the
+       whole prompt, ``serve_handoff_reprefill_ms``). Both are
+       time-to-next-token on the receiving engine, warm compiles.
+    2. **Topology** — the prefill=1 + decode=2 fleet vs the colocated
+       2-replica router on the adversarial prompt mix:
+       ``serve_p99_ttft_ms_disagg`` vs ``serve_p99_ttft_ms_coloc`` and
+       the ``serve_disagg_ttft_ok`` gate (p99 TTFT must beat colocated —
+       a dedicated prefill tier never makes a new prompt wait behind a
+       stranger's decode quanta). Continuations stay token-identical
+       either way (pinned in tests/unit/test_disagg.py, not re-proved
+       here).
+    3. **Autoscale soak** — one replica + the FleetController under a
+       burst-then-lull load: the burst must at least double the tier,
+       the lull must drain it back, and ``serve_autoscale_lost`` MUST
+       be 0 throughout (scale-downs drain through the integrity chain)."""
+    import gc as _gc
+    import shutil
+    import statistics
+    import tempfile
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.fleet import FleetConfig, FleetController
+    from deepspeed_tpu.inference.router import RouterConfig, ServingRouter
+    from deepspeed_tpu.models import llama_config, make_model
+
+    overrides = dict(vocab_size=2048, num_layers=2, hidden_size=128,
+                     num_heads=4, num_kv_heads=2,
+                     intermediate_size=384) if small else {}
+    cfg = llama_config(size, max_seq_len=4096, **overrides)
+    model = make_model(cfg, name=f"llama-{size}-disagg")
+    rng = np.random.default_rng(0)
+    serving_kw = (dict(max_seqs=4, block_size=16, max_model_len=128,
+                       decode_quantum=4, prompt_bucket=16, max_queue=8)
+                  if small else
+                  dict(max_seqs=16, block_size=64, max_model_len=2048,
+                       decode_quantum=8, num_blocks=320, max_queue=8))
+
+    def _make(role=None, params=None, **extra):
+        kw = dict(serving_kw, **extra)
+        if role:
+            kw["role"] = role
+        return deepspeed_tpu.init_serving(
+            model, config={"train_batch_size": 1}, serving=kw,
+            params=params)
+
+    # ---- 1) handoff pricing (engine level) ---------------------------
+    # chunked prefill on (the production posture): the re-prefill
+    # fallback pays prompt/budget rounds on the receiver, the KV path
+    # pays one gather/scatter round-trip + a single tail-span chunk
+    budget = 32 if small else 128
+    pre = _make("prefill", prefill_token_budget=budget)
+    params = pre.engine.params
+    dec = _make("decode", params, prefill_token_budget=budget)
+    # the re-prefill fallback pays O(prompt); price the hop at the longest
+    # prompt the geometry admits so the gap is the one operators see
+    plen = 112 if small else 512
+
+    def _prefill_one(eng, prompt):
+        rid = eng.add_request(prompt, max_new_tokens=max_new)
+        for _ in range(200):
+            eng.step()
+            req = eng._requests.get(rid)
+            if req is not None and req.prefill_done and req.generated:
+                return rid
+        raise RuntimeError("prefill never completed")
+
+    def _next_token_ms(eng, rid):
+        """Steps until the request emits its next token (or finishes)."""
+        base = len(eng._requests[rid].generated)
+        t0 = time.perf_counter()
+        for _ in range(400):
+            eng.step()
+            req = eng._requests.get(rid)
+            if req is None or len(req.generated) > base:
+                return (time.perf_counter() - t0) * 1e3
+        raise RuntimeError("handed-off request never advanced")
+
+    def _drain(eng):
+        for _ in range(400):
+            if eng.scheduler.done:
+                return
+            eng.step()
+
+    kv_ms, reprefill_ms = [], []
+    samples = 3 if small else 5
+    for i in range(samples + 1):       # sample 0 warms both paths' compiles
+        prompt = rng.integers(0, cfg.vocab_size, size=(plen,)
+                              ).astype(np.int32)
+        # KV path: export gather + release + accept(kv) + tail-span step
+        rid = _prefill_one(pre, prompt)
+        t0 = time.perf_counter()
+        payloads = pre.export_kv([rid])
+        recs = pre.release_requests([rid])
+        dec.accept_migration(recs, source="pre", kv=payloads)
+        hand = (time.perf_counter() - t0) * 1e3
+        hand += _next_token_ms(dec, rid)
+        _drain(dec)
+        # fallback path: same hop, record only — full re-prefill on dec
+        rid = _prefill_one(pre, prompt)
+        t0 = time.perf_counter()
+        recs = pre.release_requests([rid])
+        dec.accept_migration(recs, source="pre")
+        fall = (time.perf_counter() - t0) * 1e3
+        fall += _next_token_ms(dec, rid)
+        _drain(dec)
+        if i > 0:
+            kv_ms.append(hand)
+            reprefill_ms.append(fall)
+    out = {
+        "serve_handoff_ms": round(statistics.median(kv_ms), 2),
+        "serve_handoff_reprefill_ms": round(
+            statistics.median(reprefill_ms), 2),
+        "serve_handoff_bytes": int(
+            pre.stats()["handoff_bytes"] / max(1, samples + 1)),
+    }
+    del pre, dec
+    _gc.collect()
+
+    # ---- 2) topology: disagg vs colocated p99 TTFT -------------------
+    # the adversarial mix: decode tails long enough that a colocated
+    # replica's seats stay pinned by strangers' decode quanta while new
+    # prompts queue; the disagg prefill tier recycles its seats at
+    # handoff time instead, so queued prompts reach first token sooner
+    prompts = [32, 48, 96] if small else [256, 512, 1024]
+    t_new = max_new * 4
+    reqs = [(rng.integers(0, cfg.vocab_size,
+                          size=(prompts[i % len(prompts)],),
+                          ).astype(np.int32), t_new)
+            for i in range(2 * n_requests)]
+
+    def _fleet_p99(roles):
+        tmp = tempfile.mkdtemp(prefix="disagg_bench_")
+        engines = []
+        try:
+            router = ServingRouter(RouterConfig(
+                store_dir=os.path.join(tmp, "store"),
+                drain_dir=os.path.join(tmp, "drains")))
+            for i, role in enumerate(roles):
+                eng = _make(role, params)
+                # warm the per-bucket prefill/decode compiles outside the
+                # timed window (decode-role engines still prefill on the
+                # fallback path; warming keeps the comparison about
+                # routing, not compile order). A prefill-role engine
+                # never decodes, so its requests never FINISH — warm it
+                # by prefilling to first token, then release.
+                # the short prompt warms the smallest prefill bucket —
+                # the one a handed-off tail span (1 pending token) lands
+                # in on the decode side
+                warm = [(rng.integers(0, cfg.vocab_size, size=(p,)
+                                      ).astype(np.int32), 4)
+                        for p in prompts + [8]]
+                if role == "prefill":
+                    rids = [eng.add_request(p, m) for p, m in warm]
+                    for _ in range(10000):
+                        eng.step()
+                        live = {r.rid: r for r in eng.scheduler.running}
+                        if all(rid in live and live[rid].prefill_done
+                               and live[rid].generated
+                               for rid in rids):
+                            break
+                    eng.release_requests(rids)
+                else:
+                    eng.run(warm)
+                eng.reset_stats()
+                engines.append(eng)
+                router.register(f"{role}{i}", eng)
+            # warm the handoff path itself (gather on the source, scatter
+            # + tail-span on each sink) — first-import compiles otherwise
+            # land inside the timed window and swamp the p99
+            if roles[0] == "prefill":
+                src = engines[0]
+                for dst in engines[1:]:
+                    prompt = rng.integers(0, cfg.vocab_size,
+                                          size=(prompts[0],)
+                                          ).astype(np.int32)
+                    rid = _prefill_one(src, prompt)
+                    payloads = src.export_kv([rid])
+                    recs = src.release_requests([rid])
+                    dst.accept_migration(recs, source="warm", kv=payloads)
+                    _drain(dst)
+                for eng in engines:
+                    eng.reset_stats()
+            router.run(list(reqs), max_rounds=100000)
+            st = router.stats()
+            return st, router
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    st_disagg, r_disagg = _fleet_p99(["prefill", "decode", "decode"])
+    st_coloc, _ = _fleet_p99(["both", "both"])
+    p99_d = st_disagg.get("p99_ttft_ms", 0.0)
+    p99_c = st_coloc.get("p99_ttft_ms", 0.0)
+    ok = bool(p99_d and p99_c and p99_d < p99_c)
+    if not ok:
+        print(f"bench: DISAGG TTFT GATE: p99 {p99_d:.1f} ms (disagg) vs "
+              f"{p99_c:.1f} ms (colocated) — the dedicated prefill tier "
+              "should win under the adversarial mix (see ISSUE 19)",
+              file=sys.stderr)
+    out.update({
+        "serve_p99_ttft_ms_disagg": round(p99_d, 1),
+        "serve_p99_ttft_ms_coloc": round(p99_c, 1),
+        "serve_disagg_ttft_ok": ok,
+        "serve_disagg_handoffs": int(st_disagg["handoffs"]),
+        "serve_disagg_handoff_fallbacks": int(
+            st_disagg["handoff_fallbacks"]),
+        "serve_disagg_lost": int(st_disagg["lost_requests"]),
+    })
+    del r_disagg
+    _gc.collect()
+
+    # ---- 3) autoscale soak: burst doubles, lull drains, zero lost ----
+    tmp = tempfile.mkdtemp(prefix="autoscale_bench_")
+    try:
+        router = ServingRouter(RouterConfig(
+            store_dir=os.path.join(tmp, "store"),
+            drain_dir=os.path.join(tmp, "drains")))
+        router.register("r0", _make(None, params))
+        ctl = FleetController(
+            router, lambda name, role: _make(role, params),
+            FleetConfig(role="both", min_replicas=1, max_replicas=3,
+                        scale_up_load=1.0, scale_up_after=2,
+                        scale_down_load=0.05, scale_down_after=3,
+                        cooldown_ticks=1))
+        burst = [(rng.integers(0, cfg.vocab_size,
+                               size=(prompts[0],)).astype(np.int32),
+                  max_new)
+                 for _ in range(3 * serving_kw["max_seqs"])]
+        outs = {}
+        peak = 1
+        from deepspeed_tpu.inference.scheduler import AdmissionRejected
+        pending = list(burst)
+        for _ in range(600):
+            while pending:
+                try:
+                    router.add_request(*pending[0])
+                except AdmissionRejected:
+                    break
+                pending.pop(0)
+            for r in router.step():
+                outs[r.rid] = r.output
+            ctl.tick()
+            peak = max(peak, int(router.fleet_stats()["fleet_live"]))
+            if not pending and router.done:
+                break
+        for _ in range(12):            # the lull: load gone, tier drains
+            router.step()
+            ctl.tick()
+        fs = router.fleet_stats()
+        st = router.stats()
+        lost = int(st["lost_requests"]) + (len(burst) - len(outs))
+        if lost or peak < 2 or fs["fleet_live"] != 1:
+            print(f"bench: AUTOSCALE GATE: peak={peak} final="
+                  f"{fs['fleet_live']} lost={lost} (burst must double the "
+                  "tier, the lull must drain it, nothing may be lost)",
+                  file=sys.stderr)
+        out.update({
+            "serve_autoscale_peak_replicas": peak,
+            "serve_autoscale_final_replicas": int(fs["fleet_live"]),
+            "serve_autoscale_scale_ups": int(ctl.stats()["scale_ups"]),
+            "serve_autoscale_lost": lost,
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
     _gc.collect()
     return out
 

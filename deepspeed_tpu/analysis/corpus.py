@@ -766,6 +766,20 @@ def prefix_refcount_leak(devices=None):
     return audit_prefix(correct=False)
 
 
+def handoff_recompute(devices=None):
+    """Disaggregated-serving audit: a prefill tier feeding a decode tier
+    whose handoffs silently fall back to re-prefill
+    (``RouterConfig.handoff_kv`` off) under a steady long-prompt load.
+    Every request still completes, but the decode tier re-pays every
+    stranger's prompt — re-prefill debt outruns the decode budget and
+    decode-tier TTFT grows monotonically. ``ttft-growth`` must fire. The
+    KV twin (same load, same tiers, the bytes actually travel) stays
+    flat and passes — tests assert both directions; the twin is also
+    CLI-runnable (``serving_lint --handoff --kv``)."""
+    from deepspeed_tpu.analysis.serving_lint import audit_handoff
+    return audit_handoff(kv=False)
+
+
 def offload_serial_pipeline(devices=None):
     """Offload pipeline audit: a layer-streamed executor whose overlap
     pipeline was silently disabled — every param fetch resolves
@@ -862,6 +876,7 @@ CORPUS = {
     "serving-unbounded-queue": serving_unbounded_queue,
     "router-blackhole": router_blackhole,
     "prefix-refcount-leak": prefix_refcount_leak,
+    "handoff-recompute": handoff_recompute,
     "offload-serial-pipeline": offload_serial_pipeline,
     "exposed-collective-trace": exposed_collective_trace,
     "serving-blind-stall": serving_blind_stall,

@@ -64,6 +64,23 @@ Both directions are CLI-runnable::
     python -m deepspeed_tpu.analysis.serving_lint --prefix --correct  # twin
 
 and the defect is seeded as the ``prefix-refcount-leak`` corpus entry.
+
+Fifth rule (ISSUE 19): the **silent handoff recompute**. A disaggregated
+fleet hands prefill-done requests to the decode tier; the handoff is
+supposed to ship the KV bytes (one gather + one scatter). A fleet whose
+handoffs silently fall back to re-prefill still LOOKS healthy — every
+request completes — but the decode tier re-pays every stranger's prompt,
+re-prefill debt outruns the decode budget under a long-prompt load, and
+decode-tier TTFT grows monotonically. ``audit_handoff`` replays that load
+through the REAL ``ServingRouter`` handoff sweep over pure-host stub
+tiers and fires a ``ttft-growth`` finding when every handoff fell back
+and the TTFT trajectory grew past the bound. The KV twin ships the bytes
+and passes. Both directions are CLI-runnable::
+
+    python -m deepspeed_tpu.analysis.serving_lint --handoff         # defect
+    python -m deepspeed_tpu.analysis.serving_lint --handoff --kv    # twin
+
+and the defect is seeded as the ``handoff-recompute`` corpus entry.
 """
 
 import argparse
@@ -543,6 +560,287 @@ def audit_adapters(correct: bool = False, **sim_kwargs) -> Report:
     return report
 
 
+# decode-tier TTFT (seconds of simulated time) this deep into a sustained
+# long-prompt load is queue growth from re-prefill debt, not jitter
+TTFT_GROWTH_BOUND = 10.0
+
+
+class _StubPrefillReplica:
+    """Pure-host prefill-tier stand-in (ISSUE 19): admissions queue,
+    each step prefills up to ``service_rate`` prompts into the ready set,
+    and the ROUTER's handoff sweep drains that set through the real
+    ``handoff_ready``/``export_kv``/``release_requests`` protocol.
+    Nothing ever finishes here — a prefill replica's output is handoffs."""
+
+    def __init__(self, name: str, store_dir: str, drain_root: str,
+                 capacity: int = 8, service_rate: int = 4, clock=None):
+        import os
+        from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+        self.name = name
+        self.role = "prefill"
+        self.rdzv = FileRendezvous(store_dir, name, clock=clock)
+        self.drain_dir = os.path.join(drain_root, name)
+        self.capacity = capacity
+        self.service_rate = service_rate
+        self._clock = clock or __import__("time").time
+        self.dead = False
+        self.partitioned = False
+        self.mute_heartbeat = False
+        self.killed_t = None
+        self._q: list = []               # [(rid, plen, max_new, submit_t)]
+        self._ready: dict = {}           # rid -> (plen, max_new, submit_t)
+
+    def meta(self) -> Dict[str, Any]:
+        return {"role": self.role, "queue_depth": len(self._q),
+                "running": len(self._ready), "capacity": self.capacity,
+                "pool_free": 1.0, "draining": False}
+
+    def publish(self) -> None:
+        if self.mute_heartbeat:
+            return
+        self.rdzv.heartbeat(meta=self.meta())
+
+    def try_admit(self, prompt, max_new_tokens: int, rid: int,
+                  **_deadlines) -> int:
+        self._q.append((rid, len(prompt), max_new_tokens, self._clock()))
+        return rid
+
+    def step(self):
+        for rid, plen, max_new, sub in self._q[:self.service_rate]:
+            self._ready[rid] = (plen, max_new, sub)
+        del self._q[:self.service_rate]
+        self.publish()
+        return []
+
+    # -- the handoff protocol the router sweep drives -------------------
+    def handoff_ready(self):
+        return list(self._ready)
+
+    def export_kv(self, request_ids):
+        out = {}
+        for rid in request_ids:
+            if rid in self._ready:
+                plen = self._ready[rid][0]
+                # stand-in payload: rows of KV bytes, one per prompt
+                # token (the real engine ships pool blocks)
+                out[rid] = {"schema": 1, "rows": plen, "blocks": 1,
+                            "geometry": {}, "crc": 0,
+                            "data": {"k": np.zeros(plen, np.uint8)}}
+        return out
+
+    def release_requests(self, request_ids):
+        recs = []
+        for rid in request_ids:
+            plen, max_new, sub = self._ready.pop(rid)
+            recs.append({"rid": rid, "prompt": [0] * plen,
+                         "max_new_tokens": max_new, "generated": [0],
+                         "submit_t": sub})
+        return recs
+
+    def accept_migration(self, recs, rng_counter=None, source=None,
+                         geometry=None, kv=None):
+        now = self._clock()
+        for r in recs:
+            self._q.append((int(r["rid"]), len(r["prompt"]),
+                            int(r["max_new_tokens"]), now))
+        return [int(r["rid"]) for r in recs]
+
+    def new_cancelled(self):
+        return []
+
+    @property
+    def done(self) -> bool:
+        return not self._q and not self._ready
+
+    def inflight(self) -> int:
+        return len(self._q) + len(self._ready)
+
+
+class _StubDecodeReplica:
+    """Pure-host decode-tier stand-in: ``accept_migration`` prices the
+    arriving continuation in work units — ``kv`` bytes cost nothing to
+    resume, a record WITHOUT them re-prefills (prompt-length units) before
+    any decode token comes out — and each step pays ``decode_budget``
+    units head-of-line. The decode-tier TTFT trajectory (first decode
+    token minus arrival) is exactly what the audit gates."""
+
+    def __init__(self, name: str, store_dir: str, drain_root: str,
+                 capacity: int = 8, decode_budget: int = 10, clock=None):
+        import os
+        from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+        self.name = name
+        self.role = "decode"
+        self.rdzv = FileRendezvous(store_dir, name, clock=clock)
+        self.drain_dir = os.path.join(drain_root, name)
+        self.capacity = capacity
+        self.decode_budget = decode_budget
+        self._clock = clock or __import__("time").time
+        self.dead = False
+        self.partitioned = False
+        self.mute_heartbeat = False
+        self.killed_t = None
+        self._q: list = []     # [{rid, prefill_left, decode_left, submit_t}]
+        self.ttfts: list = []  # decode-tier TTFT per request, service order
+        self.completed = 0
+
+    def meta(self) -> Dict[str, Any]:
+        return {"role": self.role, "queue_depth": len(self._q),
+                "running": 0, "capacity": self.capacity,
+                "pool_free": 1.0, "draining": False}
+
+    def publish(self) -> None:
+        if self.mute_heartbeat:
+            return
+        self.rdzv.heartbeat(meta=self.meta())
+
+    def try_admit(self, prompt, max_new_tokens: int, rid: int,
+                  **_deadlines) -> int:
+        # new admissions only reach the decode tier when nothing
+        # prefill-capable is registered; the audit always registers one
+        self._q.append({"rid": rid, "prefill_left": len(prompt),
+                        "decode_left": max_new_tokens,
+                        "submit_t": self._clock()})
+        return rid
+
+    def accept_migration(self, recs, rng_counter=None, source=None,
+                         geometry=None, kv=None):
+        rids = []
+        for r in recs:
+            rid = int(r["rid"])
+            has_kv = bool(kv) and rid in kv
+            self._q.append({
+                "rid": rid,
+                # the whole point of the KV handoff: bytes resume free,
+                # a record alone re-pays the prompt
+                "prefill_left": 0 if has_kv else len(r["prompt"]),
+                "decode_left": int(r["max_new_tokens"]),
+                "submit_t": float(r.get("submit_t") or self._clock())})
+            rids.append(rid)
+        return rids
+
+    def step(self):
+        now = self._clock()
+        budget = self.decode_budget
+        out = []
+        while budget > 0 and self._q:
+            job = self._q[0]
+            pay = min(budget, job["prefill_left"])
+            job["prefill_left"] -= pay
+            budget -= pay
+            if budget <= 0:
+                break
+            if job["decode_left"] > 0 and not job.get("started"):
+                job["started"] = True
+                self.ttfts.append(now - job["submit_t"])
+            pay = min(budget, job["decode_left"])
+            job["decode_left"] -= pay
+            budget -= pay
+            if job["decode_left"] <= 0:
+                self._q.pop(0)
+                self.completed += 1
+                out.append(_StubFinished(rid=job["rid"],
+                                         submit_t=job["submit_t"],
+                                         first_token_t=now))
+        self.publish()
+        return out
+
+    def new_cancelled(self):
+        return []
+
+    @property
+    def done(self) -> bool:
+        return not self._q
+
+    def inflight(self) -> int:
+        return len(self._q)
+
+
+def simulate_handoff(kv: bool, rounds: int = 30,
+                     arrivals_per_round: int = 2, prompt_len: int = 24,
+                     max_new: int = 4, decode_budget: int = 10
+                     ) -> Dict[str, Any]:
+    """Deterministic disaggregated replay through the REAL
+    ``ServingRouter`` handoff sweep: one prefill stub feeds two decode
+    stubs under a steady long-prompt load. ``kv=False`` is the seeded
+    defect — ``RouterConfig.handoff_kv`` off, so every handoff silently
+    falls back to re-prefill and the decode tier re-pays every stranger's
+    prompt: re-prefill debt (``arrivals * (prompt_len + max_new)`` units
+    per round) outruns the decode budget and decode-tier TTFT grows
+    monotonically. The KV twin ships the bytes, pays only decode units,
+    and stays flat. Simulated clock, 1s per round."""
+    import logging as _logging
+    import shutil
+    import tempfile
+    from deepspeed_tpu.inference.router import RouterConfig, ServingRouter
+    from deepspeed_tpu.utils.logging import logger as _logger
+
+    tmp = tempfile.mkdtemp(prefix="handoff_lint_")
+    t = [0.0]
+    prev_level = _logger.level
+    _logger.setLevel(_logging.ERROR)
+    try:
+        cfg = RouterConfig(
+            store_dir=f"{tmp}/store", drain_dir=f"{tmp}/drains",
+            handoff_kv=kv, clock=lambda: t[0])
+        router = ServingRouter(cfg)
+        pre = _StubPrefillReplica("pre0", cfg.store_dir, cfg.drain_dir,
+                                  clock=cfg.clock)
+        decs = [_StubDecodeReplica(f"dec{i}", cfg.store_dir, cfg.drain_dir,
+                                   decode_budget=decode_budget,
+                                   clock=cfg.clock)
+                for i in range(2)]
+        for rep in [pre] + decs:
+            router.register_handle(rep)
+        prompt = np.arange(prompt_len, dtype=np.int32)
+        for _ in range(rounds):
+            for _ in range(arrivals_per_round):
+                router.add_request(prompt, max_new)
+            router.step()
+            t[0] += 1.0
+        ttfts = sorted(x for d in decs for x in d.ttfts)
+        st = router.stats()
+        return {"decode_ttfts": [round(x, 2) for x in ttfts],
+                "rounds": rounds, "kv": kv,
+                "handoffs": int(st["handoffs"]),
+                "handoff_fallbacks": int(st["handoff_fallbacks"]),
+                "completed": int(st["completed"]),
+                "lost": int(st["lost_requests"])}
+    finally:
+        _logger.setLevel(prev_level)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def audit_handoff(kv: bool = False, **sim_kwargs) -> Report:
+    """Run the disaggregated replay and gate it: decode-tier TTFT
+    growing monotonically past ``TTFT_GROWTH_BOUND`` seconds with every
+    handoff a fallback = the ``ttft-growth`` defect (a fleet whose
+    handoffs silently re-prefill)."""
+    sim = simulate_handoff(kv=kv, **sim_kwargs)
+    ttfts = sim["decode_ttfts"]
+    monotone = all(b >= a for a, b in zip(ttfts, ttfts[1:]))
+    report = Report(meta={"analyzer": "serving-handoff", **sim})
+    if monotone and ttfts and ttfts[-1] >= TTFT_GROWTH_BOUND \
+            and sim["handoffs"] > 0 \
+            and sim["handoff_fallbacks"] == sim["handoffs"]:
+        report.extend([Finding(
+            rule="ttft-growth",
+            message=(f"every one of the {sim['handoffs']} prefill->decode "
+                     "handoffs silently fell back to re-prefill: the "
+                     "decode tier re-paid every prompt and its TTFT grew "
+                     f"monotonically to {ttfts[-1]:.1f}s over "
+                     f"{sim['rounds']} rounds of the long-prompt load — "
+                     "enable the KV-byte handoff "
+                     "(RouterConfig.handoff_kv) so a handoff costs one "
+                     "gather/scatter round-trip instead of a "
+                     "prompt-length recompute on the decode replica"),
+            severity="error", program="serving_handoff",
+            ident="handoff-recompute",
+            data={"final_ttft_s": ttfts[-1], "handoffs": sim["handoffs"],
+                  "fallbacks": sim["handoff_fallbacks"],
+                  "completed": sim["completed"]})])
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis.serving_lint",
@@ -572,10 +870,20 @@ def main(argv=None) -> int:
     p.add_argument("--adapters", action="store_true",
                    help="run the LoRA adapter-slot audit instead (churned "
                         "multi-tenant load; pool-growth gate)")
+    p.add_argument("--handoff", action="store_true",
+                   help="run the disaggregated-handoff audit instead "
+                        "(prefill tier feeding a decode tier under a "
+                        "long-prompt load; ttft-growth gate)")
+    p.add_argument("--kv", action="store_true",
+                   help="handoff audit only: ship KV bytes across the "
+                        "handoff (the passing twin; omit = the seeded "
+                        "silent re-prefill defect)")
     p.add_argument("--json", action="store_true",
                    help="print the full report as JSON")
     args = p.parse_args(argv)
-    if args.adapters:
+    if args.handoff:
+        report = audit_handoff(kv=args.kv, rounds=max(args.rounds, 24))
+    elif args.adapters:
         report = audit_adapters(correct=args.correct,
                                 rounds=max(args.rounds, 16))
     elif args.prefix:
@@ -590,6 +898,18 @@ def main(argv=None) -> int:
                                  rounds=args.rounds)
     if args.json:
         print(json.dumps(report.to_dict(), indent=1, default=str))
+    elif args.handoff:
+        sim = report.meta
+        ttfts = sim["decode_ttfts"]
+        print(f"serving_lint: decode-tier TTFT "
+              f"{ttfts[-1] if ttfts else 0:.1f}s after {sim['rounds']} "
+              f"rounds ({sim['handoffs']} handoffs, "
+              f"{sim['handoff_fallbacks']} re-prefill fallbacks, "
+              f"{sim['completed']} completed)")
+        for f in report.findings:
+            print(f"  {f.severity}: {f.rule}: {f.message}")
+        if report.ok:
+            print("serving_lint: OK (KV bytes travel, decode TTFT flat)")
     elif args.adapters:
         sim = report.meta
         pinned = sim["pinned"]

@@ -221,6 +221,18 @@ class FileRendezvous:
         except OSError:
             pass
 
+    def retire(self, host: str):
+        """Remove ANOTHER host's heartbeat from the store. Only for a
+        coordinator holding death evidence (the serving router after a
+        decommission/failover — ISSUE 19): a retired-but-alive host
+        simply re-appears on its next beat, so this can hide a live host
+        for at most one heartbeat interval, never fence one out. Without
+        it, autoscale cycles accumulate dead entries forever."""
+        try:
+            os.remove(self._hb_path(host))
+        except OSError:
+            pass
+
 
 def reform_step(rdzv: FileRendezvous) -> Optional[Dict[str, Any]]:
     """One membership round: heartbeat; if the live set drifted from the

@@ -277,3 +277,13 @@ def pool_bytes(cfg, num_blocks: int, block_size: int, dtype=None) -> int:
     import numpy as _np
     itemsize = _np.dtype(dtype if dtype is not None else cfg.dtype).itemsize
     return rows * hd * itemsize * 2
+
+
+def kv_payload_nbytes(data: Dict[str, "object"]) -> int:
+    """Host bytes of an exported KV payload's per-leaf buffers (the
+    ``data`` dict of a ``ServingEngine.export_kv`` payload: k/v blocks
+    plus int8 scales when present). Shared by the serving engine's
+    staging accounting — in-flight handoff buffers count against
+    ``stats()["pool_bytes"]`` until consumed — and by the disagg tests
+    that pin that accounting."""
+    return sum(int(getattr(a, "nbytes", 0)) for a in data.values())

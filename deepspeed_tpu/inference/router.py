@@ -122,6 +122,13 @@ class RouterConfig:
     # injectable time source shared with every replica's FileRendezvous
     # (tests drive detection deterministically; None = time.time)
     clock: Optional[Callable[[], float]] = None
+    # disaggregated serving (ISSUE 19): when a prefill-role replica
+    # finishes a prompt, ship the KV bytes to the decode replica through
+    # export_kv/accept_migration(kv=) instead of re-prefilling there.
+    # False is the handoff-recompute defect the corpus twin pins: the
+    # hop still works (re-prefill migration) but every handoff makes the
+    # decode tier pay a stranger's prompt again.
+    handoff_kv: bool = True
 
 
 class ReplicaHandle:
@@ -130,13 +137,21 @@ class ReplicaHandle:
     protocol (``name``/``dead``/``partitioned``/``mute_heartbeat``,
     ``publish``/``step``/``try_admit``/``accept_migration``/``kill``/
     ``new_cancelled``/``drain_dir``) — the lint's pure-host stub replica
-    implements the same surface."""
+    implements the same surface. The disaggregated-handoff half
+    (``handoff_ready``/``export_kv``/``release_requests``) is optional:
+    the router's sweep getattr-guards it, so role-less stubs and old
+    handles simply never hand off."""
 
     def __init__(self, name: str, engine, store_dir: str, drain_root: str,
                  clock: Optional[Callable[[], float]] = None,
-                 preemption=None):
+                 preemption=None, role: Optional[str] = None):
         self.name = name
         self.engine = engine
+        # disaggregated serving (ISSUE 19): the tier this replica serves.
+        # Defaults to the engine's own config.role; anything else (old
+        # handles, stub replicas) routes as "both"
+        self.role = str(role or getattr(
+            getattr(engine, "config", None), "role", None) or "both")
         self.rdzv = FileRendezvous(store_dir, name, clock=clock)
         # integrity-chain namespacing: every drain of this replica lives
         # under its own directory AND tag, so two replicas draining into
@@ -172,7 +187,10 @@ class ReplicaHandle:
         replicas are pod-sharded; old no-meta/no-topology heartbeats
         interop (the schema satellite's contract)."""
         sched = self.engine.scheduler
-        d = {"role": "replica",
+        # "role" carries the serving tier (prefill/decode/both). Old
+        # heartbeats said "replica" — readers treat anything that isn't
+        # prefill/decode as "both", so old metas interop unchanged
+        d = {"role": self.role,
              "queue_depth": int(sched.num_waiting),
              "running": int(sched.num_running),
              "capacity": self.capacity,
@@ -237,10 +255,25 @@ class ReplicaHandle:
         return finished
 
     def accept_migration(self, recs, rng_counter=None, source=None,
-                         geometry=None):
+                         geometry=None, kv=None):
         return self.engine.accept_migration(recs, rng_counter=rng_counter,
                                             source=source,
-                                            geometry=geometry)
+                                            geometry=geometry, kv=kv)
+
+    # ---- disaggregated handoff (ISSUE 19) ----------------------------
+
+    def handoff_ready(self) -> List[int]:
+        """Requests a prefill-tier replica is done prefilling: first
+        token committed, everything after it is decode work that belongs
+        on the decode tier. The router's handoff sweep drains these."""
+        return [r.rid for r in self.engine.scheduler.running
+                if r.prefill_done and r.generated]
+
+    def export_kv(self, request_ids):
+        return self.engine.export_kv(request_ids)
+
+    def release_requests(self, request_ids):
+        return self.engine.release_requests(request_ids)
 
     def new_cancelled(self) -> List[Request]:
         cur = self.engine.cancelled
@@ -319,7 +352,9 @@ class ServingRouter:
                           "migrated": 0, "resubmitted": 0, "lost": 0,
                           "failovers": 0, "failover_ms": 0.0,
                           "completed": 0, "cancelled": 0,
-                          "dispatch_faults": 0}
+                          "dispatch_faults": 0,
+                          "handoffs": 0, "handoff_bytes": 0,
+                          "handoff_fallbacks": 0, "handoff_ms": 0.0}
         self._jsonl = None
         if config.telemetry_jsonl:
             from deepspeed_tpu.monitor.monitor import JSONLMonitor
@@ -327,12 +362,15 @@ class ServingRouter:
 
     # ---- registration ------------------------------------------------
 
-    def register(self, name: str, engine, preemption=None) -> ReplicaHandle:
+    def register(self, name: str, engine, preemption=None,
+                 role: Optional[str] = None) -> ReplicaHandle:
         """Wrap a ServingEngine as a replica and add it to the registry
-        (publishes its first heartbeat and the next generation manifest)."""
+        (publishes its first heartbeat and the next generation manifest).
+        ``role`` overrides the engine's own ``config.role`` for routing
+        (prefill / decode / both)."""
         return self.register_handle(ReplicaHandle(
             name, engine, self.config.store_dir, self.config.drain_dir,
-            clock=self.config.clock, preemption=preemption))
+            clock=self.config.clock, preemption=preemption, role=role))
 
     def register_handle(self, handle) -> Any:
         """Register a prebuilt replica handle (the lint's stub replicas
@@ -383,6 +421,17 @@ class ServingRouter:
         return (int(meta.get("queue_depth", 0))
                 + int(meta.get("running", 0))) / max(1, int(cap))
 
+    def _role_of(self, rep) -> str:
+        """The replica's serving tier: the handle's own ``role`` first,
+        its registry heartbeat second. Anything that isn't exactly
+        prefill/decode — including the old "replica" string and missing
+        meta — routes as "both" (the interop contract for old metas)."""
+        role = getattr(rep, "role", None)
+        if role is None:
+            meta = (self._info.get(rep.name) or {}).get("meta") or {}
+            role = meta.get("role")
+        return role if role in ("prefill", "decode") else "both"
+
     def _admission_order(self) -> List[Tuple[Any, bool]]:
         """Healthy replicas, least registry-load first; HALF_OPEN replicas
         rank last and only while no probe request is in flight (the
@@ -419,6 +468,16 @@ class ServingRouter:
             ranked.append((1 if half else 0,
                            self._load_score(name, rep), i, rep, half))
         ranked.sort(key=lambda t: t[:3])
+        # disaggregated routing: NEW requests are prefill work, so
+        # prefill-capable replicas (prefill/both) take them and the
+        # decode tier only sees handoffs. A registry with nothing
+        # prefill-capable falls back to the full ranking — admitting to
+        # a decode replica (which can still serve end-to-end) beats
+        # shedding the request
+        pref = [(rep, half) for _, _, _, rep, half in ranked
+                if self._role_of(rep) != "decode"]
+        if pref:
+            return pref
         return [(rep, half) for _, _, _, rep, half in ranked]
 
     def add_request(self, prompt_ids, max_new_tokens: int = 64,
@@ -534,6 +593,7 @@ class ServingRouter:
         self._round += 1
         if self.config.breaker:
             self._health_sweep()
+        self._handoff_sweep()
         for r in finished:
             self._on_finished(r)
         self._drain_events()
@@ -822,6 +882,142 @@ class ServingRouter:
                        migrated=migrated, lost=lost, ms=round(ms, 2))
         self._publish_generation()
 
+    # ---- disaggregated prefill/decode handoff (ISSUE 19) -------------
+
+    def _decode_targets(self, exclude: str) -> List[Any]:
+        """Live decode-capable replicas (decode/both, not draining, not
+        breaker-blocked), least loaded first — where a finished prefill's
+        KV bytes and continuation go."""
+        out = []
+        for i, (name, rep) in enumerate(self.replicas.items()):
+            if name == exclude or rep.dead:
+                continue
+            if self.config.breaker and self._breaker[name]["state"] in (
+                    BREAKER_OPEN, BREAKER_DEAD):
+                continue
+            if getattr(rep, "partitioned", False):
+                continue
+            meta = (self._info.get(name) or {}).get("meta") or {}
+            if meta.get("draining"):
+                continue
+            if self._role_of(rep) == "prefill":
+                continue
+            out.append((self._load_score(name, rep), i, rep))
+        out.sort(key=lambda t: t[:2])
+        return [rep for *_, rep in out]
+
+    def _handoff_sweep(self) -> None:
+        """Post-round disaggregation pass: every prefill-role replica's
+        prefill-done requests move to the least-loaded decode replica —
+        KV bytes by default (one gather + one scatter), the ordinary
+        re-prefill migration when the payload is refused or the seam
+        faults. With no decode tier registered the work stays put (a
+        prefill-role replica never decodes, so the controller owns
+        fixing that)."""
+        if len(self.replicas) < 2:
+            return
+        if self._info_round != self._round:
+            self._refresh_info()
+        for name, rep in list(self.replicas.items()):
+            if rep.dead or self._role_of(rep) != "prefill":
+                continue
+            ready = getattr(rep, "handoff_ready", None)
+            if ready is None:
+                continue
+            rids = ready()
+            if not rids:
+                continue
+            targets = self._decode_targets(exclude=name)
+            if not targets:
+                continue
+            for rid in rids:
+                self._handoff(rep, rid, targets)
+
+    def _handoff(self, src, rid: int, targets: List[Any]) -> None:
+        """Move one prefill-done request from ``src`` to the first decode
+        target that takes it. The KV payload travels when
+        ``handoff_kv`` is on and survives the fault seam; a typed
+        ``ResumeIncompatible`` refusal (geometry/bits/torn checksum)
+        retries the SAME target through the re-prefill path — the refusal
+        is about the bytes, not the placement. Request traces stitch
+        across the hop via the trace context in the release record."""
+        t0 = time.perf_counter()
+        payload = None
+        if self.config.handoff_kv:
+            payload = src.export_kv([rid]).get(rid)
+        recs = src.release_requests([rid])
+        if not recs:
+            return
+        if payload is not None:
+            try:
+                rb_faults.kv_handoff_seam(payload)
+            except rb_faults.HandoffFault:
+                # injected transfer failure: the bytes never arrive, the
+                # record still does — decode-side re-prefill
+                payload = None
+        geometry = None
+        meta = (self._info.get(src.name) or {}).get("meta") or {}
+        if meta.get("tp") is not None:
+            geometry = {"tp": meta.get("tp"), "ep": meta.get("ep")}
+        placed = None
+        kv_ok = False
+        for target in targets:
+            try:
+                if payload is not None:
+                    try:
+                        target.accept_migration(recs, source=src.name,
+                                                geometry=geometry,
+                                                kv={rid: payload})
+                        kv_ok = True
+                    except ResumeIncompatible:
+                        # payload refused (validated before anything was
+                        # enqueued): same target, ordinary re-prefill
+                        target.accept_migration(recs, source=src.name,
+                                                geometry=geometry)
+                else:
+                    target.accept_migration(recs, source=src.name,
+                                            geometry=geometry)
+            except ResumeIncompatible:
+                continue              # too small / wrong mesh: next
+            placed = target
+            break
+        if placed is None:
+            # released from the prefill tier but no decode replica can
+            # hold it: accounted exactly like a failover loss (the
+            # admission record would resubmit it if src dies; here it is
+            # simply gone from the fleet)
+            self._counters["lost"] += 1
+            self._placement.pop(rid, None)
+            self._records.pop(rid, None)
+            rb_events.emit("request_lost", rid=rid, replica=src.name,
+                           reason="no decode replica can hold it")
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        self._counters["handoffs"] += 1
+        self._counters["handoff_ms"] += ms
+        if kv_ok:
+            from deepspeed_tpu.inference.kv_cache import kv_payload_nbytes
+            self._counters["handoff_bytes"] += kv_payload_nbytes(
+                payload["data"])
+        else:
+            self._counters["handoff_fallbacks"] += 1
+        self._placement[rid] = placed.name
+        rb_events.emit("request_handoff", rid=rid, src=src.name,
+                       dst=placed.name, kv=kv_ok, ms=round(ms, 2))
+
+    def decommission(self, name: str) -> None:
+        """Planned scale-down (the fleet controller's lull path): SIGTERM
+        drain through the replica's integrity chain, fail its in-flight
+        work over to survivors — the in-process kill IS death evidence,
+        so the fencing rule holds — and retire its heartbeat so dead
+        registry entries don't accumulate across scale cycles."""
+        rep = self.replicas[name]
+        if rep.dead:
+            return
+        rep.kill()
+        self._failover(rep)
+        self._registry.retire(name)
+
     # ---- telemetry / introspection -----------------------------------
 
     def _drain_events(self) -> None:
@@ -926,6 +1122,7 @@ class ServingRouter:
         pool_occ = Histogram(FRACTION_EDGES)
         adapter_occ = Histogram(FRACTION_EDGES)
         live = 0
+        roles = {"prefill": 0, "decode": 0, "both": 0}
         totals = {"completed": 0, "cancelled": 0, "generated_tokens": 0,
                   "adapter_page_ins": 0}
         for name, rep in self.replicas.items():
@@ -939,6 +1136,7 @@ class ServingRouter:
                 obs = None               # pre-reset history
             if not rep.dead:
                 live += 1
+                roles[self._role_of(rep)] += 1
                 # gauges are now-facts of the LIVE fleet — a dead
                 # replica's queue depth is not depth anyone waits in
                 qdepth.observe(float(meta.get("queue_depth", 0)))
@@ -957,6 +1155,11 @@ class ServingRouter:
         out: Dict[str, Any] = {
             "fleet_replicas": len(self.replicas),
             "fleet_live": live,
+            # role gauges of the LIVE fleet (ISSUE 19): the autoscaler's
+            # view of the tier it manages
+            "fleet_prefill_replicas": roles["prefill"],
+            "fleet_decode_replicas": roles["decode"],
+            "fleet_both_replicas": roles["both"],
             "fleet_ttft_ms": ttft,
             "fleet_itl_ms": itl,
             "fleet_queue_depth": qdepth,
@@ -993,6 +1196,9 @@ class ServingRouter:
         n_f = int(self._counters["failovers"])
         out["failover_ms"] = float(
             round(self._counters["failover_ms"] / n_f, 2)) if n_f else 0.0
+        n_h = int(self._counters["handoffs"])
+        out["handoff_ms"] = float(
+            round(self._counters["handoff_ms"] / n_h, 2)) if n_h else 0.0
         attempts = self._counters["admitted"] + self._counters["shed"]
         out["spill_rate"] = float(
             round(self._counters["spilled"] / attempts, 4)) if attempts \

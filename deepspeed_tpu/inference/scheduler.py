@@ -124,6 +124,15 @@ class Request:
     # pinned) — engine-owned, mirrored here so _tables_device can build
     # the per-round adapter-index vector without a lookup
     adapter_slot: Optional[int] = None
+    # --- disaggregated serving (ISSUE 19) -----------------------------
+    # KV rows arriving as imported BYTES instead of recompute: set by
+    # accept_migration's kv= fast path after restore(). Admission then
+    # starts cached_rows at kv_rows (like a prefix-cache hit) and skips
+    # prefix matching — the engine scatters the payload into the fresh
+    # blocks before the tail span runs. Cleared on preemption (the
+    # payload is dropped; resume re-prefills — the fallback is always
+    # the recompute path, never stale bytes).
+    kv_rows: int = 0
 
     @property
     def context(self) -> np.ndarray:
@@ -255,6 +264,7 @@ class RequestScheduler:
         req.cow_src = req.cow_dst = None
         req.last_token_t = None
         req.adapter_slot = None
+        req.kv_rows = 0
         self._next_rid = max(self._next_rid, req.rid) + 1
         self.waiting.append(req)
 
@@ -347,6 +357,10 @@ class RequestScheduler:
         req.cached_rows = 0                    # resumes by re-prefilling
         req.prefill_done = False
         req.prefix_rows = 0
+        req.kv_rows = 0                        # imported KV never survives
+        #                                        eviction: re-admission
+        #                                        recomputes (the engine
+        #                                        drops the staged payload)
         self._free_slots.append(req.slot)
         self._release_cow(req)
         self.allocator.free(req.block_ids, owner=req.rid)
@@ -454,7 +468,7 @@ class RequestScheduler:
                        self.max_blocks_per_seq)
             m = (self.prefix_cache.match(ctx_arr)
                  if self.prefix_cache is not None
-                 and not req.adapter_id else None)
+                 and not req.adapter_id and not req.kv_rows else None)
             if m is not None and len(m.blocks) > max(0, need - 1):
                 # never map more shared blocks than the table needs minus
                 # one fresh write target (match caps at ctx-1 rows, so
@@ -493,6 +507,16 @@ class RequestScheduler:
                     req.cow_src = m.partial_block
                     req.cow_dst = fresh[0]
             req.block_ids = shared + fresh
+            if req.kv_rows:
+                # imported KV (accept_migration kv= fast path) covers rows
+                # [0, kv_rows): the engine scatters the payload into these
+                # fresh blocks before the tail span runs, so the prefill
+                # spans start PAST the shipped rows — a handoff costs one
+                # scatter + a tail span, not a prompt-length recompute.
+                # Prefix matching was skipped above: the bytes already
+                # carry the prefix, and a by-reference match would alias
+                # the scatter's write targets.
+                req.cached_rows = req.kv_rows
             req.prefill_done = False
             req.slot = self._free_slots.pop()
             req.state = "running"

@@ -84,7 +84,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from deepspeed_tpu.inference.kv_cache import BlockAllocator, pool_bytes
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator, blocks_for,
+                                              kv_payload_nbytes, pool_bytes)
 from deepspeed_tpu.inference.scheduler import (AdmissionRejected, Request,
                                                RequestScheduler)
 from deepspeed_tpu.robustness import events as rb_events
@@ -185,6 +186,19 @@ def measure_paged_backends(mcfg, k_pool, v_pool, *, max_seqs: int, MB: int,
         return timed("xla"), timed("pallas")
 
 
+def kv_payload_crc(data: Dict[str, Any]) -> int:
+    """Checksum of an exported KV payload's buffers (key-sorted, so the
+    number is layout-stable): a torn/corrupt handoff must be DETECTED at
+    import and fall back to re-prefill — decoding garbage KV would emit
+    wrong tokens silently. crc32 is plenty: this guards torn transport,
+    not adversaries."""
+    import zlib
+    crc = 0
+    for name in sorted(data):
+        crc = zlib.crc32(np.ascontiguousarray(data[name]).tobytes(), crc)
+    return crc
+
+
 @dataclasses.dataclass
 class ServingConfig:
     """Knobs of the serving tier (see README "Serving" for the memory
@@ -266,6 +280,15 @@ class ServingConfig:
     request_trace: bool = False
     trace_replica: str = "r0"          # process row in the merged trace
     trace_events: int = 65536          # tracer ring bound
+    # --- disaggregated serving (ISSUE 19; "both" = colocated behavior) ---
+    # fleet tier this engine serves: a "prefill" engine runs prompt
+    # prefills and emits each request's FIRST token but never a decode
+    # quantum — requests then sit prefill_done until the router hands
+    # them (with their KV bytes) to a "decode"/"both" replica. The role
+    # also rides the replica heartbeat meta so the router's admission
+    # targets prefill-capable replicas first. "both" is the pre-ISSUE-19
+    # colocated engine, and what role-less heartbeats interop as.
+    role: str = "both"                 # prefill | decode | both
 
 
 class ServingEngine:
@@ -321,6 +344,9 @@ class ServingEngine:
             # attention dispatch silently ran XLA
             raise ValueError(f"decode_backend={c.decode_backend!r}: one of "
                              "auto | xla | pallas")
+        if c.role not in ("prefill", "decode", "both"):
+            raise ValueError(f"role={c.role!r}: one of prefill | decode | "
+                             "both (the disaggregated-fleet tier label)")
         model_cap = getattr(mcfg, "max_seq_len", None)
         want = int(c.max_model_len or model_cap or 2048)
         want = -(-want // c.block_size) * c.block_size
@@ -502,6 +528,25 @@ class ServingEngine:
             lambda pools, src, dst: jax.tree.map(
                 lambda a: a.at[:, dst].set(a[:, src]), pools),
             donate_argnums=(0,), out_shardings=self._pool_shardings)
+        # disaggregated KV handoff programs (ISSUE 19): ONE compile each,
+        # the _copy_block_fn idiom widened to a block-id VECTOR padded to
+        # the table width MB. Export gathers a request's blocks (pads
+        # index trash block 0 — discarded on the host slice); import
+        # scatters a padded payload back in (pad writes land in trash
+        # block 0, which is never read). The gather must NOT donate the
+        # pools — the source keeps serving its other requests; a
+        # head-sharded engine's device_get assembles the full logical
+        # array, so payloads are mesh-independent.
+        self._gather_blocks_fn = jax.jit(
+            lambda pools, ids: jax.tree.map(lambda a: a[:, ids], pools))
+        self._scatter_blocks_fn = jax.jit(
+            lambda pools, ids, data: jax.tree.map(
+                lambda a, d: a.at[:, ids].set(d), pools, data),
+            donate_argnums=(0,), out_shardings=self._pool_shardings)
+        # in-flight handoff staging: host bytes of exported payloads not
+        # yet released + imported payloads not yet scattered. Real memory
+        # — stats()["pool_bytes"] prices it alongside the device pool.
+        self._kv_staging: Dict[int, int] = {}
         self._rng_counter = 0
         self._stats_t0: Optional[float] = None
         # latency-frontier counters (reset_stats windows)
@@ -511,7 +556,9 @@ class ServingEngine:
                      "prefill_chunk_tokens": 0, "cow_forks": 0}
         # reliability bookkeeping ---------------------------------------
         self._counters = {"shed": 0, "deadline_misses": 0, "degraded": 0,
-                          "recoveries": 0, "recovery_ms": 0.0}
+                          "recoveries": 0, "recovery_ms": 0.0,
+                          "handoffs": 0, "handoff_bytes": 0,
+                          "handoff_fallbacks": 0}
         # recovery epoch: a watchdog-abandoned round thread re-checks this
         # after its (injected) stall and bails out WITHOUT dispatching —
         # stale work never races the recovered engine
@@ -1250,14 +1297,27 @@ class ServingEngine:
                 for req in decisions["admitted"]:
                     if not self._acquire_adapter(req):
                         self.scheduler.preempt(req)
+                        self._drop_kv_payload(req)
                         rb_events.emit("adapter_slots_exhausted",
                                        rid=req.rid,
                                        adapter=req.adapter_id)
+            for req in decisions["preempted"]:
+                # an eviction consumes an unscattered import payload: the
+                # re-admission recomputes (scheduler.preempt zeroed
+                # kv_rows) — stale bytes never outlive their blocks
+                self._drop_kv_payload(req)
             for req in decisions["admitted"]:
                 if req.cow_src is not None and req.state == "running":
                     # the copy-on-write fork runs BEFORE any of the
                     # request's own dispatches can write the boundary block
                     self._dispatch_fork(req)
+                if req.state == "running" and \
+                        getattr(req, "_kv_payload", None) is not None:
+                    # imported KV bytes scatter into the admission's fresh
+                    # blocks BEFORE the tail prefill span below reads them
+                    with self._rspan(req.rid, "kv_import",
+                                     rows=int(req.kv_rows)):
+                        self._dispatch_kv_import(req)
             ph["housekeeping_ms"] = (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
             for req, start, n in decisions["prefill"]:
@@ -1284,9 +1344,16 @@ class ServingEngine:
 
             t_dec0 = time.perf_counter()
             tables, seq_lens, active, aidx = self._tables_device()
-            spec = (self.config.spec_tokens > 0
+            # a prefill-role engine NEVER runs decode quanta: requests sit
+            # prefill_done until the router hands them (with their KV
+            # bytes) to the decode tier. Their FIRST token still commits
+            # through the pending-firsts fetch below, so TTFT is measured
+            # where the prefill ran.
+            can_decode = self.config.role != "prefill"
+            spec = (self.config.spec_tokens > 0 and can_decode
                     and any(r.prefill_done for r in self.scheduler.running))
-            decode = any(r.prefill_done for r in self.scheduler.running)
+            decode = can_decode and any(r.prefill_done
+                                        for r in self.scheduler.running)
             step_fn = self._get_spec_step() if spec \
                 else (self._get_quantum_step() if decode else None)
             tok_mat = None
@@ -1519,6 +1586,13 @@ class ServingEngine:
             req.adapter_slot = None   # pool rebuilt below; re-pin on resume
             if req.cow_src is not None:     # un-forked admission caught
                 self.scheduler._release_cow(req)   # mid-round by the fault
+            if getattr(req, "_kv_payload", None) is not None \
+                    and req.kv_rows == 0:
+                # preempt_all zeroed kv_rows mid-round before the import
+                # could scatter: the payload is orphaned — drop it, the
+                # re-admission recomputes (host bytes of STILL-waiting
+                # imports keep their kv_rows and survive the pool rebuild)
+                self._drop_kv_payload(req)
         if self._lora:
             self.adapter_slots.reset()
             with self.engine.mesh:
@@ -1573,6 +1647,7 @@ class ServingEngine:
                 continue
             self.scheduler.cancel(req, reason=f"{kind}_deadline")
             self._release_adapter(req)   # no-op for never-pinned waiters
+            self._drop_kv_payload(req, count=False)   # died, not fell back
             if self._tracer is not None:
                 self._tracer.instant(req.rid, "cancelled",
                                      reason=f"{kind}_deadline")
@@ -1608,6 +1683,241 @@ class ServingEngine:
     def cancelled(self) -> List[Request]:
         """Requests shed by deadline enforcement (partial outputs kept)."""
         return list(self._cancelled)
+
+    # ---- disaggregated prefill/decode handoff (ISSUE 19) -------------
+
+    def _kv_geometry(self) -> Dict[str, Any]:
+        """The pool geometry a KV payload must match to be scattered in:
+        logical shapes (mesh-independent — a head-sharded engine's export
+        assembles the full head dim, so tp2->tp2 and tp1->tp1 both ship
+        the same bytes; tp CROSSING is refused by _check_geometry for the
+        continuation-determinism reason, not here)."""
+        k = self.pools["k"]
+        return {"num_layers": int(k.shape[0]),
+                "kv_heads": int(k.shape[2]),
+                "head_dim": int(k.shape[4]),
+                "block_size": int(self.config.block_size),
+                "kv_bits": int(getattr(self.model.config,
+                                       "kv_cache_bits", 0) or 0),
+                "dtype": str(k.dtype)}
+
+    def export_kv(self, request_ids: List[int]
+                  ) -> Dict[int, Dict[str, Any]]:
+        """Serialize requests' pool blocks into dense host payloads — the
+        KV-byte half of a prefill->decode handoff. One gather dispatch +
+        one device_get per request (the `_copy_block_fn` idiom widened to
+        a padded block-id vector), NOT a prompt-length recompute. Each
+        payload carries its geometry (typed refusal at import) and a crc32
+        over the buffers (a torn payload must fall back to re-prefill,
+        never decode garbage). int8 pools ship payload + scales — the
+        payload keys mirror the pool tree. Requests without pool rows
+        (still waiting / nothing cached) are skipped: the caller's
+        fallback is the ordinary re-prefill migration.
+
+        The bytes stage on the host until ``release_requests`` hands the
+        request away (or the payload is consumed) — ``stats()`` prices
+        them in ``pool_bytes``/``kv_staging_bytes``."""
+        import jax
+        import jax.numpy as jnp
+        bs = self.config.block_size
+        out: Dict[int, Dict[str, Any]] = {}
+        for rid in request_ids:
+            req = self._requests.get(rid)
+            if req is None or req.state != "running" \
+                    or req.cached_rows <= 0 or not req.block_ids:
+                continue
+            rows = int(req.cached_rows)
+            n = blocks_for(rows, bs)
+            ids = np.zeros((self.MB,), np.int32)   # pads -> trash block 0
+            ids[:n] = req.block_ids[:n]
+            with self.engine.mesh:
+                gathered = self._gather_blocks_fn(self.pools,
+                                                  jnp.asarray(ids))
+            host = jax.device_get(gathered)
+            data = {name: np.ascontiguousarray(a[:, :n])
+                    for name, a in host.items()}
+            payload = {"schema": 1, "rows": rows, "blocks": n,
+                       "geometry": self._kv_geometry(),
+                       "data": data, "crc": kv_payload_crc(data)}
+            nbytes = kv_payload_nbytes(data)
+            self._kv_staging[rid] = nbytes
+            self._counters["handoffs"] += 1
+            self._counters["handoff_bytes"] += nbytes
+            if self._tracer is not None:
+                self._tracer.instant(rid, "kv_export", bytes=nbytes,
+                                     rows=rows)
+            out[rid] = payload
+        return out
+
+    def _validate_kv_payload(self, req: Request, payload: Dict[str, Any],
+                             source: Optional[str] = None) -> None:
+        """Typed refusal (``ResumeIncompatible``) for any payload this
+        engine cannot scatter bit-faithfully: geometry/bits/dtype
+        mismatch, wrong pool tree, rows outside the pending-token
+        protocol, or a checksum failure (torn payload). The caller falls
+        back to the ordinary re-prefill migration — old drain records
+        (no kv) never reach here."""
+        src = f" (exported by {source})" if source else ""
+
+        def refuse(why: str) -> None:
+            self._counters["handoff_fallbacks"] += 1
+            raise ResumeIncompatible(
+                f"kv payload for request {req.rid}{src}: {why} — "
+                "falling back to the re-prefill migration path keeps the "
+                "continuation correct (just slower)")
+
+        geom, local = payload.get("geometry") or {}, self._kv_geometry()
+        for key, want in local.items():
+            got = geom.get(key)
+            if got is not None and got != want:
+                refuse(f"pool geometry mismatch on {key!r} "
+                       f"(payload {got!r}, this engine {want!r})")
+        if set(payload.get("data") or {}) != set(self.pools):
+            refuse(f"payload tree {sorted(payload.get('data') or {})} != "
+                   f"pool tree {sorted(self.pools)} (kv-bits mismatch "
+                   "ships/omits the scale leaves)")
+        rows, n = int(payload.get("rows", 0)), int(payload.get("blocks", 0))
+        ctx = len(req.context)
+        if not 0 < rows < ctx:
+            # pending-token protocol: the row at cached_rows is computed
+            # by the receiver's tail span, so a full-context payload is
+            # as malformed as an empty one
+            refuse(f"rows={rows} outside (0, {ctx}) for a context of "
+                   f"{ctx} tokens")
+        if n != blocks_for(rows, self.config.block_size) or n > self.MB:
+            refuse(f"blocks={n} does not cover rows={rows} at block_size="
+                   f"{self.config.block_size} (table width {self.MB})")
+        k = payload["data"].get("k")
+        want_shape = (local["num_layers"], n, local["kv_heads"],
+                      local["block_size"], local["head_dim"])
+        if getattr(k, "shape", None) != want_shape:
+            refuse(f"k payload shape {getattr(k, 'shape', None)} != "
+                   f"{want_shape}")
+        if self.model.decode_span_paged is None:
+            refuse("this engine has no span protocol (decode_span_paged) "
+                   "to run the post-import tail span")
+        if kv_payload_crc(payload["data"]) != payload.get("crc"):
+            refuse("checksum failure (torn/corrupt payload)")
+
+    def import_kv(self, request_id: int,
+                  payload: Dict[str, Any]) -> None:
+        """Attach an exported KV payload to a WAITING request on this
+        engine (the receive half of the handoff; ``accept_migration``'s
+        ``kv=`` fast path calls this per record). Validation is typed —
+        ``ResumeIncompatible`` on geometry/bits/checksum mismatch, and
+        the request is left untouched for the re-prefill fallback. The
+        actual scatter happens at admission: blocks come from the normal
+        ``BlockAllocator`` path, the payload scatters into them before
+        the 1-tail-span prefill runs, and the continuation is
+        token-identical to the colocated engine."""
+        req = self._requests.get(request_id)
+        if req is None or req.state != "waiting":
+            raise ResumeIncompatible(
+                f"import_kv: request {request_id} is not waiting on this "
+                "engine (accept_migration enqueues it; the kv= fast path "
+                "does both in one call)")
+        self._validate_kv_payload(req, payload)
+        req._kv_payload = payload
+        req.kv_rows = int(payload["rows"])
+        self._kv_staging[request_id] = kv_payload_nbytes(payload["data"])
+
+    def _dispatch_kv_import(self, req: Request) -> None:
+        """Scatter an imported payload into the request's freshly-admitted
+        blocks (dispatch, no sync — the round's single fetch stays the
+        only host sync). Pads write into trash block 0, which is never
+        read. Runs before the request's tail prefill span, which then
+        computes only rows [kv_rows, ctx)."""
+        import jax.numpy as jnp
+        payload, req._kv_payload = req._kv_payload, None
+        n = int(payload["blocks"])
+        ids = np.zeros((self.MB,), np.int32)
+        ids[:n] = req.block_ids[:n]
+        data = {}
+        for name, arr in payload["data"].items():
+            buf = np.zeros((arr.shape[0], self.MB) + arr.shape[2:],
+                           arr.dtype)
+            buf[:, :n] = arr
+            data[name] = buf
+        with self.engine.mesh:
+            self.pools = self._scatter_blocks_fn(self.pools,
+                                                 jnp.asarray(ids), data)
+        nbytes = self._kv_staging.pop(req.rid, 0)
+        self._counters["handoffs"] += 1
+        self._counters["handoff_bytes"] += nbytes
+
+    def _drop_kv_payload(self, req: Request, count: bool = True) -> None:
+        """Forget an unconsumed import payload (preemption / adapter
+        bounce / recovery / cancel): the request falls back to plain
+        re-prefill — stale bytes must never be scattered into blocks
+        allocated by a LATER admission. ``count=False`` for exits that
+        aren't fallbacks (cancel/release)."""
+        if getattr(req, "_kv_payload", None) is None:
+            return
+        req._kv_payload = None
+        req.kv_rows = 0
+        self._kv_staging.pop(req.rid, None)
+        if count:
+            self._counters["handoff_fallbacks"] += 1
+
+    def release_requests(self, request_ids: List[int]
+                         ) -> List[Dict[str, Any]]:
+        """Extract live requests for a handoff: returns drain-schema
+        records (plus live-only ``submit_t``/``first_token_t`` stamps so
+        TTFT, ITL and deadlines stay honest across the hop — in-process
+        replicas share the clock) and removes the requests from this
+        engine — blocks/slot back to the pool, prefix cache offered the
+        KV first, nothing counted as cancelled. Call ``export_kv`` BEFORE
+        this (the gather reads the pool rows this frees); export staging
+        for these rids is consumed here."""
+        recs: List[Dict[str, Any]] = []
+        for rid in request_ids:
+            req = self._requests.get(rid)
+            if req is None or req.state not in ("running", "waiting"):
+                continue
+            if self._tracer is not None:
+                self._tracer.instant(req.rid, "handoff_out")
+            recs.append({
+                "rid": req.rid,
+                "prompt": np.asarray(req.prompt).tolist(),
+                "generated": list(req.generated),
+                "max_new_tokens": req.max_new_tokens,
+                "preemptions": req.preemptions,
+                "cached_rows": req.cached_rows,
+                "block_ids": list(req.block_ids),
+                "slot": req.slot,
+                "state": req.state,
+                "ttft_deadline_ms": req.ttft_deadline_ms,
+                "deadline_ms": req.deadline_ms,
+                "adapter_id": req.adapter_id,
+                "submit_t": req.submit_t,
+                "first_token_t": req.first_token_t,
+                "last_token_t": req.last_token_t,
+                "trace": (self._tracer.context(req.rid)
+                          if self._tracer is not None else None),
+            })
+            self._drop_kv_payload(req, count=False)  # moving, not falling
+            self._kv_staging.pop(req.rid, None)      # export consumed
+            if req.state == "running":
+                self.scheduler.running.remove(req)
+                self.scheduler._free_slots.append(req.slot)
+                self.scheduler._release_cow(req)
+                self.scheduler._publish(req)
+                if req.block_ids:
+                    self.allocator.free(req.block_ids, owner=req.rid)
+                req.block_ids = []
+                req.slot = None
+            else:
+                try:
+                    self.scheduler.waiting.remove(req)
+                except ValueError:
+                    pass
+            self._release_adapter(req)
+            req._first_dev = None
+            req.state = "migrated"
+            del self._requests[req.rid]
+            if self._tracer is not None:
+                self._tracer.end(req.rid)
+        return recs
 
     def drain(self, save_dir: Optional[str] = None,
               tag: str = "serving_drain",
@@ -1697,7 +2007,8 @@ class ServingEngine:
     def accept_migration(self, recs: List[Dict[str, Any]],
                          rng_counter: Optional[int] = None,
                          source: Optional[str] = None,
-                         geometry: Optional[Dict[str, Any]] = None
+                         geometry: Optional[Dict[str, Any]] = None,
+                         kv: Optional[Dict[int, Dict[str, Any]]] = None
                          ) -> List[int]:
         """Restore drained request records (the ``state.json`` schema) onto
         THIS engine — the remote-drain handoff the router's failover uses
@@ -1715,9 +2026,19 @@ class ServingEngine:
         mismatched local geometry refuses the whole batch with the typed
         ``ResumeIncompatible`` — the failover tries the next survivor
         (see _check_geometry for why a continuation must not cross mesh
-        geometries)."""
+        geometries).
+
+        ``kv`` (ISSUE 19) is the handoff fast path: ``{rid: payload}``
+        from the source's ``export_kv``. Each payload validates against
+        the LOCAL pool geometry/bits and its checksum BEFORE anything is
+        enqueued — a mismatch or torn payload raises the typed
+        ``ResumeIncompatible`` and the caller retries WITHOUT ``kv``
+        (the re-prefill path old drain records already take). Accepted
+        payloads make the handoff cost one scatter + a tail span instead
+        of a prompt-length recompute, token-identically."""
         self._check_geometry(geometry, source)
-        reqs: List[Any] = []       # (Request, drained trace ctx or None)
+        kv = kv or {}
+        reqs: List[Any] = []   # (Request, rec, payload or None)
         for rec in recs:
             aid = int(rec.get("adapter_id", 0))
             if aid and (not self._lora or aid not in self.adapter_store):
@@ -1753,20 +2074,40 @@ class ServingEngine:
                     f"(block-table width {self.MB} x "
                     f"{self.config.block_size}-token blocks) — place it "
                     "on an engine at least as large as the drained one")
-            reqs.append((req, rec.get("trace")))
+            payload = kv.get(req.rid)
+            if payload is not None:
+                # all-or-nothing with the rest of the batch: a bad payload
+                # refuses HERE, before anything is enqueued
+                self._validate_kv_payload(req, payload, source)
+            reqs.append((req, rec, payload))
         if rng_counter is not None:
             self._rng_counter = max(self._rng_counter, int(rng_counter))
         rids: List[int] = []
-        for req, trace_ctx in reqs:
+        for req, rec, payload in reqs:
             self.scheduler.restore(req)
             self._requests[req.rid] = req
+            if payload is not None:
+                req._kv_payload = payload
+                req.kv_rows = int(payload["rows"])
+                self._kv_staging[req.rid] = \
+                    kv_payload_nbytes(payload["data"])
             if self._tracer is not None:
                 # stitch: inherit the drained trace id + spans (v3 record)
                 # so the merged export shows ONE trace across replicas
-                self._tracer.adopt(req.rid, trace_ctx)
+                self._tracer.adopt(req.rid, rec.get("trace"))
                 self._tracer.instant(req.rid, "migrated_in",
-                                     source=source or "")
+                                     source=source or "",
+                                     kv=payload is not None)
             req._trace_wait_t0 = req.submit_t    # restore() re-stamps it
+            # live-handoff stamps (release_requests records only — drain
+            # records never carry them): keep TTFT/ITL/deadlines honest
+            # across the hop instead of restarting the clocks
+            if rec.get("submit_t") is not None:
+                req.submit_t = float(rec["submit_t"])
+            if rec.get("first_token_t") is not None:
+                req.first_token_t = float(rec["first_token_t"])
+            if rec.get("last_token_t") is not None:
+                req.last_token_t = float(rec["last_token_t"])
             rids.append(req.rid)
         if self._stats_t0 is None and rids:
             self._stats_t0 = time.perf_counter()
@@ -1872,7 +2213,9 @@ class ServingEngine:
         self._cancelled = []
         self._stats_t0 = None
         self._counters = {"shed": 0, "deadline_misses": 0, "degraded": 0,
-                          "recoveries": 0, "recovery_ms": 0.0}
+                          "recoveries": 0, "recovery_ms": 0.0,
+                          "handoffs": 0, "handoff_bytes": 0,
+                          "handoff_fallbacks": 0}
         self._itl_ms = []
         self._lat = {"spec_steps": 0, "spec_proposed": 0,
                      "spec_accepted": 0, "prefill_chunks": 0,
@@ -1934,8 +2277,13 @@ class ServingEngine:
             # PER-DEVICE pool shard (what a chip's HBM actually pays — on
             # a tp-sharded engine logical / tp; the logical size rides
             # alongside so the memory law stays checkable)
-            "pool_bytes": float(self.pool_bytes),
+            # in-flight handoff payloads are host memory the engine is
+            # still responsible for — price them alongside the pool so
+            # export staging can't hide from the memory accounting
+            "pool_bytes": float(self.pool_bytes
+                                + sum(self._kv_staging.values())),
             "pool_bytes_logical": float(self.pool_bytes_logical),
+            "kv_staging_bytes": float(sum(self._kv_staging.values())),
             "tp": float(self.tp),
             "ep": float(self.ep),
             "cancelled": float(len(self._cancelled)),

@@ -76,6 +76,18 @@ rounds, independent of the per-engine ``serving_round`` counter):
                     generation reads during the partition exercise the
                     ``FileRendezvous.current_generation`` fallback
 
+Handoff seam (ISSUE 19 — the router consults ``kv_handoff_seam(payload)``
+once per disaggregated KV handoff, AFTER export and BEFORE the decode
+replica imports; ``at`` counts 0-based handoff attempts):
+
+  kv_handoff      — mode "fail" (default) raises HandoffFault: the bytes
+                    never arrive, the record still does — the router falls
+                    back to the ordinary re-prefill migration; mode
+                    "corrupt" flips bytes in the payload in place (a torn
+                    transfer): the importer's crc32 check MUST refuse it
+                    typed and fall back — a corrupted payload must never
+                    decode garbage
+
 Observability of injected faults (ISSUE 18): every kind above already
 emits ``fault_injected`` plus its recovery record; the fleet-observability
 layer adds two read-side event types an injected stall surfaces through —
@@ -108,7 +120,8 @@ _ERRNO_BY_NAME = {"EIO": _errno.EIO, "ENOSPC": _errno.ENOSPC,
 KINDS = ("device_fault", "step_fault", "io_error", "torn_save",
          "corrupt_payload", "preempt", "clock_skew",
          "decode_dispatch", "pool_exhaust", "backend_fault",
-         "replica_kill", "heartbeat_loss", "router_partition")
+         "replica_kill", "heartbeat_loss", "router_partition",
+         "kv_handoff")
 
 ROUTER_KINDS = ("replica_kill", "heartbeat_loss", "router_partition")
 
@@ -121,6 +134,12 @@ class DispatchFault(RuntimeError):
 class BackendFault(RuntimeError):
     """Injected decode-kernel failure: the serving engine degrades to the
     XLA gather backend and retries the round."""
+
+
+class HandoffFault(RuntimeError):
+    """Injected KV-handoff transfer failure (mode "fail"): the payload is
+    lost in flight — the router hands the request off WITHOUT it and the
+    decode replica re-prefills."""
 
 
 class FaultSchedule:
@@ -147,7 +166,11 @@ class FaultSchedule:
                       advance it — see "Serving seams" above)
       mode / hang_s   decode_dispatch: "fail" (default, raises) or "hang"
                       (sleeps hang_s, default 30 — the engine's dispatch
-                      watchdog must time it out)
+                      watchdog must time it out); kv_handoff: "fail"
+                      (default, raises HandoffFault — payload lost in
+                      flight) or "corrupt" (flips payload bytes in place —
+                      the importer's crc32 must refuse it typed); for
+                      kv_handoff `at` counts 0-based handoff attempts
       keep            pool_exhaust: free blocks left visible during the
                       storm (default 0 = total exhaustion)
       replica         router kinds only (required): 0-based registration
@@ -178,7 +201,8 @@ class FaultSchedule:
                                  "'step' (1-based global step) or 'round' "
                                  "(0-based serving round-seam invocation)")
             if kind in ("io_error", "torn_save", "corrupt_payload",
-                        "decode_dispatch", "pool_exhaust", "backend_fault") \
+                        "decode_dispatch", "pool_exhaust", "backend_fault",
+                        "kv_handoff") \
                     + ROUTER_KINDS \
                     and "at" not in e and "rate" not in e:
                 raise ValueError(f"faults.entries[{i}] ({kind}): needs 'at' "
@@ -386,6 +410,31 @@ class FaultInjector:
             actions.append(act)
         return actions
 
+    def kv_handoff(self, payload: Dict[str, Any]) -> None:
+        """Handoff seam (disaggregated serving): called once per KV
+        handoff attempt with the exported payload. "fail" raises
+        HandoffFault (the router falls back to re-prefill); "corrupt"
+        flips bytes in the largest payload buffer IN PLACE — the
+        importing engine's crc32 check must refuse the torn payload
+        typed, never scatter it."""
+        idx = self._count("kv_handoff")
+        for e in self.schedule.entries:
+            if e["kind"] != "kv_handoff" or not self._matches_index(e, idx):
+                continue
+            mode = e.get("mode", "fail")
+            self._fire(e, "kv_handoff", index=idx, mode=mode)
+            if mode == "corrupt":
+                data = payload.get("data") or {}
+                if not data:
+                    continue
+                name = max(data, key=lambda k: data[k].nbytes)
+                flat = data[name].reshape(-1).view(np.uint8)
+                flat[: max(1, flat.size // 16)] ^= 0xFF
+            else:
+                raise HandoffFault(
+                    f"injected kv_handoff failure (handoff {idx}) "
+                    "(robustness.faults)")
+
     @staticmethod
     def _tear_newest_manifest(store_dir: str) -> None:
         """Write a TRUNCATED ``gen_<N+1>.json`` (a torn manifest write that
@@ -501,6 +550,15 @@ def dispatch_seam() -> None:
     """ServingEngine decode-dispatch hook (inside the watchdog guard)."""
     if _ACTIVE is not None:
         _ACTIVE.decode_dispatch()
+
+
+def kv_handoff_seam(payload: Dict[str, Any]) -> None:
+    """ServingRouter KV-handoff hook: a no-op unless an injector is
+    installed. May raise HandoffFault (payload lost in flight) or corrupt
+    the payload in place (torn transfer — the importer's checksum is the
+    last line of defense)."""
+    if _ACTIVE is not None:
+        _ACTIVE.kv_handoff(payload)
 
 
 def router_seam(store_dir: Optional[str] = None) -> List[Dict[str, Any]]:

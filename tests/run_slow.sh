@@ -40,6 +40,9 @@
 #       rollup truth test (2 engine builds + a full routed load) and the
 #       traced 2-replica kill/failover stitch, bit-compared against an
 #       untraced fault-free run
+#   FLEET_BUDGET=420 tests/run_slow.sh disagg  # ISSUE 19: the tp2->tp2
+#       KV-byte handoff parity run and the engine-backed burst/lull
+#       autoscale soak (FleetController scale events, zero lost)
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -125,6 +128,10 @@ for m in "${modules[@]}"; do
         # full routed loads (matched before the *test_serving* glob
         # below)
         *test_fleet_obs*) budget="${OBS_BUDGET:-420}" ;;
+        # ISSUE-19 disaggregated serving: the tp2->tp2 handoff parity
+        # run (3 sharded engine builds) and the burst/lull autoscale
+        # soak over real engines with FleetController scale events
+        *test_disagg*) budget="${FLEET_BUDGET:-420}" ;;
         # ISSUE-9 serving tier: multi-tenant end-to-end runs (engine
         # rebuilds + per-bucket prefill compiles + int8 pool parity over
         # 24 decode steps) own a budget independent of the tier default

@@ -334,6 +334,7 @@ _CORPUS_RULES = {
     "tp-serving-replicated-pool": "replication-over-budget",
     "quantized-weight-replicated": "replication-over-budget",
     "adapter-slot-leak": "pool-growth",
+    "handoff-recompute": "ttft-growth",
     "serving-blind-stall": "serving-phase-stall",
     "tracing-sync-leak": "tracing-sync-leak",
     "staging-buffer-alias": "buffer-alias",

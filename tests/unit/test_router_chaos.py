@@ -24,6 +24,13 @@ seam:
     snapshot onto r0 (``request_migrated`` per request, cross-engine
     re-prefill determinism), and ``serve_lost_requests == 0``.
 
+The disaggregated soak (ISSUE 19) drives a prefill + 2-decode fleet
+through the ``kv_handoff`` seam (a corrupted payload caught by the crc, a
+failed transfer) plus a decode-replica kill: every degraded handoff falls
+back to re-prefill, failed-over work re-parks on the prefill tier and is
+re-handed to the surviving decode replica, and the outputs stay
+bit-identical to the fault-free single-replica run.
+
 Slow tier: three engine builds + a 30+ round routed load. Runs under
 tests/run_slow.sh with its own budget (ROUTER_CHAOS_BUDGET).
 """
@@ -226,3 +233,109 @@ class TestRouterChaosSoak:
         assert {"fault_injected", "replica_degraded", "replica_recovered",
                 "request_migrated", "replica_failover", "request_spilled",
                 "serving_drained"} <= types, types
+
+
+# arrival plan for the disaggregated soak: 2/round for 8 rounds — the
+# late admissions are still decoding when the kill lands at round 12
+N_DISAGG = 16
+DISAGG_FEED = {r: 2 for r in range(8)}
+
+
+class TestDisaggChaosSoak:
+    def test_disagg_soak_kv_faults_and_decode_kill(self, tmp_path):
+        """ISSUE 19: a prefill + 2-decode fleet under the ``kv_handoff``
+        seam (one corrupted payload — caught by the receiver's crc — and
+        one failed transfer) plus a SIGTERM kill of a decode replica
+        mid-soak. The degraded handoffs fall back to re-prefill, the
+        killed replica's work fails over and (if it lands on the prefill
+        tier) is re-handed to the surviving decode replica, and every
+        output stays BIT-IDENTICAL to the fault-free single-replica run.
+        """
+        model = _model()
+        params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+        rng = np.random.default_rng(7)
+        reqs = [(rng.integers(0, 128, size=(int(n),)).astype(np.int32),
+                 int(k))
+                for n, k in zip(rng.integers(5, 40, N_DISAGG),
+                                rng.integers(10, 17, N_DISAGG))]
+
+        # ---- fault-free SINGLE-replica baseline -----------------------
+        base = _serving(model, params, max_seqs=8, num_blocks=72,
+                        max_queue=None).run(list(reqs))
+        assert len(base) == N_DISAGG
+
+        # ---- disaggregated chaos run ----------------------------------
+        rb_events.clear()
+        t = [0.0]
+        router = ServingRouter(RouterConfig(
+            store_dir=str(tmp_path / "store"),
+            drain_dir=str(tmp_path / "drains"),
+            dead_after_s=2.5, clock=lambda: t[0]))
+        router.register("pre0", _serving(model, params, role="prefill"),
+                        role="prefill")
+        router.register("dec0", _serving(model, params, role="decode"),
+                        role="decode")
+        router.register("dec1", _serving(model, params, role="decode"),
+                        role="decode")
+        inj = rb_faults.install(FaultInjector(FaultSchedule([
+            # 0-based handoff-attempt indices: every request hands off
+            # exactly once (plus re-handoffs after the kill), so 1 and 3
+            # land inside the first wave
+            {"kind": "kv_handoff", "at": 1, "mode": "corrupt"},
+            {"kind": "kv_handoff", "at": 3},
+            # registration order: pre0=0 dec0=1 dec1=2 — kill the second
+            # decode replica while the late tail is still decoding on it
+            {"kind": "replica_kill", "at": 12, "replica": 2},
+        ], seed=5)))
+
+        pending = collections.deque(reqs)
+        outs, rounds = {}, 0
+        while pending or not router.done:
+            for _ in range(min(DISAGG_FEED.get(rounds, 0), len(pending))):
+                p, k = pending[0]
+                try:
+                    router.add_request(p, k)
+                except AdmissionRejected:
+                    break            # saturated: retry next round
+                pending.popleft()
+            for r in router.step():
+                outs[r.rid] = r.output
+            t[0] += 1.0
+            rounds += 1
+            assert rounds < 2000, "disagg soak did not converge"
+        rb_faults.clear()
+
+        fired = {f["kind"] for f in inj.fired}
+        assert fired == {"kv_handoff", "replica_kill"}, fired
+        assert sum(f["kind"] == "kv_handoff" for f in inj.fired) == 2
+
+        # ---- the acceptance bar ---------------------------------------
+        st = router.stats()
+        assert st["lost_requests"] == 0.0, st
+        assert st["completed"] == float(N_DISAGG), st
+        # every admitted request crossed the prefill->decode hop once;
+        # failed-over work may re-hand after re-parking on pre0
+        assert st["handoffs"] >= float(N_DISAGG), st
+        assert st["handoff_fallbacks"] == 2.0, st
+        assert st["failovers"] == 1.0 and st["migrated"] >= 1.0, st
+
+        # the two degraded hops are visible as kv=False handoff events;
+        # every other hop shipped KV bytes
+        hops = rb_events.history("request_handoff")
+        assert sum(not e["kv"] for e in hops) == 2, hops
+        assert sum(bool(e["kv"]) for e in hops) >= N_DISAGG - 2, hops
+        assert all(e["src"] in ("pre0",) for e in hops), hops
+
+        # the kill's drain snapshot migrated off dec1, never onto the
+        # dead replica
+        migrated = rb_events.history("request_migrated")
+        assert migrated and all(e["src"] == "dec1" and e["dst"] != "dec1"
+                                for e in migrated), migrated
+
+        # bit-identical to the fault-free single-replica run: the seam
+        # and the kill degrade throughput, never correctness
+        assert set(outs) == set(base)
+        for rid in base:
+            np.testing.assert_array_equal(
+                base[rid], outs[rid],
+                err_msg=f"request {rid} diverged under disagg chaos")
