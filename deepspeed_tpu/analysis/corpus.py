@@ -858,6 +858,33 @@ def allocator_unlocked_share(devices=None):
     return audit_schedules("allocator-unlocked-share", correct=False)
 
 
+def drain_schema_skew(devices=None):
+    """Proto corpus (wire-schema lint, not a compiled program): a v3
+    drain-state writer that persists an UNREGISTERED ``sampler_state``
+    field, read back bare (no ``.get``/membership guard) by a reader
+    that still sees v2 tags on disk — the reader/writer skew a rolling
+    fleet upgrade turns into a crash loop. ``proto_lint`` must flag the
+    writer (``schema-breaking-change``, file:line) and the reader
+    (``reader-writer-skew``). Corrected twin (field registered, read
+    guarded): ``proto_lint --corpus``."""
+    from deepspeed_tpu.analysis.proto_lint import audit_drain_schema_skew
+    return audit_drain_schema_skew(correct=False)
+
+
+def fenceless_failover(devices=None):
+    """Model-check corpus (exhaustive bounded explorer over the REAL
+    ``ServingRouter``, not a compiled program): a router that treats
+    heartbeat silence ALONE as death evidence. The explorer must find an
+    event sequence (probe -> stale -> probe -> probe) where the muted
+    but alive replica completes a request the fenceless sweep already
+    resubmitted — ``double-serve``, with a replayable event-trace id.
+    Corrected twin (the shipped fencing rule: migrate only on
+    in-process death or a committed drain snapshot) holds over the full
+    bounded space: ``modelcheck --corpus``."""
+    from deepspeed_tpu.robustness.modelcheck import audit_events
+    return audit_events("fenceless-failover", correct=False)
+
+
 CORPUS = {
     "undonated-state": undonated_state,
     "extra-collective": extra_collective,
@@ -884,6 +911,8 @@ CORPUS = {
     "serialized-backward": serialized_backward,
     "staging-buffer-alias": staging_buffer_alias,
     "allocator-unlocked-share": allocator_unlocked_share,
+    "drain-schema-skew": drain_schema_skew,
+    "fenceless-failover": fenceless_failover,
 }
 
 

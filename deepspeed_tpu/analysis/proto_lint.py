@@ -1,0 +1,786 @@
+"""graft-proto: wire-schema compatibility lint for the serving fleet.
+
+PRs 11-19 grew a real distributed control plane whose payloads cross
+disk/process boundaries — drain-state tags (v1->v3), heartbeat files,
+generation manifests, KV handoff payloads, fleet/telemetry events — and
+whose cross-version interop was guarded only by hand-written tests. This
+pass makes the wire format a checked artifact: an AST scan extracts
+every serialized payload (dict literals flowing into ``json.dump`` /
+``json.dumps`` sinks, plus ``rb_events.emit`` sites) and checks it
+against the checked-in registry ``analysis/proto_registry.json`` (fields,
+requiredness, version key, checksum discipline per schema).
+
+Rule catalog:
+
+``unversioned-payload``
+    A boundary-crossing payload with no schema/version key: either a
+    dict that matches a registered schema but omits its version key, a
+    dict in a boundary module that matches NO registered schema and
+    carries neither ``version`` nor ``schema``, or a registered event
+    emitted without an explicit ``schema=`` kwarg.
+``schema-breaking-change``
+    A writer drifted from the registry without a version bump: emits an
+    unregistered version value, omits a field the registered version
+    requires, or adds a field the registered version doesn't know.
+    Bumping legally = bump the constant in ``inference/schemas.py`` AND
+    register the new version's field sets (the registry is the gate).
+``reader-writer-skew``
+    A registered reader indexes ``rec["field"]`` bare (no ``.get``, no
+    ``"field" in rec`` guard anywhere in the function) for a field some
+    registered writer version never emits — the crash that hits the
+    moment an old payload meets a new reader.
+``checksum-gap``
+    A bulk-bytes schema (``checksum`` discipline in the registry) none
+    of whose registered readers calls a verification function — torn
+    payloads would be consumed silently.
+
+Every finding carries file:line provenance. ``--write-baseline`` /
+``--baseline`` allowlist known findings exactly like the other
+analyzers; the live tree scans CLEAN (no baseline file is checked in).
+
+Two seeded corpus twins gate the pass itself (``--corpus``, also
+exposed through ``lint --corpus``):
+
+* ``drain-schema-skew`` — a writer grows a required ``sampler_state``
+  field with no version bump and its reader indexes it bare: the defect
+  twin must fire ``schema-breaking-change`` + ``reader-writer-skew``
+  with file:line; the corrected twin (registered fields only, reader
+  defaults via ``.get``) must scan silent.
+* ``fenceless-failover`` lives in ``robustness/modelcheck.py`` (the
+  dynamic face of this ISSUE) and is gated there.
+
+Usage::
+
+    python -m deepspeed_tpu.analysis.proto_lint             # scan package
+    python -m deepspeed_tpu.analysis.proto_lint --corpus    # twin gate
+    python -m deepspeed_tpu.analysis.proto_lint --json
+    python -m deepspeed_tpu.analysis.proto_lint --write-baseline
+"""
+
+import argparse
+import ast
+import copy
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis.report import (Finding, Report, load_baseline,
+                                           save_baseline)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG_ROOT = os.path.dirname(_HERE)
+DEFAULT_REGISTRY = os.path.join(_HERE, "proto_registry.json")
+DEFAULT_BASELINE = os.path.join(_HERE, "proto_baseline.json")
+
+
+def load_registry(path: Optional[str] = None) -> Dict[str, Any]:
+    with open(path or DEFAULT_REGISTRY) as f:
+        return json.load(f)
+
+
+def _schema_constants() -> Dict[str, int]:
+    """Version constants writers reference by name (inference/schemas.py)
+    — the AST pass resolves ``"version": DRAIN_STATE_VERSION`` through
+    this map, so a bump there is seen by the lint without re-parsing."""
+    from deepspeed_tpu.inference import schemas
+    return {n: getattr(schemas, n) for n in dir(schemas)
+            if n.isupper() and isinstance(getattr(schemas, n), int)}
+
+
+# ---------------------------------------------------------------------------
+# per-module extraction
+# ---------------------------------------------------------------------------
+
+class _DictLit:
+    """A dict literal: constant-string keys (+ keys added later via
+    ``var["k"] = ...`` in the same scope), value nodes per key, and
+    whether a ``**spread`` makes the key set dynamic."""
+
+    def __init__(self, node: ast.Dict):
+        self.node = node
+        self.lineno = node.lineno
+        self.keys: Set[str] = set()
+        self.value_nodes: Dict[str, ast.AST] = {}
+        self.augmented: Set[str] = set()
+        self.dynamic = False
+        for k, v in zip(node.keys, node.values):
+            if k is None:                       # {**spread}
+                self.dynamic = True
+            elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+                self.keys.add(k.value)
+                self.value_nodes[k.value] = v
+
+    @property
+    def all_keys(self) -> Set[str]:
+        return self.keys | self.augmented
+
+
+class _ScopeFacts:
+    """Everything the rules need from one function (or module) scope."""
+
+    def __init__(self, qualname: str):
+        self.qualname = qualname
+        self.dict_vars: Dict[str, _DictLit] = {}
+        self.dicts: List[_DictLit] = []          # every dict literal
+        self.sinks: List[Tuple[_DictLit, int]] = []  # json.dump/dumps
+        self.unresolved_sinks: List[int] = []
+        # (event_type, explicit kwargs, has **kwargs, lineno)
+        self.emits: List[Tuple[str, Set[str], bool, int]] = []
+        self.bare_reads: Dict[str, int] = {}     # field -> first lineno
+        self.get_fields: Set[str] = set()
+        self.guard_fields: Set[str] = set()      # "f" in x
+        self.calls: Set[str] = set()
+
+
+def _walk_scope(root: ast.AST):
+    """Nodes of one scope: the root's body minus nested functions."""
+    todo = list(ast.iter_child_nodes(root))
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _collect_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+
+    def rec(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[q] = child
+                rec(child, q)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                rec(child, q)
+            else:
+                rec(child, prefix)
+
+    rec(tree, "")
+    return out
+
+
+def _extract_scope(qualname: str, root: ast.AST) -> _ScopeFacts:
+    facts = _ScopeFacts(qualname)
+    nodes = list(_walk_scope(root))
+    # phase 1: dict-literal assignments (the walk order is not source
+    # order, so bindings must exist before sinks/augments resolve them)
+    for node in nodes:
+        if isinstance(node, ast.AnnAssign):
+            # var: Dict[str, Any] = {...}
+            if (isinstance(node.target, ast.Name)
+                    and isinstance(node.value, ast.Dict)):
+                facts.dict_vars[node.target.id] = _DictLit(node.value)
+        elif isinstance(node, ast.Assign):
+            # var = {...}
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Dict)):
+                facts.dict_vars[node.targets[0].id] = _DictLit(node.value)
+    # phase 2: conditional field adds — var["k"] = ... after the literal
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)):
+                    lit = facts.dict_vars.get(tgt.value.id)
+                    if lit is not None:
+                        lit.augmented.add(tgt.slice.value)
+    # phase 3: sinks, reads, guards, calls
+    for node in nodes:
+        if isinstance(node, ast.Dict):
+            facts.dicts.append(_DictLit(node))
+        elif isinstance(node, ast.Subscript):
+            if (isinstance(node.ctx, ast.Load)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and not node.slice.value.startswith("_")):
+                facts.bare_reads.setdefault(node.slice.value, node.lineno)
+        elif isinstance(node, ast.Compare):
+            # "field" in x  — membership guard counts as a default path
+            if (isinstance(node.left, ast.Constant)
+                    and isinstance(node.left.value, str)
+                    and any(isinstance(op, ast.In) for op in node.ops)):
+                facts.guard_fields.add(node.left.value)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = None
+            if isinstance(fn, ast.Attribute):
+                name = fn.attr
+            elif isinstance(fn, ast.Name):
+                name = fn.id
+            if name:
+                facts.calls.add(name)
+            # x.get("field"[, default])
+            if (isinstance(fn, ast.Attribute) and fn.attr == "get"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                facts.get_fields.add(node.args[0].value)
+            # json.dump(obj, f) / json.dumps(obj)
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("dump", "dumps")
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "json" and node.args):
+                arg = node.args[0]
+                if isinstance(arg, ast.Dict):
+                    facts.sinks.append((_DictLit(arg), node.lineno))
+                elif isinstance(arg, ast.Name):
+                    lit = facts.dict_vars.get(arg.id)
+                    if lit is not None:
+                        facts.sinks.append((lit, node.lineno))
+                    else:
+                        facts.unresolved_sinks.append(node.lineno)
+                else:
+                    facts.unresolved_sinks.append(node.lineno)
+            # rb_events.emit("type", k=v, ...)
+            if (isinstance(fn, ast.Attribute) and fn.attr == "emit"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                kwargs = {kw.arg for kw in node.keywords
+                          if kw.arg is not None}
+                star = any(kw.arg is None for kw in node.keywords)
+                facts.emits.append(
+                    (node.args[0].value, kwargs, star, node.lineno))
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# rule evaluation
+# ---------------------------------------------------------------------------
+
+class _ScanState:
+    def __init__(self, registry: Dict[str, Any],
+                 constants: Optional[Dict[str, int]] = None):
+        self.registry = registry
+        self.constants = (dict(constants) if constants is not None
+                          else _schema_constants())
+        self.findings: List[Finding] = []
+        # schema -> every top-level field any scanned writer emits
+        self.writer_fields: Dict[str, Set[str]] = {}
+        # schema -> [(relpath, facts)] for registered readers seen
+        self.reader_facts: Dict[str, List[Tuple[str, _ScopeFacts]]] = {}
+        self.census = {"modules": 0, "payload_sites": 0,
+                       "matched_payloads": 0, "unmatched_sites": 0,
+                       "emit_sites": 0, "reader_fns": 0}
+
+
+def _match_schema(keys: Set[str], registry: Dict[str, Any],
+                  top_level: bool = True) -> Optional[str]:
+    for name, spec in registry["schemas"].items():
+        if not top_level and spec.get("version_key") is not None:
+            continue
+        if set(spec["match"]) <= keys:
+            return name
+    return None
+
+
+def _resolve_version(node: ast.AST,
+                     constants: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    if isinstance(node, ast.Attribute):
+        return constants.get(node.attr)
+    return None
+
+
+def _current_version(spec: Dict[str, Any]) -> int:
+    return max(int(v) for v in spec["versions"])
+
+
+def _check_fields(st: _ScanState, relpath: str, schema: str,
+                  spec: Dict[str, Any], version: int, lit: _DictLit):
+    """required(v) must be emitted; everything emitted must be known to
+    required(v) + optional(v). Underscore fields are transient."""
+    ver = spec["versions"].get(str(version))
+    if ver is None:
+        st.findings.append(Finding(
+            rule="schema-breaking-change",
+            message=(f"{relpath}:{lit.lineno}: {schema} writer emits "
+                     f"version {version}, which is not registered in "
+                     "proto_registry.json — bump legally by registering "
+                     "the new version's field sets (and a golden fixture)"),
+            program=relpath, ident=f"{schema}:unregistered-version",
+            data={"file": relpath, "line": lit.lineno,
+                  "schema": schema, "version": version}))
+        return
+    emitted = {k for k in lit.all_keys if not k.startswith("_")}
+    st.writer_fields.setdefault(schema, set()).update(emitted)
+    missing = set(ver["required"]) - emitted
+    if missing and not lit.dynamic:
+        st.findings.append(Finding(
+            rule="schema-breaking-change",
+            message=(f"{relpath}:{lit.lineno}: {schema} v{version} writer "
+                     f"omits required field(s) {sorted(missing)} — "
+                     "removing a required field needs a version bump"),
+            program=relpath,
+            ident=f"{schema}:v{version}:missing:"
+                  + ",".join(sorted(missing)),
+            data={"file": relpath, "line": lit.lineno, "schema": schema,
+                  "version": version, "missing": sorted(missing)}))
+    known = set(ver["required"]) | set(ver["optional"])
+    if spec.get("version_key"):
+        known.add(spec["version_key"])
+    extras = emitted - known
+    if extras:
+        st.findings.append(Finding(
+            rule="schema-breaking-change",
+            message=(f"{relpath}:{lit.lineno}: {schema} v{version} writer "
+                     f"adds unregistered field(s) {sorted(extras)} with no "
+                     "version bump — old readers will never see them and "
+                     "new readers can't rely on them; register a new "
+                     "version in proto_registry.json"),
+            program=relpath,
+            ident=f"{schema}:v{version}:extra:" + ",".join(sorted(extras)),
+            data={"file": relpath, "line": lit.lineno, "schema": schema,
+                  "version": version, "extra": sorted(extras)}))
+
+
+def _check_payload(st: _ScanState, relpath: str, lit: _DictLit,
+                   in_boundary: bool, visited: Set[int],
+                   at_sink: bool):
+    registry = st.registry
+    schema = _match_schema(lit.all_keys, registry)
+    if schema is None:
+        if (at_sink and in_boundary
+                and not ({"version", "schema"} & lit.all_keys)):
+            st.census["unmatched_sites"] += 1
+            st.findings.append(Finding(
+                rule="unversioned-payload",
+                message=(f"{relpath}:{lit.lineno}: dict serialized across "
+                         "a boundary matches no registered schema and "
+                         "carries no version/schema key — register it in "
+                         "proto_registry.json or add a version key"),
+                program=relpath, ident=f"unregistered:{lit.lineno}",
+                data={"file": relpath, "line": lit.lineno,
+                      "keys": sorted(lit.all_keys)}))
+        elif at_sink:
+            st.census["unmatched_sites"] += 1
+        _check_nested(st, relpath, lit, visited, parent=None,
+                      parent_version=None)
+        return
+    st.census["matched_payloads"] += 1
+    spec = registry["schemas"][schema]
+    vkey = spec.get("version_key")
+    version = None
+    if vkey is not None:
+        if vkey not in lit.keys:
+            st.findings.append(Finding(
+                rule="unversioned-payload",
+                message=(f"{relpath}:{lit.lineno}: {schema} payload has no "
+                         f"{vkey!r} key — readers cannot version-gate it"),
+                program=relpath, ident=f"{schema}:no-version-key",
+                data={"file": relpath, "line": lit.lineno,
+                      "schema": schema}))
+        else:
+            version = _resolve_version(lit.value_nodes[vkey], st.constants)
+            if version is None:
+                st.findings.append(Finding(
+                    rule="schema-breaking-change",
+                    message=(f"{relpath}:{lit.lineno}: {schema} writer's "
+                             f"{vkey!r} value is not a literal or a "
+                             "schemas.py constant — the lint cannot pin "
+                             "it; use the inference/schemas.py constant"),
+                    program=relpath, ident=f"{schema}:opaque-version",
+                    data={"file": relpath, "line": lit.lineno,
+                          "schema": schema}))
+    if version is None:
+        version = _current_version(spec)
+    _check_fields(st, relpath, schema, spec, version, lit)
+    _check_nested(st, relpath, lit, visited, parent=schema,
+                  parent_version=version)
+
+
+def _check_nested(st: _ScanState, relpath: str, lit: _DictLit,
+                  visited: Set[int], parent: Optional[str],
+                  parent_version: Optional[int]):
+    """Sub-payloads (e.g. drain-request records inside a drain-state
+    ListComp) ride their parent's version."""
+    for node in ast.walk(lit.node):
+        if not isinstance(node, ast.Dict) or node is lit.node:
+            continue
+        sub = _DictLit(node)
+        if id(node) in visited:
+            continue
+        schema = _match_schema(sub.all_keys, st.registry, top_level=False)
+        if schema is None:
+            continue
+        visited.add(id(node))
+        st.census["matched_payloads"] += 1
+        spec = st.registry["schemas"][schema]
+        version = (parent_version
+                   if parent is not None and spec.get("rides") == parent
+                   else _current_version(spec))
+        _check_fields(st, relpath, schema, spec, version, sub)
+
+
+def _check_emits(st: _ScanState, relpath: str, facts: _ScopeFacts):
+    events = st.registry.get("events", {})
+    for etype, kwargs, star, lineno in facts.emits:
+        st.census["emit_sites"] += 1
+        spec = events.get(etype)
+        if spec is None:
+            continue
+        if "schema" not in kwargs:
+            st.findings.append(Finding(
+                rule="unversioned-payload",
+                message=(f"{relpath}:{lineno}: event {etype!r} emitted "
+                         "without an explicit schema= kwarg — downstream "
+                         "consumers (telemetry JSONL, trace analysis) "
+                         "cannot version-gate it"),
+                program=relpath, ident=f"event:{etype}:no-schema",
+                data={"file": relpath, "line": lineno, "event": etype}))
+        missing = set(spec["required"]) - kwargs
+        if missing and not star:
+            st.findings.append(Finding(
+                rule="schema-breaking-change",
+                message=(f"{relpath}:{lineno}: event {etype!r} omits "
+                         f"required field(s) {sorted(missing)}"),
+                program=relpath,
+                ident=f"event:{etype}:missing:" + ",".join(sorted(missing)),
+                data={"file": relpath, "line": lineno, "event": etype,
+                      "missing": sorted(missing)}))
+        extras = {k for k in kwargs if not k.startswith("_")} \
+            - set(spec["required"]) - set(spec["optional"])
+        if extras:
+            st.findings.append(Finding(
+                rule="schema-breaking-change",
+                message=(f"{relpath}:{lineno}: event {etype!r} adds "
+                         f"unregistered field(s) {sorted(extras)} — "
+                         "register them in proto_registry.json"),
+                program=relpath,
+                ident=f"event:{etype}:extra:" + ",".join(sorted(extras)),
+                data={"file": relpath, "line": lineno, "event": etype,
+                      "extra": sorted(extras)}))
+
+
+def _scan_into(st: _ScanState, src: str, relpath: str):
+    tree = ast.parse(src)
+    st.census["modules"] += 1
+    prefixes = st.registry.get("boundary_modules", [])
+    in_boundary = (any(relpath.startswith(p) for p in prefixes)
+                   or not relpath.startswith("deepspeed_tpu/"))
+    scopes = {"<module>": tree}
+    scopes.update(_collect_functions(tree))
+    # which registered readers live in this file?
+    readers_here: Dict[str, List[str]] = {}
+    for schema, spec in st.registry["schemas"].items():
+        for ref in spec.get("readers", ()):
+            path, _, qual = ref.partition("::")
+            if path == relpath:
+                readers_here.setdefault(qual, []).append(schema)
+    for qual, root in scopes.items():
+        facts = _extract_scope(qual, root)
+        visited: Set[int] = set()
+        sunk: Set[int] = set()
+        for lit, lineno in facts.sinks:
+            st.census["payload_sites"] += 1
+            sunk.add(id(lit.node))
+            _check_payload(st, relpath, lit, in_boundary, visited,
+                           at_sink=True)
+        # dict literals never reaching a sink in this scope still get
+        # schema-matched (handoff records and KV payloads are built
+        # here, serialized by their eventual consumer) — version
+        # resolved from the literal, else assumed current
+        for lit in facts.dicts:
+            if id(lit.node) in visited or id(lit.node) in sunk:
+                continue
+            if _match_schema(lit.all_keys, st.registry) is None:
+                continue
+            visited.add(id(lit.node))
+            _check_payload(st, relpath, lit, in_boundary, visited,
+                           at_sink=False)
+        _check_emits(st, relpath, facts)
+        for schema in readers_here.get(qual, ()):
+            st.census["reader_fns"] += 1
+            st.reader_facts.setdefault(schema, []).append((relpath, facts))
+
+
+def _finalize(st: _ScanState) -> Report:
+    registry = st.registry
+    # reader-writer-skew: bare reads of fields not every version emits
+    for schema, spec in registry["schemas"].items():
+        versions = spec["versions"].values()
+        union: Set[str] = set()
+        for v in versions:
+            union |= set(v["required"]) | set(v["optional"])
+        union |= st.writer_fields.get(schema, set())
+        always = None
+        for v in versions:
+            req = set(v["required"])
+            always = req if always is None else (always & req)
+        candidates = union - (always or set())
+        for relpath, facts in st.reader_facts.get(schema, ()):
+            for field in sorted(candidates):
+                line = facts.bare_reads.get(field)
+                if line is None or field in facts.get_fields \
+                        or field in facts.guard_fields:
+                    continue
+                st.findings.append(Finding(
+                    rule="reader-writer-skew",
+                    message=(f"{relpath}:{line}: {facts.qualname} indexes "
+                             f"[{field!r}] bare, but not every registered "
+                             f"{schema} version emits it — an old payload "
+                             "raises KeyError here; default it with "
+                             f".get({field!r})"),
+                    program=relpath,
+                    ident=f"{schema}:{facts.qualname}:{field}",
+                    data={"file": relpath, "line": line, "schema": schema,
+                          "field": field}))
+    # checksum-gap: a checksummed schema none of whose scanned readers
+    # verifies
+    for schema, spec in registry["schemas"].items():
+        chk = spec.get("checksum")
+        readers = st.reader_facts.get(schema, [])
+        if not chk or not readers:
+            continue
+        verify = set(chk.get("verify", ()))
+        if any(facts.calls & verify for _, facts in readers):
+            continue
+        relpath, facts = readers[0]
+        line = (facts.bare_reads or {None: 0}).get(
+            chk.get("bulk_field"), getattr(facts, "lineno", 0)) or 0
+        st.findings.append(Finding(
+            rule="checksum-gap",
+            message=(f"{relpath}: no registered {schema} reader "
+                     f"({', '.join(f.qualname for _, f in readers)}) calls "
+                     f"any of {sorted(verify)} before consuming the "
+                     "payload — a torn bulk payload would be used "
+                     "silently"),
+            program=relpath, ident=f"{schema}:unverified",
+            data={"file": relpath, "line": line, "schema": schema,
+                  "verify": sorted(verify)}))
+    rep = Report(findings=st.findings)
+    rep.meta["proto"] = dict(st.census)
+    return rep
+
+
+def scan_source(src: str, relpath: str,
+                registry: Optional[Dict[str, Any]] = None,
+                constants: Optional[Dict[str, int]] = None) -> Report:
+    """Lint one module's source (fixtures, tests)."""
+    st = _ScanState(registry or load_registry(), constants)
+    _scan_into(st, src, relpath)
+    return _finalize(st)
+
+
+def scan_package(root: Optional[str] = None,
+                 registry: Optional[Dict[str, Any]] = None,
+                 baseline: Optional[Dict[str, Any]] = None) -> Report:
+    """Lint every module under ``root`` (default: the installed
+    deepspeed_tpu package) against the checked-in registry."""
+    root = root or _PKG_ROOT
+    st = _ScanState(registry or load_registry())
+    base = os.path.dirname(os.path.abspath(root))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            relpath = os.path.relpath(path, base).replace(os.sep, "/")
+            try:
+                with open(path) as f:
+                    src = f.read()
+                _scan_into(st, src, relpath)
+            except (OSError, SyntaxError) as e:
+                st.findings.append(Finding(
+                    rule="unscannable-module", severity="warning",
+                    message=f"{relpath}: {e}", program=relpath))
+    rep = _finalize(st)
+    if baseline:
+        rep.apply_baseline(baseline)
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# seeded corpus twins (defect must fire / corrected must hold)
+# ---------------------------------------------------------------------------
+
+_SKEW_RELPATH = "corpus/drain_schema_skew.py"
+
+_SKEW_DEFECT = '''\
+"""Defect twin: the writer grows a required ``sampler_state`` field
+without bumping the drain-state version, and the reader indexes it bare
+— every drain written by the previous release crashes the reader with
+KeyError at restore time (the outage hits during a failover, the worst
+possible moment)."""
+import json
+
+
+def write_drain(path, requests, rng_counter):
+    state = {"version": 3, "source": "r0", "rng_counter": rng_counter,
+             "sampler_state": rng_counter * 7,
+             "requests": [{"rid": rid, "prompt": [1, 2, 3],
+                           "generated": [], "max_new_tokens": 8}
+                          for rid in requests]}
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+
+def read_drain(path):
+    with open(path) as f:
+        state = json.load(f)
+    return state["sampler_state"], state["requests"]
+'''
+
+_SKEW_CORRECT = '''\
+"""Corrected twin: the writer emits only registered drain-state v3
+fields, and the reader defaults the derived sampler cursor with
+``.get`` — old payloads restore cleanly."""
+import json
+
+
+def write_drain(path, requests, rng_counter):
+    state = {"version": 3, "source": "r0", "rng_counter": rng_counter,
+             "requests": [{"rid": rid, "prompt": [1, 2, 3],
+                           "generated": [], "max_new_tokens": 8}
+                          for rid in requests]}
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+
+def read_drain(path):
+    with open(path) as f:
+        state = json.load(f)
+    return state.get("sampler_state", 0), state["requests"]
+'''
+
+
+def _fixture_registry() -> Dict[str, Any]:
+    reg = copy.deepcopy(load_registry())
+    reg["schemas"]["drain-state"]["readers"] = [
+        f"{_SKEW_RELPATH}::read_drain"]
+    # the twins target schema drift, not the integrity chain: the
+    # fixture reader is handed an already-validated payload
+    reg["schemas"]["drain-state"].pop("checksum", None)
+    reg["schemas"]["drain-request"]["readers"] = []
+    reg["schemas"]["kv-payload"]["readers"] = []
+    return reg
+
+
+def audit_drain_schema_skew(correct: bool = False) -> Report:
+    """drain-schema-skew corpus twin (see module docstring)."""
+    src = _SKEW_CORRECT if correct else _SKEW_DEFECT
+    rep = scan_source(src, _SKEW_RELPATH, registry=_fixture_registry())
+    rep.meta["audit"] = {"name": "drain-schema-skew", "correct": correct}
+    return rep
+
+
+#: corpus name -> (audit fn, rules the defect twin must fire)
+_AUDITS = {
+    "drain-schema-skew": (audit_drain_schema_skew,
+                          ("schema-breaking-change", "reader-writer-skew")),
+}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _print_report(rep: Report, as_json: bool):
+    if as_json:
+        print(rep.to_json())
+        return
+    meta = rep.meta.get("proto", {})
+    if meta:
+        print(f"[proto] {meta.get('modules', 0)} module(s), "
+              f"{meta.get('payload_sites', 0)} payload site(s), "
+              f"{meta.get('matched_payloads', 0)} matched, "
+              f"{meta.get('emit_sites', 0)} emit site(s), "
+              f"{meta.get('reader_fns', 0)} reader fn(s)")
+    for f in rep.findings:
+        print(f"{f.severity.upper()} {f.key}: {f.message}")
+    if rep.suppressed:
+        print(f"({len(rep.suppressed)} finding(s) suppressed by baseline)")
+
+
+def _run_corpus_gate(as_json: bool) -> int:
+    """Both twin directions: the defect must FIRE the expected rules,
+    the corrected twin must hold — either miss fails the gate."""
+    rc = 0
+    for name, (fn, rules) in _AUDITS.items():
+        defect = fn(correct=False)
+        fired = {f.rule for f in defect.findings}
+        missing = [r for r in rules if r not in fired]
+        if missing:
+            rc = 1
+            print(f"[proto] {name}: LINT ESCAPE — defect twin did not "
+                  f"fire {missing} (fired: {sorted(fired)})")
+        else:
+            where = ", ".join(
+                f"{f.data.get('file')}:{f.data.get('line')}"
+                for f in defect.findings if f.rule in rules)
+            print(f"[proto] {name}: defect twin FIRES "
+                  f"{sorted(set(rules))} at {where}")
+        corrected = fn(correct=True)
+        if not corrected.ok:
+            rc = 1
+            print(f"[proto] {name}: REGRESSION in corrected twin:")
+            for f in corrected.findings:
+                print(f"  {f.severity.upper()} {f.key}: {f.message}")
+        else:
+            print(f"[proto] {name}: corrected twin holds")
+    print("proto_lint: " + ("OK" if rc == 0 else "FAIL"))
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="proto_lint",
+        description="wire-schema compatibility lint for the serving fleet")
+    p.add_argument("--root", default=_PKG_ROOT,
+                   help="package root to scan (default: deepspeed_tpu)")
+    p.add_argument("--registry", default=None,
+                   help="schema registry path (default: proto_registry.json)")
+    p.add_argument("--corpus", action="store_true",
+                   help="run the seeded defect/corrected twin gate")
+    p.add_argument("--list-corpus", action="store_true")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline path (default: {DEFAULT_BASELINE} "
+                        "when present)")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings into the baseline")
+    args = p.parse_args(argv)
+
+    if args.list_corpus:
+        for name in sorted(_AUDITS):
+            print(name)
+        return 0
+    if args.corpus:
+        return _run_corpus_gate(args.as_json)
+
+    registry = load_registry(args.registry)
+    baseline = None
+    base_path = args.baseline or DEFAULT_BASELINE
+    if not args.no_baseline and not args.write_baseline \
+            and os.path.exists(base_path):
+        baseline = load_baseline(base_path)
+    rep = scan_package(args.root, registry=registry, baseline=baseline)
+    if args.write_baseline:
+        save_baseline(rep, base_path)
+        print(f"baseline written: {base_path} "
+              f"({len(rep.findings)} finding(s) accepted)")
+        return 0
+    _print_report(rep, args.as_json)
+    if not args.as_json:
+        print("proto_lint: " + (
+            "OK" if rep.ok else
+            f"{sum(1 for f in rep.findings if f.severity == 'error')} "
+            "error(s)"))
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

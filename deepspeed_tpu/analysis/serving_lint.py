@@ -261,10 +261,11 @@ class _StubReplica:
         """Supervised kill: drain the in-flight FIFO through the integrity
         chain (state payload -> manifest -> COMMITTED last), then silence."""
         import os
+        from deepspeed_tpu.inference.schemas import DRAIN_STATE_V2
         from deepspeed_tpu.robustness import integrity
         tag_dir = os.path.join(self.drain_dir, f"drain_{self.name}")
         os.makedirs(tag_dir, exist_ok=True)
-        state = {"version": 2, "source": self.name,
+        state = {"version": DRAIN_STATE_V2, "source": self.name,
                  "engine": {"max_model_len": 4096, "block_size": 16,
                             "table_width": 256, "max_seqs": self.capacity},
                  "requests": [{"rid": rid, "prompt": [1, 2, 3],

@@ -35,6 +35,13 @@ from typing import Any, Callable, Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
+# Wire-schema versions for the two payloads this module writes. Defined
+# HERE (not in inference/schemas.py, which re-exports them) because the
+# inference package's __init__ imports the router, which imports this
+# module — a module-level import the other way would be a cycle.
+HEARTBEAT_SCHEMA = 1
+GENERATION_MANIFEST_SCHEMA = 1
+
 
 class FileRendezvous:
     """One participant's view of the membership store."""
@@ -81,7 +88,8 @@ class FileRendezvous:
         interop over one store (pinned by a unit test)."""
         self._beats += 1
         payload: Dict[str, Any] = {"host": self.host, "beats": self._beats,
-                                   "ts": self._clock(), "schema": 1}
+                                   "ts": self._clock(),
+                                   "schema": HEARTBEAT_SCHEMA}
         if meta is not None:
             payload["meta"] = dict(meta)
         tmp = self._hb_path(self.host) + f".tmp.{os.getpid()}"
@@ -175,7 +183,8 @@ class FileRendezvous:
         manifest = {"generation": n, "hosts": hosts,
                     "coordinator": coordinator or (
                         f"{hosts[0]}:{self.port}" if hosts else None),
-                    "ts": self._clock()}
+                    "ts": self._clock(),
+                    "schema": GENERATION_MANIFEST_SCHEMA}
         tmp = self._gen_path(n) + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(manifest, f)

@@ -37,6 +37,7 @@ import dataclasses
 from typing import Any, Callable, Dict, Optional
 
 from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+from deepspeed_tpu.inference.schemas import EVENT_SCHEMA
 from deepspeed_tpu.robustness import events as rb_events
 
 
@@ -205,8 +206,8 @@ class FleetController:
         self._counters["scale_ups"] += 1
         self._cooldown = cfg.cooldown_ticks
         self._hot = 0
-        rb_events.emit("fleet_scale_up", replica=name, role=cfg.role,
-                       tier=self._last_tier + 1, **detail)
+        rb_events.emit("fleet_scale_up", schema=EVENT_SCHEMA, replica=name,
+                       role=cfg.role, tier=self._last_tier + 1, **detail)
         return name
 
     def _scale_down(self, name: str, **detail) -> Optional[str]:
@@ -218,9 +219,9 @@ class FleetController:
         self._counters["scale_downs"] += 1
         self._cooldown = self.config.cooldown_ticks
         self._idle = 0
-        rb_events.emit("fleet_scale_down", replica=name,
-                       role=self.config.role, tier=self._last_tier - 1,
-                       **detail)
+        rb_events.emit("fleet_scale_down", schema=EVENT_SCHEMA,
+                       replica=name, role=self.config.role,
+                       tier=self._last_tier - 1, **detail)
         return name
 
     # ---- introspection -----------------------------------------------

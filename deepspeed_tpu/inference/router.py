@@ -71,6 +71,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+from deepspeed_tpu.inference.schemas import (DRAIN_STATE_VERSION,
+                                             EVENT_SCHEMA)
 from deepspeed_tpu.inference.scheduler import AdmissionRejected, Request
 from deepspeed_tpu.inference.serving import (ResumeIncompatible,
                                              load_drain_state)
@@ -863,7 +865,8 @@ class ServingRouter:
                 # v3: lost records keep their drained trace context too
                 integrity.atomic_write(
                     os.path.join(tag_dir, "state.json"),
-                    json.dumps({"version": 3, "source": rep.name,
+                    json.dumps({"version": DRAIN_STATE_VERSION,
+                                "source": rep.name,
                                 "rng_counter": rng_counter,
                                 "engine": drained_engine,
                                 "failover_residue": True,
@@ -1002,8 +1005,9 @@ class ServingRouter:
         else:
             self._counters["handoff_fallbacks"] += 1
         self._placement[rid] = placed.name
-        rb_events.emit("request_handoff", rid=rid, src=src.name,
-                       dst=placed.name, kv=kv_ok, ms=round(ms, 2))
+        rb_events.emit("request_handoff", schema=EVENT_SCHEMA, rid=rid,
+                       src=src.name, dst=placed.name, kv=kv_ok,
+                       ms=round(ms, 2))
 
     def decommission(self, name: str) -> None:
         """Planned scale-down (the fleet controller's lull path): SIGTERM

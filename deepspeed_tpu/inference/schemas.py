@@ -1,0 +1,74 @@
+"""Wire-schema version constants for the serving fleet (ISSUE 20).
+
+Every serialized payload that crosses a disk or process boundary in the
+serving control plane — drain-state tags, KV handoff payloads, heartbeat
+files, generation manifests, telemetry events — carries a version key so
+readers can version-gate. Before this module those literals were
+scattered (``{"version": 3, ...}`` in serving.py AND router.py,
+``"schema": 1`` in rendezvous.py and the KV exporter), which is exactly
+the drift ``analysis/proto_lint.py`` exists to catch: a writer bumping
+its literal while a twin writer keeps the old one is a silent
+wire-format fork. Writers and readers now import the constant from here,
+and proto_lint's registry (``analysis/proto_registry.json``) pins the
+field sets each version number is allowed to mean.
+
+Bumping a version legally (see README "Protocol compatibility & model
+checking"):
+
+1. bump the constant here (old constants stay — readers still accept
+   every registered version);
+2. add the new version's required/optional field sets to
+   ``analysis/proto_registry.json``;
+3. check in a golden fixture under ``tests/fixtures/proto/`` so the
+   replay matrix pins the old payloads against the CURRENT readers.
+
+Skipping step 2 makes ``proto_lint`` fail with ``schema-breaking-change``
+— the registry is the gate, not convention.
+
+Import-cycle note: ``elasticity.rendezvous`` cannot import from
+``deepspeed_tpu.inference`` (the package ``__init__`` pulls in the
+router, which imports rendezvous), so the heartbeat/manifest constants
+are DEFINED there and re-exported here; everything inference-side is
+defined here.
+"""
+
+from deepspeed_tpu.elasticity.rendezvous import (  # noqa: F401
+    GENERATION_MANIFEST_SCHEMA,
+    HEARTBEAT_SCHEMA,
+)
+
+# ---- drain-state tags (serving.drain / router failover residue) -------
+# v1: requests only (pre-integrity seed format; readers still load it).
+# v2: + rng_counter/source/engine geometry (ISSUE 15 — resume refuses a
+#     geometry mismatch instead of corrupting the KV cache).
+# v3: + per-request trace/adapter/deadline fields (ISSUE 17/18).
+DRAIN_STATE_V1 = 1
+DRAIN_STATE_V2 = 2
+DRAIN_STATE_V3 = 3
+#: what the CURRENT writers emit
+DRAIN_STATE_VERSION = DRAIN_STATE_V3
+#: every version the CURRENT readers accept (golden fixtures replay all)
+DRAIN_STATE_VERSIONS = (DRAIN_STATE_V1, DRAIN_STATE_V2, DRAIN_STATE_V3)
+
+# ---- KV handoff payloads (serving.export_kv / accept_migration) -------
+# Bulk-bytes payload: carries a crc32 over the row bytes; readers must
+# verify before installing rows (proto_lint's checksum-gap rule).
+KV_PAYLOAD_SCHEMA = 1
+
+# ---- telemetry / fleet events (robustness.events.emit) ----------------
+# Events that downstream tooling consumes across a process boundary
+# (telemetry JSONL, trace analysis) carry an explicit schema key; the
+# emit() envelope's "type"/"ts" are transport, not schema.
+EVENT_SCHEMA = 1
+
+__all__ = [
+    "DRAIN_STATE_V1",
+    "DRAIN_STATE_V2",
+    "DRAIN_STATE_V3",
+    "DRAIN_STATE_VERSION",
+    "DRAIN_STATE_VERSIONS",
+    "KV_PAYLOAD_SCHEMA",
+    "EVENT_SCHEMA",
+    "HEARTBEAT_SCHEMA",
+    "GENERATION_MANIFEST_SCHEMA",
+]

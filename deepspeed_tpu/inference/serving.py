@@ -86,6 +86,8 @@ import numpy as np
 
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator, blocks_for,
                                               kv_payload_nbytes, pool_bytes)
+from deepspeed_tpu.inference.schemas import (DRAIN_STATE_VERSION,
+                                             KV_PAYLOAD_SCHEMA)
 from deepspeed_tpu.inference.scheduler import (AdmissionRejected, Request,
                                                RequestScheduler)
 from deepspeed_tpu.robustness import events as rb_events
@@ -1736,7 +1738,7 @@ class ServingEngine:
             host = jax.device_get(gathered)
             data = {name: np.ascontiguousarray(a[:, :n])
                     for name, a in host.items()}
-            payload = {"schema": 1, "rows": rows, "blocks": n,
+            payload = {"schema": KV_PAYLOAD_SCHEMA, "rows": rows, "blocks": n,
                        "geometry": self._kv_geometry(),
                        "data": data, "crc": kv_payload_crc(data)}
             nbytes = kv_payload_nbytes(data)
@@ -1964,7 +1966,7 @@ class ServingEngine:
             # v3 (ISSUE 18): per-request "trace" context (id + spans) so a
             # migrated request's trace stitches across replicas. Readers
             # ignore unknown fields — v2 consumers interop unchanged.
-            "version": 3,
+            "version": DRAIN_STATE_VERSION,
             "rng_counter": self._rng_counter,
             "source": source,
             "engine": {

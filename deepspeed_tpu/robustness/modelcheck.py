@@ -1,0 +1,788 @@
+"""graft-check: exhaustive bounded model checker for the serving
+control plane.
+
+The dynamic face of ISSUE 20 (the static face is
+``analysis/proto_lint.py``): the router's circuit breaker, failover
+fencing, and the fleet controller's cooldown are small state machines
+with a known off-by-one history (PR 19's ``cooldown_ticks=1`` bug) —
+exactly the kind of logic where a hand-picked test sequence passes and
+the interleaving two events to the left loses a request. This module
+drives the REAL ``ServingRouter`` and ``FleetController`` (not models
+of them) with an injectable clock over ALL event interleavings up to a
+bounded depth, and checks six invariants after every event:
+
+``open-admits``
+    A replica whose breaker was OPEN (or DEAD) at admission time never
+    receives a new request (HALF_OPEN probe admissions are legal).
+``double-serve``
+    No request is completed by more than one replica (the
+    migrate-AND-resubmit duplicate a fencing bug produces).
+``unfenced-migration``
+    A failover only happens with death evidence — an in-process kill or
+    a committed drain snapshot. Heartbeat silence alone (a muted store
+    writer, a torn manifest) must never migrate a live replica's work.
+``lost-with-valid-drain``
+    When a valid committed drain exists and a live survivor exists, a
+    failover loses zero requests.
+``fleet-bounds``
+    The controller never scales the tier above ``max_replicas`` or
+    below ``min_replicas``.
+``cooldown-discipline``
+    ``cooldown_ticks=N`` suppresses scale actions for EXACTLY the N
+    ticks after a scale event: an action with fewer than N observe
+    ticks since the last action is the PR-19 off-by-one; a clean,
+    sustained-hot, below-max gap longer than N is a stuck cooldown.
+
+The event alphabet (each event is one atomic world transition):
+
+``probe``      one routing round (``router.step()``: serve, sweep,
+               breaker walk) + one admission attempt; +1s of clock
+``heartbeat``  every live, un-muted replica publishes a heartbeat
+``stale``      the victim replica's heartbeat writer dies (persistent
+               mute — the replica itself keeps serving) and the clock
+               jumps past ``dead_after_s``; survivors re-beat
+``fault``      persistent partition of the victim: dispatch to it
+               raises, its queue stalls
+``kill``       supervised in-process kill of the victim: drain through
+               the integrity chain, then death (evidence: both)
+``drain``      external SIGTERM: the victim drains itself through the
+               integrity chain and exits — the router only ever sees
+               the heartbeat loss and the committed tag
+``torn``       a torn (uncommitted) drain tag appears in the victim's
+               drain dir while it is alive — never death evidence
+``tick``       one fleet-controller observation/action
+
+Violations print as replayable event-trace ids in the graft-race
+style (``e0.1.0.0`` = alphabet indexes): ``--replay`` re-runs exactly
+that sequence with per-event narration.
+
+Corpus twins (gated by ``--corpus``, surfaced through ``lint
+--corpus``):
+
+* ``fenceless-failover`` — a router that migrates on heartbeat silence
+  alone (no death evidence) double-serves within depth 4 of a 4-event
+  alphabet; the real fenced router holds over the full space.
+* ``cooldown-off-by-one`` — the PR-19 pre-fix ``tick()`` (decrement
+  before the gate) acts with zero observe ticks at
+  ``cooldown_ticks=1``; the fixed controller holds.
+* ``control-plane-full`` — correct-only: the shipped router +
+  controller hold all six invariants over the FULL 8-event alphabet at
+  the shipped depth.
+
+Usage::
+
+    python -m deepspeed_tpu.robustness.modelcheck --corpus
+    python -m deepspeed_tpu.robustness.modelcheck --audit control-plane-full
+    python -m deepspeed_tpu.robustness.modelcheck --audit fenceless-failover \\
+        --defect --replay e0.1.0.0
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.analysis.report import Finding, Report
+
+FULL_ALPHABET = ("probe", "heartbeat", "stale", "fault", "kill", "drain",
+                 "torn", "tick")
+#: the fencing-focused sub-alphabet (no controller in those harnesses)
+FENCE_ALPHABET = ("probe", "stale", "heartbeat", "fault")
+#: shipped exhaustive depth for the full alphabet (8^1..8^3 = 584 runs)
+FULL_DEPTH = 3
+
+
+class _Finished:
+    """Just enough of a finished Request for ``router._on_finished``."""
+
+    def __init__(self, rid: int):
+        self.rid = rid
+        self.first_token_t = None
+        self.submit_t = None
+
+
+class _Replica:
+    """Pure-host stub replica implementing the ReplicaHandle protocol
+    (see ``router.ReplicaHandle``) with a ground-truth life flag the
+    router cannot touch: ``_failover`` writes ``rep.dead``, but only
+    ``kill()``/``die_external()`` — actual deaths — clear
+    ``_gt_alive``. The gap between the two is what the fencing
+    invariants measure."""
+
+    def __init__(self, name: str, store_dir: str, drain_root: str,
+                 clock: Callable[[], float],
+                 completions: Dict[int, List[str]],
+                 capacity: int = 8, service_rate: int = 1,
+                 hot: bool = False):
+        import time
+        from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+        self.name = name
+        self.role = "both"
+        self.rdzv = FileRendezvous(store_dir, name, clock=clock)
+        self.drain_dir = os.path.join(drain_root, name)
+        self.dead = False              # router-written
+        self.partitioned = False       # router-reset each round
+        self.mute_heartbeat = False    # router-reset each round
+        self.killed_t: Optional[float] = None
+        self.capacity = capacity
+        self.service_rate = service_rate
+        self.hot = hot                 # report saturated meta (tier heat)
+        self._time = time
+        self._q: List[int] = []
+        self._part = False             # persistent partition (fault event)
+        self._muted = False            # persistent heartbeat outage
+        self._exited = False           # drained + exited (drain event)
+        self._gt_alive = True          # ground truth, router-invisible
+        self._completions = completions
+
+    # -- registry ------------------------------------------------------
+
+    def meta(self) -> Dict[str, Any]:
+        depth = self.capacity if self.hot else len(self._q)
+        return {"role": self.role, "queue_depth": depth, "running": 0,
+                "capacity": self.capacity, "pool_free": 1.0,
+                "draining": False}
+
+    def publish(self) -> None:
+        if self.dead or self._exited or self._muted \
+                or self.mute_heartbeat or not self._gt_alive:
+            return
+        self.rdzv.heartbeat(meta=self.meta())
+
+    # -- dispatch ------------------------------------------------------
+
+    def try_admit(self, prompt, max_new_tokens: int, rid: int,
+                  ttft_deadline_ms=None, deadline_ms=None) -> int:
+        from deepspeed_tpu.inference.router import (ReplicaDead,
+                                                    ReplicaUnreachable)
+        from deepspeed_tpu.inference.scheduler import AdmissionRejected
+        if self.dead or not self._gt_alive:
+            raise ReplicaDead(self.name)
+        if self._part or self._exited:
+            raise ReplicaUnreachable(f"{self.name} unreachable")
+        if len(self._q) >= self.capacity:
+            raise AdmissionRejected("queue_full", replica=self.name)
+        self._q.append(rid)
+        return rid
+
+    def step(self) -> List[_Finished]:
+        """The replica's own serve loop. A muted replica (heartbeat
+        outage) still serves — that gap is the fenceless-failover
+        counterexample. A partitioned/exited one does not."""
+        from deepspeed_tpu.inference.router import (ReplicaDead,
+                                                    ReplicaUnreachable)
+        if self.dead or not self._gt_alive and not self._exited:
+            raise ReplicaDead(self.name)
+        if self._exited:
+            raise ReplicaUnreachable(f"{self.name} exited")
+        if self._part:
+            raise ReplicaUnreachable(f"{self.name} partitioned")
+        done = []
+        for rid in self._q[:self.service_rate]:
+            self._completions.setdefault(rid, []).append(self.name)
+            done.append(_Finished(rid))
+        self._q = self._q[self.service_rate:]
+        try:
+            self.publish()
+        except OSError:
+            pass
+        return done
+
+    def accept_migration(self, recs, rng_counter=None, source=None,
+                         geometry=None, kv=None) -> List[int]:
+        rids = [int(rec["rid"]) for rec in recs]
+        self._q.extend(rids)
+        return rids
+
+    def new_cancelled(self):
+        return []
+
+    def inflight(self) -> int:
+        return len(self._q)
+
+    @property
+    def done(self) -> bool:
+        return not self._q
+
+    # -- deaths --------------------------------------------------------
+
+    def _write_drain(self, commit: bool = True) -> str:
+        from deepspeed_tpu.inference.schemas import DRAIN_STATE_V2
+        from deepspeed_tpu.robustness import integrity
+        tag_dir = os.path.join(self.drain_dir, f"drain_{self.name}")
+        os.makedirs(tag_dir, exist_ok=True)
+        integrity.invalidate(tag_dir)
+        state = {"version": DRAIN_STATE_V2, "source": self.name,
+                 "engine": {"max_model_len": 4096, "block_size": 16,
+                            "table_width": 256,
+                            "max_seqs": self.capacity},
+                 "requests": [{"rid": rid, "prompt": [1, 2, 3],
+                               "max_new_tokens": 8, "generated": []}
+                              for rid in self._q]}
+        integrity.atomic_write(os.path.join(tag_dir, "state.json"),
+                               json.dumps(state, indent=1),
+                               what="modelcheck stub drain write")
+        if commit:
+            integrity.write_manifest(tag_dir)
+            integrity.write_commit_marker(tag_dir)
+        return tag_dir
+
+    def kill(self) -> Optional[str]:
+        """Supervised in-process kill: drain, then die (the router holds
+        both kinds of evidence)."""
+        if self.dead or not self._gt_alive:
+            return None
+        self.killed_t = self._time.perf_counter()
+        path = self._write_drain(commit=True)
+        self._q = []
+        self._gt_alive = False
+        self.dead = True
+        return path
+
+    def die_external(self) -> str:
+        """External SIGTERM: drain + exit. The router's ``rep.dead``
+        stays False — it only ever learns from the heartbeat loss and
+        the committed tag (the per-process deployment)."""
+        path = self._write_drain(commit=True)
+        self._q = []
+        self._gt_alive = False
+        self._exited = True
+        return path
+
+    def write_torn(self) -> str:
+        """A torn drain tag (crashed mid-drain rewrite elsewhere, or a
+        partial copy): state without manifest/commit marker. NEVER
+        death evidence — the replica is still alive."""
+        return self._write_drain(commit=False)
+
+
+class _FencelessRouter:
+    """Factory for the seeded defect twin: a router whose health sweep
+    treats heartbeat silence alone as death evidence (the exact bug the
+    fencing rule exists to prevent). Built lazily so importing this
+    module stays light."""
+
+    def __new__(cls, config, name: str = "router"):
+        from deepspeed_tpu.inference.router import (BREAKER_DEAD,
+                                                    ServingRouter)
+
+        class _Fenceless(ServingRouter):
+            def _health_sweep(self):
+                self._refresh_info()
+                for rname, rep in list(self.replicas.items()):
+                    if self._breaker[rname]["state"] == BREAKER_DEAD:
+                        continue
+                    if self._heartbeat_age(rname) > self.config.dead_after_s:
+                        # DEFECT: no rep.dead / snapshot evidence check
+                        self._failover(rep, tag=self._drain_snapshot(rep))
+
+        return _Fenceless(config, name)
+
+
+def _prefix_controller(router, spawn, config):
+    """The PR-19 pre-fix ``FleetController.tick()``: the cooldown
+    decrement happens BEFORE the gate is computed, so
+    ``cooldown_ticks=1`` suppresses zero ticks (the seeded defect the
+    cooldown-discipline invariant must find)."""
+    from deepspeed_tpu.inference.fleet import FleetController
+
+    class _PreFix(FleetController):
+        def tick(self):
+            cfg = self.config
+            self._counters["ticks"] += 1
+            if self._cooldown > 0:
+                self._cooldown -= 1          # off-by-one: decrement first
+            cooling = self._cooldown > 0
+            tier = self._tier()
+            self._last_tier = len(tier)
+            if not tier:
+                self._last_load = 0.0
+                self._hot = self._idle = 0
+                if cfg.min_replicas > 0 and not cooling:
+                    return self._scale_up(reason="below_min")
+                return None
+            load = sum(self._load(m) for m in tier.values()) / len(tier)
+            self._last_load = load
+            if load >= cfg.scale_up_load:
+                self._hot += 1
+                self._idle = 0
+            elif load <= cfg.scale_down_load:
+                self._idle += 1
+                self._hot = 0
+            else:
+                self._hot = self._idle = 0
+            if cooling:
+                return None
+            if len(tier) < cfg.min_replicas:
+                return self._scale_up(reason="below_min")
+            if self._hot >= cfg.scale_up_after \
+                    and len(tier) < cfg.max_replicas:
+                return self._scale_up(reason="sustained_pressure",
+                                      load=load)
+            if self._idle >= cfg.scale_down_after \
+                    and len(tier) > cfg.min_replicas:
+                victim = min(tier, key=lambda h: self._load(tier[h]))
+                return self._scale_down(victim, load=load)
+            return None
+
+    return _PreFix(router, spawn, config)
+
+
+class Harness:
+    """One world the explorer drives: a real router (+ optional
+    controller) over stub replicas with an injected clock, checking the
+    six invariants after every event. Events target the victim ``r0``;
+    ``r1`` (and any autoscaled replica) survives."""
+
+    def __init__(self, base_dir: str,
+                 fenced: bool = True,
+                 controller: bool = False,
+                 prefix_cooldown: bool = False,
+                 cooldown_ticks: int = 2,
+                 hot: bool = False,
+                 dead_after_s: float = 2.5,
+                 min_replicas: int = 1,
+                 max_replicas: int = 4):
+        from deepspeed_tpu.inference.fleet import (FleetConfig,
+                                                   FleetController)
+        from deepspeed_tpu.inference.router import (RouterConfig,
+                                                    ServingRouter)
+        from deepspeed_tpu.robustness import events as rb_events
+        self._rb = rb_events
+        rb_events.clear()
+        self.base = base_dir
+        store = os.path.join(base_dir, "store")
+        drains = os.path.join(base_dir, "drains")
+        self.t = [0.0]
+        clock = lambda: self.t[0]  # noqa: E731 — injectable model time
+        self.completions: Dict[int, List[str]] = {}
+        cfg = RouterConfig(store_dir=store, drain_dir=drains,
+                           dead_after_s=dead_after_s, breaker=True,
+                           breaker_faults=2, breaker_probe_after=1,
+                           clock=clock)
+        router_cls = ServingRouter if fenced else _FencelessRouter
+        self.router = router_cls(cfg)
+        self._mk = lambda name: _Replica(name, store, drains, clock,
+                                         self.completions, hot=hot)
+        self.victim = self._mk("r0")
+        self.router.register_handle(self.victim)
+        self.router.register_handle(self._mk("r1"))
+        self.ctl = None
+        self.fleet_cfg = None
+        if controller:
+            self.fleet_cfg = FleetConfig(
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                scale_up_after=1, cooldown_ticks=cooldown_ticks,
+                role="both", dead_after_s=dead_after_s)
+            spawn = lambda name, role: self._mk(name)  # noqa: E731
+            maker = (_prefix_controller if prefix_cooldown
+                     else FleetController)
+            self.ctl = maker(self.router, spawn, self.fleet_cfg)
+        self.hot = hot
+        self.violations: List[str] = []
+        self.trace: List[str] = []
+        self._reported: set = set()
+        self._failover_seen = 0
+        self._scale_seen = {"fleet_scale_up": 0, "fleet_scale_down": 0}
+        # cooldown bookkeeping: observe ticks since the last action
+        # (None until the first action) + whether the gap is "clean"
+        # (tick-only, so the exactness half of the invariant applies)
+        self._since_action: Optional[int] = None
+        self._gap_clean = True
+
+    # -- events --------------------------------------------------------
+
+    def apply(self, event: str) -> None:
+        self.trace.append(event)
+        if event != "tick":
+            self._gap_clean = False
+        getattr(self, f"_ev_{event}")()
+        self._check()
+
+    def _ev_probe(self) -> None:
+        from deepspeed_tpu.inference.router import (BREAKER_DEAD,
+                                                    BREAKER_OPEN)
+        from deepspeed_tpu.inference.scheduler import AdmissionRejected
+        self.t[0] += 1.0
+        self.router.step()
+        blocked = {n for n in self.router.replicas
+                   if self.router.breaker_state(n)
+                   in (BREAKER_OPEN, BREAKER_DEAD)}
+        try:
+            rid = self.router.add_request([1, 2, 3], max_new_tokens=4)
+        except AdmissionRejected:
+            return
+        placed = self.router._placement.get(rid)
+        if placed in blocked:
+            self.violations.append(
+                f"open-admits: request {rid} admitted to replica "
+                f"{placed} whose breaker was "
+                f"{self.router.breaker_state(placed)}")
+
+    def _ev_heartbeat(self) -> None:
+        for rep in self.router.replicas.values():
+            rep.publish()
+
+    def _ev_stale(self) -> None:
+        # the victim's heartbeat writer dies (replica keeps serving);
+        # survivors re-beat across the staleness jump
+        self.victim._muted = True
+        self.t[0] += self.router.config.dead_after_s + 0.1
+        for rep in self.router.replicas.values():
+            rep.publish()
+
+    def _ev_fault(self) -> None:
+        self.victim._part = True
+
+    def _ev_kill(self) -> None:
+        self.victim.kill()
+
+    def _ev_drain(self) -> None:
+        if self.victim._gt_alive:
+            self.victim.die_external()
+
+    def _ev_torn(self) -> None:
+        if self.victim._gt_alive:
+            self.victim.write_torn()
+
+    def _ev_tick(self) -> None:
+        if self.ctl is None:
+            return
+        acted = self.ctl.tick() is not None
+        cfg = self.fleet_cfg
+        if acted:
+            if self._since_action is not None \
+                    and self._since_action < cfg.cooldown_ticks:
+                self.violations.append(
+                    "cooldown-discipline: scale action after only "
+                    f"{self._since_action} observe tick(s) — "
+                    f"cooldown_ticks={cfg.cooldown_ticks} must suppress "
+                    f"exactly {cfg.cooldown_ticks}")
+            self._since_action = 0
+            self._gap_clean = True
+        elif self._since_action is not None:
+            self._since_action += 1
+            if (self._gap_clean and self.hot
+                    and self._since_action > cfg.cooldown_ticks
+                    and self.ctl._last_tier < cfg.max_replicas
+                    and self.ctl._last_load >= cfg.scale_up_load):
+                self.violations.append(
+                    "cooldown-discipline: sustained pressure below "
+                    f"max_replicas but no action "
+                    f"{self._since_action} tick(s) after the cooldown "
+                    f"(cooldown_ticks={cfg.cooldown_ticks}) — stuck")
+
+    # -- invariants ----------------------------------------------------
+
+    def _check(self) -> None:
+        for rid, servers in self.completions.items():
+            if len(servers) > 1 and ("ds", rid) not in self._reported:
+                self._reported.add(("ds", rid))
+                self.violations.append(
+                    f"double-serve: request {rid} completed by "
+                    f"{servers} — served more than once")
+        failovers = self._rb.history("replica_failover")
+        for ev in failovers[self._failover_seen:]:
+            name = ev.get("replica")
+            rep = self.router.replicas.get(name)
+            if rep is not None and getattr(rep, "_gt_alive", False):
+                self.violations.append(
+                    f"unfenced-migration: replica {name} failed over "
+                    "while alive (no death evidence — heartbeat silence "
+                    "or a torn tag is not evidence)")
+            survivors = any(
+                getattr(r, "_gt_alive", False) and n != name
+                for n, r in self.router.replicas.items())
+            if ev.get("lost", 0) > 0 and survivors and rep is not None \
+                    and not getattr(rep, "_gt_alive", True) \
+                    and ev.get("drain_tag"):
+                self.violations.append(
+                    f"lost-with-valid-drain: failover of {name} lost "
+                    f"{ev['lost']} request(s) despite a valid drain "
+                    f"({ev['drain_tag']}) and a live survivor")
+        self._failover_seen = len(failovers)
+        if self.fleet_cfg is not None:
+            ups = self._rb.history("fleet_scale_up")
+            for ev in ups[self._scale_seen["fleet_scale_up"]:]:
+                if ev.get("tier", 0) > self.fleet_cfg.max_replicas:
+                    self.violations.append(
+                        f"fleet-bounds: scale_up to tier {ev['tier']} > "
+                        f"max_replicas={self.fleet_cfg.max_replicas}")
+            self._scale_seen["fleet_scale_up"] = len(ups)
+            downs = self._rb.history("fleet_scale_down")
+            for ev in downs[self._scale_seen["fleet_scale_down"]:]:
+                if ev.get("tier", 0) < self.fleet_cfg.min_replicas:
+                    self.violations.append(
+                        f"fleet-bounds: scale_down to tier {ev['tier']} "
+                        f"< min_replicas={self.fleet_cfg.min_replicas}")
+            self._scale_seen["fleet_scale_down"] = len(downs)
+
+    def close(self) -> None:
+        self._rb.clear()
+
+
+# ---------------------------------------------------------------------------
+# the explorer
+# ---------------------------------------------------------------------------
+
+def trace_id(idxs: Sequence[int]) -> str:
+    return "e" + ".".join(str(i) for i in idxs)
+
+
+def parse_trace(tid: str) -> List[int]:
+    if not tid.startswith("e"):
+        raise ValueError(f"trace id {tid!r}: expected e<i>.<i>...")
+    return [int(x) for x in tid[1:].split(".")]
+
+
+def run_sequence(factory: Callable[[str], Harness],
+                 alphabet: Sequence[str],
+                 idxs: Sequence[int], base_dir: str,
+                 narrate: bool = False) -> List[str]:
+    """Run one event sequence on a fresh world to completion; returns
+    every invariant violation observed along it (a fencing bug fires
+    unfenced-migration one event before the duplicate completion lands,
+    so a sequence can carry several)."""
+    h = factory(base_dir)
+    try:
+        for step, i in enumerate(idxs):
+            before = len(h.violations)
+            h.apply(alphabet[i])
+            if narrate:
+                load = {n: r.inflight()
+                        for n, r in h.router.replicas.items()}
+                print(f"  [{step}] {alphabet[i]:<10} inflight={load}")
+                for v in h.violations[before:]:
+                    print(f"        -> {v}")
+        return list(h.violations)
+    finally:
+        h.close()
+
+
+def explore(factory: Callable[[str], Harness],
+            alphabet: Sequence[str], depth: int,
+            until_rule: Optional[str] = None) -> Dict[str, Any]:
+    """Exhaustively run every event sequence of length 1..depth. Each
+    sequence gets a fresh world (fresh store/drain dirs) — replay is
+    exact by construction, so every failure is a replayable trace id.
+    With ``until_rule`` (defect-twin mode) exploration stops at the
+    first sequence whose violations include that rule; without it the
+    whole space runs and every failure is collected."""
+    import logging as _logging
+    import shutil
+    import tempfile
+    from deepspeed_tpu.utils.logging import logger
+    explored = 0
+    failures: List[Dict[str, Any]] = []
+    root = tempfile.mkdtemp(prefix="modelcheck_")
+    prev = logger.level
+    logger.setLevel(_logging.ERROR)
+    try:
+        for length in range(1, depth + 1):
+            for idxs in itertools.product(range(len(alphabet)),
+                                          repeat=length):
+                base = os.path.join(root, f"w{explored}")
+                violations = run_sequence(factory, alphabet, idxs, base)
+                explored += 1
+                shutil.rmtree(base, ignore_errors=True)
+                if violations:
+                    failures.append(
+                        {"trace": trace_id(idxs),
+                         "events": [alphabet[i] for i in idxs],
+                         "violations": violations})
+                    if until_rule is not None and any(
+                            _rule_of(v) == until_rule
+                            for v in violations):
+                        return {"explored": explored,
+                                "failures": failures, "depth": depth,
+                                "alphabet": list(alphabet)}
+        return {"explored": explored, "failures": failures,
+                "depth": depth, "alphabet": list(alphabet)}
+    finally:
+        logger.setLevel(prev)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# seeded audits (defect must fire / corrected must hold)
+# ---------------------------------------------------------------------------
+
+def _fence_factory(fenced: bool):
+    return lambda base: Harness(base, fenced=fenced, controller=False)
+
+
+def _cooldown_factory(prefix: bool):
+    return lambda base: Harness(base, controller=True,
+                                prefix_cooldown=prefix, cooldown_ticks=1,
+                                hot=True)
+
+
+def _full_factory(base: str) -> Harness:
+    return Harness(base, controller=True, cooldown_ticks=2, hot=True)
+
+
+#: name -> (defect factory | None, correct factory, alphabet, depth,
+#:          rule the defect must fire)
+_AUDITS: Dict[str, Tuple[Optional[Callable], Callable,
+                         Sequence[str], int, Optional[str]]] = {
+    "fenceless-failover": (_fence_factory(False), _fence_factory(True),
+                           FENCE_ALPHABET, 4, "double-serve"),
+    "cooldown-off-by-one": (_cooldown_factory(True),
+                            _cooldown_factory(False),
+                            ("tick",), 4, "cooldown-discipline"),
+    "control-plane-full": (None, _full_factory, FULL_ALPHABET,
+                           FULL_DEPTH, None),
+}
+
+
+def _rule_of(violation: str) -> str:
+    return violation.split(":", 1)[0]
+
+
+def audit_events(name: str, correct: bool = False,
+                 depth: Optional[int] = None) -> Report:
+    """Run one seeded audit; the Report mirrors graft-race's
+    ``audit_schedules`` shape — findings carry a replayable trace id,
+    and a defect twin that does NOT fire yields ``explorer-miss``."""
+    defect_factory, correct_factory, alphabet, d, rule = _AUDITS[name]
+    depth = depth or d
+    factory = correct_factory if correct else defect_factory
+    if factory is None:
+        factory = correct_factory
+        correct = True
+    result = explore(factory, alphabet, depth,
+                     until_rule=None if correct else rule)
+    rep = Report()
+    rep.meta["audit"] = {"name": name, "correct": correct,
+                         "depth": depth, "alphabet": list(alphabet),
+                         "explored": result["explored"]}
+    for fail in result["failures"]:
+        for violation in fail["violations"]:
+            rep.findings.append(Finding(
+                rule=_rule_of(violation),
+                message=(f"{violation} [trace {fail['trace']}: "
+                         f"{' -> '.join(fail['events'])}] "
+                         f"(replay: --audit {name}"
+                         f"{'' if correct else ' --defect'} "
+                         f"--replay {fail['trace']})"),
+                program=name, ident=fail["trace"],
+                data={"replay_id": fail["trace"],
+                      "events": fail["events"],
+                      "explored": result["explored"]}))
+    if not correct and not result["failures"]:
+        rep.findings.append(Finding(
+            rule="explorer-miss",
+            message=(f"{name}: seeded defect twin explored "
+                     f"{result['explored']} sequence(s) to depth {depth} "
+                     "without a violation — the explorer lost its "
+                     "regression floor"),
+            program=name, ident="miss"))
+    return rep
+
+
+def replay(name: str, tid: str, correct: bool = False) -> List[str]:
+    """Re-run one trace with per-event narration; returns violations."""
+    import logging as _logging
+    import shutil
+    import tempfile
+    from deepspeed_tpu.utils.logging import logger
+    defect_factory, correct_factory, alphabet, _, _ = _AUDITS[name]
+    factory = correct_factory if correct or defect_factory is None \
+        else defect_factory
+    idxs = parse_trace(tid)
+    base = tempfile.mkdtemp(prefix="modelcheck_replay_")
+    prev = logger.level
+    logger.setLevel(_logging.ERROR)
+    try:
+        violations = run_sequence(factory, alphabet, idxs, base,
+                                  narrate=True)
+        for v in violations:
+            print(f"  VIOLATION {v}")
+        if not violations:
+            print("  (no violation on this trace)")
+        return violations
+    finally:
+        logger.setLevel(prev)
+        shutil.rmtree(base, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _run_corpus_gate(depth_override: Optional[int] = None) -> int:
+    """Every seeded defect must FIRE (with a replayable trace) and
+    every corrected twin must hold over its full bounded space."""
+    rc = 0
+    for name, (defect, _, alphabet, depth, rule) in _AUDITS.items():
+        depth = depth_override or depth
+        if defect is not None:
+            rep = audit_events(name, correct=False, depth=depth)
+            fired = {f.rule for f in rep.findings}
+            if rule in fired:
+                f = next(f for f in rep.findings if f.rule == rule)
+                print(f"[check] {name}: defect twin FIRES {rule} "
+                      f"(replay: --audit {name} --defect --replay "
+                      f"{f.data['replay_id']})")
+            else:
+                rc = 1
+                print(f"[check] {name}: EXPLORER MISS — defect twin did "
+                      f"not fire {rule} (fired: {sorted(fired)})")
+        cor = audit_events(name, correct=True, depth=depth)
+        if cor.ok:
+            print(f"[check] {name}: corrected twin holds over "
+                  f"{cor.meta['audit']['explored']} sequence(s) "
+                  f"(depth {depth}, {len(alphabet)} events)")
+        else:
+            rc = 1
+            print(f"[check] {name}: REGRESSION — invariant violated in "
+                  "the corrected twin:")
+            for f in cor.findings:
+                print(f"  {f.message}")
+    print("modelcheck: " + ("OK" if rc == 0 else "FAIL"))
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="modelcheck",
+        description="exhaustive bounded control-plane model checker")
+    p.add_argument("--corpus", action="store_true",
+                   help="run the seeded defect/corrected twin gate")
+    p.add_argument("--list-corpus", action="store_true")
+    p.add_argument("--audit", help="run one audit by name")
+    p.add_argument("--defect", action="store_true",
+                   help="run the audit's defect twin (default: corrected)")
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--replay", help="replay one trace id (e0.1.2)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    if args.list_corpus:
+        for name in sorted(_AUDITS):
+            print(name)
+        return 0
+    if args.audit and args.replay:
+        violations = replay(args.audit, args.replay,
+                            correct=not args.defect)
+        return 1 if violations else 0
+    if args.audit:
+        rep = audit_events(args.audit, correct=not args.defect,
+                           depth=args.depth)
+        if args.as_json:
+            print(rep.to_json())
+        else:
+            a = rep.meta["audit"]
+            print(f"[check] {args.audit}: explored {a['explored']} "
+                  f"sequence(s) to depth {a['depth']}")
+            for f in rep.findings:
+                print(f.message)
+            print("modelcheck: " + ("OK" if rep.ok else "FAIL"))
+        return 0 if rep.ok else 1
+    return _run_corpus_gate(args.depth)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

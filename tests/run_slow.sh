@@ -43,6 +43,10 @@
 #   FLEET_BUDGET=420 tests/run_slow.sh disagg  # ISSUE 19: the tp2->tp2
 #       KV-byte handoff parity run and the engine-backed burst/lull
 #       autoscale soak (FleetController scale events, zero lost)
+#   PROTO_BUDGET=420 tests/run_slow.sh proto modelcheck  # ISSUE 20: the
+#       exhaustive control-plane model-check soaks (full 8-event space
+#       at the shipped depth + the fencing alphabet one ring deeper,
+#       each sequence a fresh real-router world)
 #
 # Quick-tier tests are certified separately (pytest -m 'not slow'); this
 # driver runs ONLY the slow-marked tests of each module (-m slow) so the two
@@ -140,6 +144,13 @@ for m in "${modules[@]}"; do
         # of the corpus harnesses + 1000-schedule random soaks of the
         # corrected twins + the full two-face CLI gate
         *test_race_lint*) budget="${RACE_BUDGET:-420}" ;;
+        # ISSUE-20 protocol + model-check soaks: the full 8-event
+        # control-plane space at the shipped depth and the fencing
+        # alphabet one ring deeper — each sequence boots a real router
+        # (and, for the full alphabet, a FleetController) in a fresh
+        # world, so the soak is hundreds of router lifecycles
+        *test_proto_lint*|*test_modelcheck*)
+            budget="${PROTO_BUDGET:-420}" ;;
     esac
     t0=$(date +%s)
     out=$(timeout -k 10 "$budget" \

@@ -339,6 +339,8 @@ _CORPUS_RULES = {
     "tracing-sync-leak": "tracing-sync-leak",
     "staging-buffer-alias": "buffer-alias",
     "allocator-unlocked-share": "refcount-race",
+    "drain-schema-skew": "reader-writer-skew",
+    "fenceless-failover": "double-serve",
 }
 
 

@@ -1,0 +1,207 @@
+"""graft-check tests: the six control-plane invariants hold for the
+shipped router + fleet controller over exhaustive bounded event spaces,
+the seeded defect twins (fenceless failover, the PR-19 cooldown
+off-by-one) fire with replayable event traces, and the explorer's
+replay/trace-id machinery round-trips."""
+
+import pytest
+
+from deepspeed_tpu.robustness import modelcheck
+from deepspeed_tpu.robustness.modelcheck import (FENCE_ALPHABET,
+                                                 FULL_ALPHABET, Harness,
+                                                 audit_events, explore,
+                                                 parse_trace, run_sequence,
+                                                 trace_id)
+
+
+def _rules(report):
+    return {f.rule for f in report.findings}
+
+
+# --------------------------------------------------------------------------
+# the explorer itself
+# --------------------------------------------------------------------------
+
+class TestTraceIds:
+    def test_round_trip(self):
+        assert trace_id((0, 1, 0, 0)) == "e0.1.0.0"
+        assert parse_trace("e0.1.0.0") == [0, 1, 0, 0]
+        assert parse_trace(trace_id((7,))) == [7]
+
+    def test_bad_id_rejected(self):
+        with pytest.raises(ValueError):
+            parse_trace("x3.1")
+
+
+class TestExplorerDeterminism:
+    def test_same_trace_same_violations(self, tmp_path):
+        factory = lambda base: Harness(base, fenced=False)  # noqa: E731
+        idxs = [FENCE_ALPHABET.index(e)
+                for e in ("probe", "stale", "probe", "probe")]
+        a = run_sequence(factory, FENCE_ALPHABET, idxs, str(tmp_path / "a"))
+        b = run_sequence(factory, FENCE_ALPHABET, idxs, str(tmp_path / "b"))
+        assert a == b
+        assert any(v.startswith("double-serve") for v in a)
+
+    def test_exhaustive_count(self):
+        # lengths 1..2 over a 4-event alphabet = 4 + 16 worlds
+        res = explore(lambda base: Harness(base, fenced=True),
+                      FENCE_ALPHABET, depth=2)
+        assert res["explored"] == 20 and not res["failures"]
+
+
+# --------------------------------------------------------------------------
+# the shipped control plane holds every invariant
+# --------------------------------------------------------------------------
+
+class TestInvariantsHold:
+    def test_full_alphabet_exhaustive_depth_2(self):
+        # all 8 events (breaker, fencing, torn tags, fleet ticks) over
+        # every 1- and 2-event world: 72 sequences, six invariants each
+        res = explore(
+            lambda base: Harness(base, controller=True, cooldown_ticks=2,
+                                 hot=True),
+            FULL_ALPHABET, depth=2)
+        assert res["explored"] == 72
+        assert not res["failures"], res["failures"][:2]
+
+    def test_fencing_alphabet_exhaustive_depth_4(self):
+        # the fencing-focused space at the corpus depth: heartbeats go
+        # stale, partitions stick, and the fenced sweep never migrates a
+        # live replica's work
+        res = explore(lambda base: Harness(base, fenced=True),
+                      FENCE_ALPHABET, depth=4)
+        assert res["explored"] == 340
+        assert not res["failures"], res["failures"][:2]
+
+    def test_kill_with_drain_migrates_everything(self, tmp_path):
+        # a supervised kill drains through the integrity chain: the
+        # failover must migrate every queued request (lost == 0) and the
+        # survivor must complete them exactly once
+        h = Harness(str(tmp_path), fenced=True)
+        for ev in ("probe", "probe", "kill", "stale", "probe", "probe",
+                   "probe"):
+            h.apply(ev)
+        assert not h.violations, h.violations
+        fo = h._rb.history("replica_failover")
+        assert fo and fo[-1]["lost"] == 0 and fo[-1]["drain_tag"]
+        assert all(v == ["r1"] or v == ["r0"]
+                   for v in h.completions.values())
+        h.close()
+
+    def test_torn_tag_never_counts_as_evidence(self, tmp_path):
+        # a torn (uncommitted) drain tag + heartbeat silence must not
+        # migrate the still-alive replica's work
+        h = Harness(str(tmp_path), fenced=True)
+        for ev in ("probe", "torn", "stale", "probe", "probe"):
+            h.apply(ev)
+        assert not h.violations, h.violations
+        assert not h._rb.history("replica_failover")
+        h.close()
+
+
+# --------------------------------------------------------------------------
+# seeded twins: defect fires with a replayable trace, corrected holds
+# --------------------------------------------------------------------------
+
+class TestFencelessFailover:
+    def test_defect_found_as_double_serve_with_replayable_trace(self):
+        rep = audit_events("fenceless-failover", correct=False)
+        assert not rep.ok
+        assert "double-serve" in _rules(rep)
+        assert "unfenced-migration" in _rules(rep)
+        f = next(f for f in rep.findings if f.rule == "double-serve")
+        assert f.data["replay_id"].startswith("e")
+        # the printed trace id replays to the same violation
+        again = modelcheck.replay("fenceless-failover",
+                                  f.data["replay_id"], correct=False)
+        assert any(v.startswith("double-serve") for v in again)
+
+    def test_corrected_router_holds_over_full_space(self):
+        rep = audit_events("fenceless-failover", correct=True)
+        assert rep.ok, [f.message for f in rep.findings]
+        assert rep.meta["audit"]["explored"] == 340
+
+    def test_shallow_defect_run_reports_explorer_miss(self):
+        # the regression floor for the explorer itself: a depth too
+        # shallow to reach the bug must say so, not pass silently
+        rep = audit_events("fenceless-failover", correct=False, depth=1)
+        assert "explorer-miss" in _rules(rep)
+
+
+class TestCooldownOffByOne:
+    def test_prefix_tick_fires_cooldown_discipline(self):
+        # the PR-19 defect: decrement-before-gate makes cooldown_ticks=1
+        # suppress ZERO ticks — two consecutive scale-ups, no quiet tick
+        rep = audit_events("cooldown-off-by-one", correct=False)
+        assert not rep.ok
+        assert "cooldown-discipline" in _rules(rep)
+        f = next(f for f in rep.findings
+                 if f.rule == "cooldown-discipline")
+        assert f.data["replay_id"] == "e0.0"      # [tick, tick]
+        assert "only 0 observe tick" in f.message
+
+    def test_fixed_tick_holds_and_acts_after_exactly_the_cooldown(self):
+        rep = audit_events("cooldown-off-by-one", correct=True)
+        assert rep.ok, [f.message for f in rep.findings]
+
+    def test_stuck_cooldown_also_flagged(self, tmp_path):
+        # the other direction of exactness: a controller that never
+        # leaves cooldown under clean sustained pressure is stuck
+        from deepspeed_tpu.inference.fleet import FleetController
+
+        class _Stuck(FleetController):
+            def tick(self):
+                out = super().tick()
+                if out is not None:
+                    self._cooldown = 10 ** 9   # jam after the first action
+                return out
+
+        h = Harness(str(tmp_path), controller=True, cooldown_ticks=1,
+                    hot=True)
+        h.ctl = _Stuck(h.router, h.ctl.spawn, h.fleet_cfg)
+        for _ in range(5):
+            h.apply("tick")
+        assert any(v.startswith("cooldown-discipline") and "stuck" in v
+                   for v in h.violations), h.violations
+        h.close()
+
+
+class TestCLI:
+    def test_corpus_gate_exit_zero(self, capsys):
+        assert modelcheck.main(["--corpus"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("defect twin FIRES") == 2
+        assert out.count("corrected twin holds") == 3
+        assert "--replay e" in out
+        assert "modelcheck: OK" in out
+
+    def test_single_audit_exit_codes(self, capsys):
+        assert modelcheck.main(["--audit", "fenceless-failover",
+                                "--defect"]) == 1
+        capsys.readouterr()
+        assert modelcheck.main(["--audit", "cooldown-off-by-one"]) == 0
+
+    def test_list_corpus(self, capsys):
+        assert modelcheck.main(["--list-corpus"]) == 0
+        out = capsys.readouterr().out
+        assert "fenceless-failover" in out
+        assert "control-plane-full" in out
+
+
+# --------------------------------------------------------------------------
+# slow tier: the shipped depth + one deeper ring (run_slow.sh, PROTO_BUDGET)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestExhaustiveSoak:
+    def test_control_plane_full_space_at_shipped_depth(self):
+        rep = audit_events("control-plane-full", correct=True)
+        assert rep.ok, [f.message for f in rep.findings]
+        assert rep.meta["audit"]["explored"] == 584     # 8 + 64 + 512
+
+    def test_fencing_space_one_ring_deeper(self):
+        res = explore(lambda base: Harness(base, fenced=True),
+                      FENCE_ALPHABET, depth=5)
+        assert res["explored"] == 1364
+        assert not res["failures"], res["failures"][:2]

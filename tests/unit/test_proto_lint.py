@@ -1,0 +1,453 @@
+"""graft-proto tests: each wire-schema rule both directions on fixture
+sources, the checked-in registry against the live tree (clean, no
+baseline), baseline round-trip, golden wire fixtures replayed against
+the CURRENT readers, the seeded corpus twins, and CLI exit codes."""
+
+import json
+import os
+import textwrap
+import types
+
+import pytest
+
+from deepspeed_tpu.analysis import proto_lint
+from deepspeed_tpu.analysis.proto_lint import (audit_drain_schema_skew,
+                                               load_registry, scan_package,
+                                               scan_source)
+
+_FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures",
+                         "proto")
+
+
+def _rules(report):
+    return {f.rule for f in report.findings}
+
+
+def _snippet(src):
+    return textwrap.dedent(src)
+
+
+# --------------------------------------------------------------------------
+# each rule, defect and corrected twin on synthetic boundary modules
+# --------------------------------------------------------------------------
+
+class TestUnversionedPayload:
+    def test_versionless_drain_writer_flagged(self):
+        rep = scan_source(_snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"source": "r0", "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """), "corpus/fix_writer.py")
+        assert "unversioned-payload" in _rules(rep)
+        f = next(f for f in rep.findings if f.rule == "unversioned-payload")
+        assert "corpus/fix_writer.py:" in f.message
+
+    def test_versioned_drain_writer_clean(self):
+        rep = scan_source(_snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"version": 3, "source": "r0",
+                         "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """), "corpus/fix_writer.py")
+        assert "unversioned-payload" not in _rules(rep)
+
+    def test_unmatched_boundary_sink_without_version_flagged(self):
+        # a NEW payload shape json-dumped at a boundary without any
+        # version/schema key: the lint can't match it, but it can still
+        # demand versioning discipline
+        rep = scan_source(_snippet("""
+            import json
+
+            def save(path, rows):
+                blob = {"rows": rows, "kind": "sidecar"}
+                with open(path, "w") as f:
+                    json.dump(blob, f)
+        """), "deepspeed_tpu/inference/fix_sidecar.py")
+        assert "unversioned-payload" in _rules(rep)
+
+    def test_event_emit_without_schema_flagged_and_with_schema_clean(self):
+        bad = scan_source(_snippet("""
+            from deepspeed_tpu.robustness import events as rb_events
+
+            def announce(rid):
+                rb_events.emit("request_handoff", rid=rid, src="a",
+                               dst="b")
+        """), "deepspeed_tpu/inference/fix_events.py")
+        assert "unversioned-payload" in _rules(bad)
+        good = scan_source(_snippet("""
+            from deepspeed_tpu.robustness import events as rb_events
+
+            def announce(rid):
+                rb_events.emit("request_handoff", schema=1, rid=rid,
+                               src="a", dst="b")
+        """), "deepspeed_tpu/inference/fix_events.py")
+        assert "unversioned-payload" not in _rules(good)
+
+
+class TestSchemaBreakingChange:
+    def test_unregistered_version_flagged(self):
+        rep = scan_source(_snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"version": 9, "source": "r0",
+                         "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """), "corpus/fix_writer.py")
+        assert "schema-breaking-change" in _rules(rep)
+
+    def test_unregistered_field_flagged_registered_clean(self):
+        bad = scan_source(_snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"version": 3, "source": "r0",
+                         "sampler_state": 7, "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """), "corpus/fix_writer.py")
+        assert "schema-breaking-change" in _rules(bad)
+        f = next(f for f in bad.findings
+                 if f.rule == "schema-breaking-change")
+        assert "sampler_state" in f.message
+        good = scan_source(_snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"version": 3, "source": "r0", "rng_counter": 7,
+                         "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """), "corpus/fix_writer.py")
+        assert "schema-breaking-change" not in _rules(good)
+
+    def test_missing_required_field_flagged(self):
+        # a kv-payload built without its crc/geometry: the handoff
+        # reader's validation contract is broken at the writer
+        rep = scan_source(_snippet("""
+            def export(rows, blocks, data):
+                return {"schema": 1, "rows": rows, "blocks": blocks,
+                        "data": data}
+        """), "deepspeed_tpu/inference/fix_kv.py")
+        assert "schema-breaking-change" in _rules(rep)
+        f = next(f for f in rep.findings
+                 if f.rule == "schema-breaking-change")
+        assert "crc" in f.message or "geometry" in f.message
+
+    def test_event_with_unregistered_field_flagged(self):
+        rep = scan_source(_snippet("""
+            from deepspeed_tpu.robustness import events as rb_events
+
+            def announce(rid):
+                rb_events.emit("request_handoff", schema=1, rid=rid,
+                               src="a", dst="b", flavor="spicy")
+        """), "deepspeed_tpu/inference/fix_events.py")
+        assert "schema-breaking-change" in _rules(rep)
+
+    def test_version_constant_resolved_through_schemas_module(self):
+        # writers reference DRAIN_STATE_VERSION, not a literal: the lint
+        # resolves it via inference/schemas.py so a legal bump there is
+        # seen without editing every writer
+        rep = scan_source(_snippet("""
+            import json
+            from deepspeed_tpu.inference.schemas import DRAIN_STATE_VERSION
+
+            def save(path, requests):
+                state = {"version": DRAIN_STATE_VERSION, "source": "r0",
+                         "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """), "corpus/fix_writer.py")
+        assert "schema-breaking-change" not in _rules(rep)
+        assert "unversioned-payload" not in _rules(rep)
+
+
+def _reader_registry(relpath, qual="read_drain", keep_checksum=False):
+    """Registry overlay: the fixture function is the ONLY registered
+    drain-state reader (so skew/checksum findings anchor there)."""
+    reg = load_registry()
+    reg["schemas"]["drain-state"]["readers"] = [f"{relpath}::{qual}"]
+    if not keep_checksum:
+        reg["schemas"]["drain-state"].pop("checksum", None)
+    reg["schemas"]["drain-request"]["readers"] = []
+    reg["schemas"]["kv-payload"]["readers"] = []
+    return reg
+
+
+class TestReaderWriterSkew:
+    _RELPATH = "corpus/fix_reader.py"
+
+    def test_bare_read_of_version_gated_field_flagged(self):
+        rep = scan_source(_snippet("""
+            import json
+
+            def read_drain(path):
+                with open(path) as f:
+                    state = json.load(f)
+                return state["engine"], state["requests"]
+        """), self._RELPATH, registry=_reader_registry(self._RELPATH))
+        assert "reader-writer-skew" in _rules(rep)
+        f = next(f for f in rep.findings if f.rule == "reader-writer-skew")
+        assert "engine" in f.message and f"{self._RELPATH}:" in f.message
+
+    def test_get_defaulted_read_clean(self):
+        rep = scan_source(_snippet("""
+            import json
+
+            def read_drain(path):
+                with open(path) as f:
+                    state = json.load(f)
+                return state.get("engine"), state["requests"]
+        """), self._RELPATH, registry=_reader_registry(self._RELPATH))
+        assert "reader-writer-skew" not in _rules(rep)
+
+    def test_membership_guarded_read_clean(self):
+        # the serving.py idiom: `if "engine" in state:` before indexing
+        rep = scan_source(_snippet("""
+            import json
+
+            def read_drain(path):
+                with open(path) as f:
+                    state = json.load(f)
+                if "engine" in state:
+                    return state["engine"], state["requests"]
+                return None, state["requests"]
+        """), self._RELPATH, registry=_reader_registry(self._RELPATH))
+        assert "reader-writer-skew" not in _rules(rep)
+
+    def test_always_required_field_bare_read_clean(self):
+        # `requests` is required by EVERY registered version: indexing it
+        # bare can never skew
+        rep = scan_source(_snippet("""
+            import json
+
+            def read_drain(path):
+                with open(path) as f:
+                    state = json.load(f)
+                return state["requests"]
+        """), self._RELPATH, registry=_reader_registry(self._RELPATH))
+        assert "reader-writer-skew" not in _rules(rep)
+
+
+class TestChecksumGap:
+    _RELPATH = "corpus/fix_reader.py"
+
+    def test_unverified_reader_of_checksummed_schema_flagged(self):
+        rep = scan_source(_snippet("""
+            import json
+
+            def read_drain(path):
+                with open(path) as f:
+                    state = json.load(f)
+                return state.get("engine"), state["requests"]
+        """), self._RELPATH,
+            registry=_reader_registry(self._RELPATH, keep_checksum=True))
+        assert "checksum-gap" in _rules(rep)
+        f = next(f for f in rep.findings if f.rule == "checksum-gap")
+        assert "validate_tag" in f.message
+
+    def test_reader_through_integrity_chain_clean(self):
+        rep = scan_source(_snippet("""
+            import json
+            import os
+            from deepspeed_tpu.robustness import integrity
+
+            def read_drain(save_dir):
+                tag = integrity.newest_valid_tag(save_dir)
+                with open(os.path.join(save_dir, tag, "state.json")) as f:
+                    state = json.load(f)
+                return state.get("engine"), state["requests"]
+        """), self._RELPATH,
+            registry=_reader_registry(self._RELPATH, keep_checksum=True))
+        assert "checksum-gap" not in _rules(rep)
+
+
+# --------------------------------------------------------------------------
+# the live tree against the checked-in registry
+# --------------------------------------------------------------------------
+
+class TestPackageScan:
+    def test_package_clean_even_without_baseline(self):
+        # the acceptance gate: after this PR's schema centralization the
+        # tree has zero findings to allowlist (no baseline file exists)
+        rep = scan_package()
+        assert rep.ok, [f"{f.rule}: {f.message}" for f in rep.findings]
+        assert not os.path.exists(proto_lint.DEFAULT_BASELINE)
+
+    def test_census_covers_the_fleet_surface(self):
+        rep = scan_package()
+        census = rep.meta["proto"]
+        # drain writers (engine + router residue + lint stub), heartbeat,
+        # manifest, kv export at least
+        assert census["payload_sites"] >= 10
+        assert census["matched_payloads"] >= 8
+        assert census["emit_sites"] >= 20
+        # every registered reader function must actually be found —
+        # a renamed reader silently dropping out of scope is how skew
+        # checks rot
+        registry = load_registry()
+        registered = sum(len(s.get("readers", ()))
+                         for s in registry["schemas"].values())
+        assert census["reader_fns"] == registered
+
+    def test_baseline_round_trip_suppresses(self):
+        src = _snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"source": "r0", "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """)
+        rep = scan_source(src, "corpus/fix_writer.py")
+        assert not rep.ok
+        rep2 = scan_source(src, "corpus/fix_writer.py")
+        rep2.apply_baseline(rep.baseline_dict())
+        assert rep2.ok and rep2.suppressed
+
+
+# --------------------------------------------------------------------------
+# golden wire fixtures: payloads from every era the fleet ever wrote,
+# replayed against the CURRENT readers
+# --------------------------------------------------------------------------
+
+def _fixture(name):
+    with open(os.path.join(_FIXTURES, name + ".json")) as f:
+        return json.load(f)
+
+
+class TestGoldenFixtures:
+    @pytest.mark.parametrize("name", ["drain_state_v1", "drain_state_v2",
+                                      "drain_state_v2_nogeometry",
+                                      "drain_state_v3"])
+    def test_drain_fixture_loads_through_current_reader(self, name,
+                                                        tmp_path):
+        from deepspeed_tpu.inference.schemas import DRAIN_STATE_VERSIONS
+        from deepspeed_tpu.inference.serving import load_drain_state
+        from deepspeed_tpu.robustness import integrity
+        payload = _fixture(name)
+        tag_dir = tmp_path / "drain_fixture"
+        tag_dir.mkdir()
+        integrity.atomic_write(str(tag_dir / "state.json"),
+                               json.dumps(payload), what="golden fixture")
+        integrity.write_manifest(str(tag_dir))
+        integrity.write_commit_marker(str(tag_dir))
+        state = load_drain_state(str(tmp_path), tag="drain_fixture")
+        assert state["tag"] == "drain_fixture"
+        assert int(state.get("version", 1)) in DRAIN_STATE_VERSIONS
+        # exactly the fields the failover/resume paths index bare —
+        # every era's records must satisfy them
+        assert state["requests"]
+        for rec in state["requests"]:
+            assert int(rec["rid"]) >= 0
+            assert isinstance(rec["prompt"], list) and rec["prompt"]
+            assert int(rec["max_new_tokens"]) >= 1
+            assert isinstance(rec.get("generated", []), list)
+        # the version-gated fields stay .get-guarded in the reader
+        state.get("engine"), state.get("rng_counter"), state.get("source")
+
+    def test_registry_pins_every_drain_fixture_era(self):
+        registry = load_registry()
+        versions = registry["schemas"]["drain-state"]["versions"]
+        for name in ("drain_state_v1", "drain_state_v2",
+                     "drain_state_v2_nogeometry", "drain_state_v3"):
+            payload = _fixture(name)
+            ver = str(payload.get("version", 1))
+            assert ver in versions, (name, ver)
+            spec = versions[ver]
+            known = set(spec["required"]) | set(spec["optional"])
+            assert set(payload) <= known, (name, set(payload) - known)
+            missing = set(spec["required"]) - set(payload)
+            assert not missing, (name, missing)
+
+    def test_roleless_heartbeat_readable_and_lands_in_both_tier(self,
+                                                               tmp_path):
+        from deepspeed_tpu.elasticity.rendezvous import FileRendezvous
+        from deepspeed_tpu.inference.fleet import (FleetConfig,
+                                                   FleetController)
+        payload = _fixture("heartbeat_roleless")
+        (tmp_path / f"hb_{payload['host']}.json").write_text(
+            json.dumps(payload))
+        rdzv = FileRendezvous(str(tmp_path), "observer", dead_after_s=60.0,
+                              clock=lambda: payload["ts"] + 1.0)
+        beats = rdzv.read_heartbeats()
+        assert payload["host"] in beats
+        assert "schema" not in beats[payload["host"]]   # the pre-schema era
+        assert payload["host"] in rdzv.live_host_info()
+        # the CURRENT fleet controller resolves a role-less meta to the
+        # "both" tier (the pre-disaggregation deployment shape)
+        router = types.SimpleNamespace(
+            config=types.SimpleNamespace(
+                store_dir=str(tmp_path),
+                clock=lambda: payload["ts"] + 1.0),
+            replicas={})
+        ctl = FleetController(router, spawn=lambda n, r: None,
+                              config=FleetConfig(role="both",
+                                                 dead_after_s=60.0))
+        assert payload["host"] in ctl._tier()
+
+
+# --------------------------------------------------------------------------
+# corpus twins + CLI
+# --------------------------------------------------------------------------
+
+class TestCorpusTwins:
+    def test_defect_fires_both_rules_with_provenance(self):
+        rep = audit_drain_schema_skew(correct=False)
+        assert not rep.ok
+        rules = _rules(rep)
+        assert "schema-breaking-change" in rules
+        assert "reader-writer-skew" in rules
+        for f in rep.findings:
+            assert f.data["file"] and f.data["line"] > 0
+
+    def test_corrected_twin_holds(self):
+        rep = audit_drain_schema_skew(correct=True)
+        assert rep.ok, [f.message for f in rep.findings]
+
+
+class TestCLI:
+    def test_tree_scan_exit_zero(self, capsys):
+        assert proto_lint.main([]) == 0
+        out = capsys.readouterr().out
+        assert "proto_lint: OK" in out
+
+    def test_corpus_gate_exit_zero(self, capsys):
+        assert proto_lint.main(["--corpus"]) == 0
+        out = capsys.readouterr().out
+        assert "defect twin FIRES" in out
+        assert "corrected twin holds" in out
+        assert " at corpus/drain_schema_skew.py:" in out
+
+    def test_json_report_parses(self, capsys):
+        assert proto_lint.main(["--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["ok"] is True
+
+    def test_write_baseline_round_trip(self, tmp_path, capsys):
+        # a deliberately dirty single-module "tree": baseline it, rescan
+        root = tmp_path / "deepspeed_tpu"
+        root.mkdir()
+        (root / "dirty.py").write_text(_snippet("""
+            import json
+
+            def save(path, requests):
+                state = {"source": "r0", "requests": requests}
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """))
+        base = tmp_path / "baseline.json"
+        assert proto_lint.main(["--root", str(root), "--no-baseline"]) == 1
+        capsys.readouterr()
+        assert proto_lint.main(["--root", str(root), "--baseline",
+                                str(base), "--write-baseline"]) == 0
+        assert base.exists()
+        assert proto_lint.main(["--root", str(root), "--baseline",
+                                str(base)]) == 0
